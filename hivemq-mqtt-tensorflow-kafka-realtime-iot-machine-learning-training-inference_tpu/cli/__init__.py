@@ -1,0 +1,1 @@
+from .cardata import main  # noqa: F401

@@ -1,0 +1,8 @@
+from .schema import (  # noqa: F401
+    CAR_SCHEMA,
+    KSQL_CAR_SCHEMA,
+    Field,
+    RecordSchema,
+    SENSOR_FIELDS,
+)
+from .normalize import Normalizer, CAR_NORMALIZER  # noqa: F401

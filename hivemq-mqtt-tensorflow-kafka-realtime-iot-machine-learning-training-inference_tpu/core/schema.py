@@ -1,0 +1,184 @@
+"""Typed record schemas for the car-sensor domain.
+
+The reference system has two Avro schemas for the same logical record
+(see reference `testdata/cardata-v1.avsc` and
+`python-scripts/AUTOENCODER-TensorFlow-IO-Kafka/cardata-v1.avsc`):
+
+1. the *producer* schema — 18 required fields, lower_snake_case, float/int
+   primitives — used by the device fleet when publishing over MQTT, and
+2. the *KSQL-derived* schema — the 18 fields renamed to UPPER_CASE (with the
+   KSQL quirk that `tire_pressure_1_1 → TIRE_PRESSURE11` etc.), widened to
+   nullable `["null","double"]` / `["null","int"]` unions, plus a 19th field
+   `FAILURE_OCCURRED: ["null","string"]` (the anomaly label) — this is what
+   the ML layer actually consumes.
+
+Rather than shipping two JSON files and a generic Avro parser as the source
+of truth, we define one field table and *derive* both schema variants (and
+their Avro JSON) from it.  The Avro JSON emitted here is wire-compatible
+with the reference schemas.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional
+
+import numpy as np
+
+# Avro primitive → numpy dtype for the columnar decode path.
+_AVRO_NP = {
+    "float": np.float32,
+    "double": np.float64,
+    "int": np.int32,
+    "long": np.int64,
+    "boolean": np.bool_,
+    "string": object,
+    "bytes": object,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    """One field of a record schema.
+
+    ``norm`` is the affine normalization range (lo, hi) mapping to (-1, 1);
+    ``None`` means the reference zeroes the field out (its normalize_fn TODOs,
+    reference cardata-v3.py:108-124) — we preserve that for parity, and expose
+    a corrected path behind a flag in `core.normalize`.
+    """
+
+    name: str
+    avro_type: str  # primitive name: float/double/int/string/...
+    nullable: bool = False
+    doc: str = ""
+    norm: Optional[tuple] = None
+
+    @property
+    def np_dtype(self):
+        return _AVRO_NP[self.avro_type]
+
+    def avro_json(self) -> dict:
+        t = [self.avro_type] if not self.nullable else ["null", self.avro_type]
+        out = {"name": self.name, "type": t[0] if len(t) == 1 else t}
+        if self.nullable:
+            out["default"] = None
+        if self.doc:
+            out["doc"] = self.doc
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class RecordSchema:
+    """An Avro record schema plus framework metadata."""
+
+    name: str
+    namespace: str
+    fields: tuple  # tuple[Field, ...]
+    label_field: Optional[str] = None  # name of the anomaly-label field, if any
+
+    def avro_json(self) -> str:
+        return json.dumps(
+            {
+                "type": "record",
+                "name": self.name,
+                "namespace": self.namespace,
+                "fields": [f.avro_json() for f in self.fields],
+            },
+            indent=2,
+        )
+
+    @property
+    def field_names(self):
+        return tuple(f.name for f in self.fields)
+
+    @property
+    def sensor_fields(self):
+        """Fields that feed the model (everything except the label)."""
+        return tuple(f for f in self.fields if f.name != self.label_field)
+
+    @property
+    def num_sensors(self) -> int:
+        return len(self.sensor_fields)
+
+    def field(self, name: str) -> Field:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        raise KeyError(name)
+
+
+def _ksql_name(name: str) -> str:
+    """KSQL column naming as observed in the reference KSQL-derived schema:
+    upper-case, and single digits separated by underscores are collapsed
+    (``tire_pressure_1_1`` → ``TIRE_PRESSURE11``,
+    ``accelerometer_1_1_value`` → ``ACCELEROMETER11_VALUE``)."""
+    parts = name.split("_")
+    out, digits = [], []
+    for p in parts:
+        if len(p) == 1 and p.isdigit():
+            digits.append(p)
+        else:
+            if digits:
+                out[-1] = out[-1] + "".join(digits)
+                digits = []
+            out.append(p)
+    if digits:
+        out[-1] = out[-1] + "".join(digits)
+    return "_".join(out).upper()
+
+
+# The single source of truth: 18 sensor fields, their Avro primitive type in
+# the *producer* schema, and the normalization spec from the reference
+# normalize_fn (cardata-v3.py:105-148).  norm=None ⇒ zeroed (reference TODO).
+SENSOR_FIELDS = (
+    Field("coolant_temp", "float", doc="battery/engine coolant temperature in degC", norm=None),
+    Field("intake_air_temp", "float", doc="air intake temperature in degC", norm=(15.0, 40.0)),
+    Field("intake_air_flow_speed", "float", doc="air intake mass g/s", norm=None),
+    Field("battery_percentage", "float", doc="battery cell total percentage left", norm=(0.0, 100.0)),
+    Field("battery_voltage", "float", doc="battery pack voltage in mV", norm=None),
+    Field("current_draw", "float", doc="current in A drawn from the battery", norm=None),
+    Field("speed", "float", doc="vehicle speed in m/s", norm=(0.0, 50.0)),
+    Field("engine_vibration_amplitude", "float", doc="engine vibration in mV", norm=(0.0, 7500.0)),
+    Field("throttle_pos", "float", doc="throttle position [0..1]", norm=(0.0, 1.0)),
+    Field("tire_pressure_1_1", "int", doc="tire pressure psi front left", norm=(20.0, 35.0)),
+    Field("tire_pressure_1_2", "int", doc="tire pressure psi front right", norm=(20.0, 35.0)),
+    Field("tire_pressure_2_1", "int", doc="tire pressure psi back left", norm=(20.0, 35.0)),
+    Field("tire_pressure_2_2", "int", doc="tire pressure psi back right", norm=(20.0, 35.0)),
+    Field("accelerometer_1_1_value", "float", doc="accel m/s^2 front left", norm=(0.0, 7.0)),
+    Field("accelerometer_1_2_value", "float", doc="accel m/s^2 front right", norm=(0.0, 7.0)),
+    Field("accelerometer_2_1_value", "float", doc="accel m/s^2 back left", norm=(0.0, 7.0)),
+    Field("accelerometer_2_2_value", "float", doc="accel m/s^2 back right", norm=(0.0, 7.0)),
+    Field("control_unit_firmware", "int", doc="firmware version [1000|2000]", norm=(1000.0, 2000.0)),
+)
+
+# Producer-side schema: what devices publish over MQTT (18 required fields).
+CAR_SCHEMA = RecordSchema(
+    name="CarData",
+    namespace="com.hivemq.avro",
+    fields=SENSOR_FIELDS,
+)
+
+# KSQL-derived schema: what the ML layer consumes (19 nullable upper-case
+# fields; floats widened to double; label appended).
+KSQL_CAR_SCHEMA = RecordSchema(
+    name="KsqlDataSourceSchema",
+    namespace="io.confluent.ksql.avro_schemas",
+    fields=tuple(
+        [
+            Field(
+                _ksql_name(f.name),
+                "double" if f.avro_type == "float" else f.avro_type,
+                nullable=True,
+                norm=f.norm,
+            )
+            for f in SENSOR_FIELDS
+        ]
+        + [Field("FAILURE_OCCURRED", "string", nullable=True)]
+    ),
+    label_field="FAILURE_OCCURRED",
+)
+
+# Offline CSV fixture layout (reference testdata/car-sensor-data.csv):
+# header `time,car,<18 sensor columns in producer order>`.
+CSV_COLUMNS = ("time", "car") + CAR_SCHEMA.field_names

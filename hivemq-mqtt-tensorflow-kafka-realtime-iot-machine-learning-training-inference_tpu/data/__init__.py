@@ -1,0 +1,2 @@
+from .dataset import SensorBatches, Batch  # noqa: F401
+from .prefetch import DevicePrefetcher  # noqa: F401

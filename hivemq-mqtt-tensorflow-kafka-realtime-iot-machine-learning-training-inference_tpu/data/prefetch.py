@@ -1,0 +1,103 @@
+"""Host→device prefetch: overlap stream decode with the device step.
+
+SURVEY §7 'hard parts (b)': host Avro decode + network consume must hide
+under the device step or throughput dies.  A background thread drains the
+batch iterator and stages `jax.device_put` results into a small queue, so
+while the TPU executes step N the host is already decoding and transferring
+step N+1 (double/triple buffering).  With a sharding, `device_put` lands
+shards directly on the mesh (the per-partition → per-shard assignment path
+used by `parallel.data_parallel`).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Optional
+
+import jax
+
+
+class DevicePrefetcher:
+    """Iterate device-resident batches with background staging.
+
+    Args:
+      batches: host batch iterable.
+      to_device: maps a host batch to device arrays; defaults to
+        `jax.device_put` of `batch.x` (and `batch.y` when present), returning
+        (arrays, batch) so callers keep metadata (n_valid, first_index).
+      depth: queue depth; 2 = classic double buffering.
+      sharding: optional `jax.sharding.Sharding` for direct sharded puts.
+    """
+
+    _END = object()
+
+    def __init__(self, batches: Iterable, to_device: Optional[Callable] = None,
+                 depth: int = 2, sharding=None):
+        self.batches = batches
+        self.sharding = sharding
+        self.to_device = to_device or self._default_to_device
+        self.q: queue.Queue = queue.Queue(maxsize=depth)
+        self._err = None
+        self._closed = False
+        self._consumed = False
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+
+    def _default_to_device(self, batch):
+        x = jax.device_put(batch.x, self.sharding)
+        y = jax.device_put(batch.y, self.sharding) if getattr(batch, "y", None) is not None else None
+        return (x, y), batch
+
+    def _put(self, item) -> bool:
+        """put that gives up when the consumer closed; never blocks forever."""
+        while not self._closed:
+            try:
+                self.q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _work(self):
+        try:
+            for b in self.batches:
+                if not self._put(self.to_device(b)):
+                    return  # consumer closed mid-stream
+        except BaseException as e:  # surfaced on the consumer side
+            self._err = e
+        finally:
+            self._put(self._END)
+
+    def close(self):
+        """Release the worker (called automatically when iteration stops,
+        including early break); safe to call twice."""
+        self._closed = True
+        try:
+            while True:
+                self.q.get_nowait()
+        except queue.Empty:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __iter__(self):
+        if self._consumed:
+            raise RuntimeError(
+                "DevicePrefetcher is single-use: the background thread already "
+                "drained its source; build a new one per pass")
+        self._consumed = True
+        try:
+            while True:
+                item = self.q.get()
+                if item is self._END:
+                    if self._err is not None:
+                        raise self._err
+                    return
+                yield item
+        finally:
+            self.close()

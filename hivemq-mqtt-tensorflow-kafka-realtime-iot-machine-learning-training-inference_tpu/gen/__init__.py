@@ -1,0 +1,1 @@
+from .simulator import FleetScenario, FleetGenerator  # noqa: F401

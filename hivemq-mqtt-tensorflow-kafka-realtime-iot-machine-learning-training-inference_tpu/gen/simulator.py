@@ -1,0 +1,210 @@
+"""Car-fleet load generator — the device-simulator equivalent.
+
+The reference drives its demo with an external Java commander/agent fleet
+(`sbaier1/device-simulator:avro`, scenario XML: 100k clients named
+`electric-vehicle-[0-9]{5}`, 1 msg/10 s, 3000 msgs/car — reference
+`infrastructure/test-generator/scenario.xml`), whose payloads come from
+`com.hivemq.CarDataPayloadGenerator` with injected failure modes.  That
+simulator is also the reference's only "multi-node test cluster" (SURVEY §4).
+
+This module is the TPU-framework-native rebuild: a vectorized numpy fleet
+simulator with per-car latent state, physically-plausible sensor
+correlations (vibration tracks speed — the reference's own docstring notes
+`speed * 150 or speed * 100`, cardata-v3.py:129), failure modes that perturb
+the relevant sensors and set the label, and a scenario config mirroring the
+XML knobs (fleet size, per-car rate, message count, ramp-up).  It emits
+producer-schema or KSQL-schema records, raw columns (fast path for
+benchmarks), or framed-Avro broker messages.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Optional
+
+import numpy as np
+
+from ..core.schema import CAR_SCHEMA, KSQL_CAR_SCHEMA, RecordSchema
+from ..ops.avro import AvroCodec
+from ..ops.framing import frame
+
+
+@dataclasses.dataclass
+class FleetScenario:
+    """Scenario knobs, mirroring the reference XML (scenario.xml:11-52)."""
+
+    num_cars: int = 25  # reference evaluation scenario size
+    msgs_per_car: int = 40
+    interval_s: float = 5.0
+    ramp_up_s: float = 5.0
+    failure_rate: float = 0.01  # fraction of cars that develop a failure
+    seed: int = 7
+
+    @classmethod
+    def full(cls):
+        """The 100k-car scenario (scenario.xml:13-14,48-49)."""
+        return cls(num_cars=100_000, msgs_per_car=3000, interval_s=10.0,
+                   ramp_up_s=20.0)
+
+    def car_id(self, i: int) -> str:
+        return f"electric-vehicle-{i:05d}"
+
+
+class FleetGenerator:
+    """Stateful vectorized simulator over a fleet scenario."""
+
+    def __init__(self, scenario: FleetScenario = FleetScenario()):
+        self.scenario = scenario
+        n = scenario.num_cars
+        rng = np.random.default_rng(scenario.seed)
+        self.rng = rng
+        # Per-car latent state.
+        self.speed = rng.uniform(0.0, 30.0, n)
+        self.battery = rng.uniform(40.0, 100.0, n)
+        self.firmware = rng.choice([1000, 2000], n).astype(np.int32)
+        self.tire_base = rng.uniform(28.0, 33.0, (n, 4))
+        # Failure state: -1 = healthy, else index of failing mode.
+        self.failing = np.full(n, -1, np.int32)
+        fail_cars = rng.random(n) < scenario.failure_rate
+        self.failing[fail_cars] = rng.integers(0, 3, fail_cars.sum())
+        self.t = 0.0
+
+    # ----------------------------------------------------------- columns
+    def step_columns(self, batch_cars: Optional[np.ndarray] = None) -> dict:
+        """Advance one tick for the selected cars; return raw sensor columns
+        (producer-schema units) + 'car' ids + 'failure_occurred' labels."""
+        s = self.scenario
+        idx = np.arange(s.num_cars) if batch_cars is None else batch_cars
+        n = len(idx)
+        rng = self.rng
+
+        # speed: mean-reverting random walk in [0, 50] m/s
+        self.speed[idx] = np.clip(
+            self.speed[idx] + rng.normal(0, 2.0, n) - 0.02 * (self.speed[idx] - 20.0),
+            0.0, 50.0)
+        speed = self.speed[idx]
+        throttle = np.clip(speed / 50.0 + rng.normal(0, 0.05, n), 0.0, 1.0)
+        vibration = speed * rng.uniform(100.0, 150.0, n)  # reference's own model
+        self.battery[idx] = np.clip(self.battery[idx] - rng.uniform(0, 0.05, n), 0.0, 100.0)
+        current = 5.0 + speed * 0.5 + rng.normal(0, 1.0, n)
+        coolant = 20.0 + speed * 0.6 + rng.normal(0, 2.0, n)
+        airflow = speed * 3.0 + rng.normal(0, 5.0, n)
+        voltage = 200.0 + self.battery[idx] * 0.5 + rng.normal(0, 2.0, n)
+        tires = self.tire_base[idx] + rng.normal(0, 0.5, (n, 4))
+        accel = np.abs(rng.normal(0.5, 0.8, (n, 4)))
+
+        # failure modes perturb the physics and set the label
+        failing = self.failing[idx]
+        lab = failing >= 0
+        m0 = failing == 0  # engine failure: vibration spike
+        vibration[m0] *= rng.uniform(2.0, 4.0, m0.sum())
+        m1 = failing == 1  # tire blowout: one tire loses pressure
+        tires[m1, 0] = rng.uniform(10.0, 18.0, m1.sum())
+        m2 = failing == 2  # battery fault: voltage sag + current spike
+        voltage[m2] -= rng.uniform(30.0, 60.0, m2.sum())
+        current[m2] *= rng.uniform(1.5, 3.0, m2.sum())
+
+        cols = {
+            "coolant_temp": coolant.astype(np.float32),
+            "intake_air_temp": rng.uniform(15.0, 40.0, n).astype(np.float32),
+            "intake_air_flow_speed": np.clip(airflow, 0, None).astype(np.float32),
+            "battery_percentage": self.battery[idx].astype(np.float32),
+            "battery_voltage": voltage.astype(np.float32),
+            "current_draw": np.clip(current, 0, None).astype(np.float32),
+            "speed": speed.astype(np.float32),
+            "engine_vibration_amplitude": vibration.astype(np.float32),
+            "throttle_pos": throttle.astype(np.float32),
+            "tire_pressure_1_1": tires[:, 0].astype(np.int32),
+            "tire_pressure_1_2": tires[:, 1].astype(np.int32),
+            "tire_pressure_2_1": tires[:, 2].astype(np.int32),
+            "tire_pressure_2_2": tires[:, 3].astype(np.int32),
+            "accelerometer_1_1_value": accel[:, 0].astype(np.float32),
+            "accelerometer_1_2_value": accel[:, 1].astype(np.float32),
+            "accelerometer_2_1_value": accel[:, 2].astype(np.float32),
+            "accelerometer_2_2_value": accel[:, 3].astype(np.float32),
+            "control_unit_firmware": self.firmware[idx],
+            "car": idx,
+            "failure_occurred": np.where(lab, "true", "false"),
+        }
+        self.t += s.interval_s
+        return cols
+
+    def sensor_matrix(self, cols: dict) -> np.ndarray:
+        """[n, 18] float64 matrix in schema order (pre-normalization)."""
+        return np.stack([cols[f.name].astype(np.float64)
+                         for f in CAR_SCHEMA.fields], axis=1)
+
+    # ----------------------------------------------------------- records
+    def row_record(self, cols: dict, i: int, schema: RecordSchema) -> dict:
+        """Row i of a step's columns as a dict record in `schema`'s naming."""
+        rec = {}
+        for f_ref, f_out in zip(CAR_SCHEMA.fields, schema.sensor_fields):
+            v = cols[f_ref.name][i]
+            rec[f_out.name] = int(v) if f_out.avro_type in ("int", "long") \
+                else float(v)
+        if schema.label_field:
+            rec[schema.label_field] = str(cols["failure_occurred"][i])
+        return rec
+
+    def records(self, n_ticks: int = 1,
+                schema: RecordSchema = KSQL_CAR_SCHEMA) -> Iterator[dict]:
+        """Yield per-row dict records in the requested schema variant."""
+        for _ in range(n_ticks):
+            cols = self.step_columns()
+            for i in range(len(cols["car"])):
+                yield self.row_record(cols, i, schema)
+
+    def publish(self, broker, topic: str, n_ticks: int = 1,
+                schema: RecordSchema = KSQL_CAR_SCHEMA,
+                encoding: str = "avro",
+                framed: bool = True, partitions: int = 1) -> int:
+        """Encode and append records to a broker topic. Returns count.
+
+        encoding="avro": Confluent-framed Avro in `schema` (ML input stage).
+        encoding="json": raw JSON with producer field names + label — what
+          lands on `sensor-data` before the KSQL-equivalent convert stage.
+        """
+        import json as _json
+
+        broker.create_topic(topic, partitions=partitions)
+        codec = AvroCodec(schema)
+        native = None
+        if encoding == "avro":
+            try:
+                from ..stream.native import NativeCodec
+
+                native = NativeCodec(schema)
+            except Exception:
+                native = None
+        count = 0
+        for tick in range(n_ticks):
+            cols = self.step_columns()
+            n = len(cols["car"])
+            ts = int(self.t * 1000)
+            keys = [self.scenario.car_id(int(c)).encode() for c in cols["car"]]
+            if native is not None and schema.label_field:
+                # vectorized path: columnar floats + labels → framed Avro
+                num = self.sensor_matrix(cols)
+                labels = cols["failure_occurred"].astype("S16")[:, None]
+                msgs = native.encode_batch(num, labels,
+                                           schema_id=1 if framed else -1)
+                for i, payload in enumerate(msgs):
+                    broker.produce(topic, payload, key=keys[i],
+                                   partition=None if partitions > 1 else 0,
+                                   timestamp_ms=ts)
+                count += n
+                continue
+            for i in range(n):
+                if encoding == "json":
+                    rec = self.row_record(cols, i, CAR_SCHEMA)
+                    rec["failure_occurred"] = str(cols["failure_occurred"][i])
+                    payload = _json.dumps(rec).encode()
+                else:
+                    payload = codec.encode(self.row_record(cols, i, schema))
+                    if framed:
+                        payload = frame(payload)
+                broker.produce(topic, payload, key=keys[i],
+                               partition=None if partitions > 1 else 0,
+                               timestamp_ms=ts)
+                count += 1
+        return count

@@ -1,0 +1,3 @@
+from .autoencoder import DenseAutoencoder, CAR_AUTOENCODER, CREDITCARD_AUTOENCODER  # noqa: F401
+from .lstm import LSTMSeq2Seq  # noqa: F401
+from .mnist import MNISTClassifier, MNISTBaseline  # noqa: F401

@@ -1,0 +1,68 @@
+"""Dense anomaly-detection autoencoder (flax.linen).
+
+Architecture parity with the reference (cardata-v3.py:176-194 and the
+creditcard notebook cell 19):
+
+    input_dim → Dense(encoding_dim, tanh, L1 activity reg)
+              → Dense(hidden_dim, relu)
+              → Dense(hidden_dim, tanh)
+              → Dense(input_dim, relu)
+
+with input_dim/encoding_dim/hidden_dim = 18/14/7 (car) or 30/14/7
+(creditcard), L1 activity coefficient 1e-7, Adam lr 1e-3 (Keras default),
+loss MSE.
+
+Keras semantics preserved exactly where they affect training dynamics:
+- the *activity* regularizer penalizes the first encoder layer's output,
+  `l1 * sum(|h|) / batch_size` (tf.keras divides activity-regularizer loss
+  by the batch size to make it batch-agnostic);
+- Glorot-uniform kernel init, zero bias init (Keras Dense defaults) — flax's
+  default is lecun_normal, so we set glorot explicitly.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class DenseAutoencoder(nn.Module):
+    input_dim: int = 18
+    encoding_dim: int = 14
+    hidden_dim: int = 7
+    activity_l1: float = 1e-7
+
+    def setup(self):
+        # attribute names become the param-tree keys (encoder0/encoder1/...)
+        dense = lambda n: nn.Dense(  # noqa: E731
+            n, kernel_init=nn.initializers.glorot_uniform())
+        self.encoder0 = dense(self.encoding_dim)
+        self.encoder1 = dense(self.hidden_dim)
+        self.decoder0 = dense(self.hidden_dim)
+        self.decoder1 = dense(self.input_dim)
+
+    def __call__(self, x, with_penalty: bool = False):
+        h = nn.tanh(self.encoder0(x))
+        # Keras activity regularizer: l1 * sum(|h|) / batch  (batch = leading dim)
+        penalty = self.activity_l1 * jnp.sum(jnp.abs(h)) / x.shape[0]
+        out = nn.relu(self.decoder1(nn.tanh(self.decoder0(nn.relu(self.encoder1(h))))))
+        if with_penalty:
+            return out, penalty
+        return out
+
+    def encode(self, x):
+        """Latent code (first two layers) — for downstream embedding use.
+        Call as `model.apply({"params": p}, x, method=DenseAutoencoder.encode)`."""
+        return nn.relu(self.encoder1(nn.tanh(self.encoder0(x))))
+
+
+# The two concrete variants the reference ships.
+CAR_AUTOENCODER = DenseAutoencoder(input_dim=18)
+CREDITCARD_AUTOENCODER = DenseAutoencoder(input_dim=30)
+
+
+def reconstruction_error(model: DenseAutoencoder, params, x) -> jnp.ndarray:
+    """Per-row reconstruction MSE — the anomaly score used by the reference's
+    threshold analysis (streaming notebook cells 21-26, threshold 5)."""
+    recon = model.apply({"params": params}, x)
+    return jnp.mean(jnp.square(recon - x), axis=-1)

@@ -1,0 +1,46 @@
+"""LSTM sequence-to-sequence next-step predictor (flax.linen).
+
+Parity with the reference supervised model (LSTM cardata-v1.py:170-176):
+
+    LSTM(32, relu, return_sequences) → LSTM(16, relu, last-step)
+    → RepeatVector(look_back) → LSTM(16, relu, seq) → LSTM(32, relu, seq)
+    → TimeDistributed(Dense(features))
+
+Keras `LSTM(activation='relu')` swaps the cell's candidate/output tanh for
+relu; flax's `nn.OptimizedLSTMCell(activation_fn=...)` maps 1:1.  The
+reference trains it at batch=1, look_back=1 — pathological for any
+accelerator — so the TPU design keeps semantic parity (same architecture,
+same next-step objective) while batching windows [B, T, F] produced by the
+host-side windower (`data.SensorBatches(window=T)`), and `lax`-scanned cells
+keep the step compilable at any T.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LSTMSeq2Seq(nn.Module):
+    features: int = 18
+    look_back: int = 1
+    enc_units: tuple = (32, 16)
+    dec_units: tuple = (16, 32)
+
+    def _rnn(self, units, name):
+        return nn.RNN(nn.OptimizedLSTMCell(units, activation_fn=nn.relu),
+                      name=name)
+
+    @nn.compact
+    def __call__(self, x):
+        """x: [B, T, F] → [B, look_back, F] next-step prediction."""
+        h = x
+        for i, u in enumerate(self.enc_units):
+            h = self._rnn(u, f"enc{i}")(h)
+        code = h[:, -1, :]  # Keras return_sequences=False → last step
+        h = jnp.repeat(code[:, None, :], self.look_back, axis=1)  # RepeatVector
+        for i, u in enumerate(self.dec_units):
+            h = self._rnn(u, f"dec{i}")(h)
+        # TimeDistributed(Dense(features)): one Dense applied per step.
+        return nn.Dense(self.features, name="head",
+                        kernel_init=nn.initializers.glorot_uniform())(h)

@@ -1,0 +1,38 @@
+"""MNIST smoke-test models (flax.linen).
+
+The reference uses an MNIST-over-Kafka pair as the ingestion smoke test
+(confluent-tensorflow-io-kafka.py:44-58) plus a no-Kafka control
+(confluent-tensorflow-io-kafka-simplified.py:9-29) to isolate ingestion bugs
+from model bugs.  Same two models here, for the same purpose against the
+broker emulator.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+
+class MNISTClassifier(nn.Module):
+    """Flatten → Dense(128, relu) → Dense(10) (softmax applied in loss)."""
+
+    hidden: int = 128
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1)) / 255.0
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        return nn.Dense(10)(x)  # logits
+
+
+class MNISTBaseline(nn.Module):
+    """Flatten → Dense(512, relu) → Dropout(0.2) → Dense(10) (control model)."""
+
+    hidden: int = 512
+    dropout: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1)) / 255.0
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(10)(x)

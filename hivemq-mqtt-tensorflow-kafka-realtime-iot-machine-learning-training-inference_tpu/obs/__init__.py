@@ -1,0 +1,3 @@
+from .metrics import (Registry, Counter, Gauge, Histogram, default_registry,  # noqa: F401
+                      start_http_server)
+from .tb import ScalarLogger, JaxProfiler  # noqa: F401

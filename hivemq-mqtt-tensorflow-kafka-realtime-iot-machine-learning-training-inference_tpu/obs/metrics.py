@@ -1,0 +1,181 @@
+"""Metrics registry with Prometheus text exposition.
+
+The reference observes everything through Prometheus + Grafana (operator
+installed first thing, `01_installConfluentPlatform.sh:12-15`; simulator and
+broker export families like `agent_publish_*`, `kafka_extension_*` — SURVEY
+§5).  The framework-native equivalent: every component registers counters/
+gauges/histograms here, and `render()` emits Prometheus text format, served
+by `start_http_server` for scrape parity with the reference's dashboards.
+
+Standard metric families the framework emits (see `default_registry`):
+  iotml_records_consumed_total      stream records decoded
+  iotml_records_trained_total       records through the train step
+  iotml_records_scored_total        records through the scorer
+  iotml_train_step_seconds          train-step latency histogram
+  iotml_reconstruction_mse          last reconstruction error gauge
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+
+def _fmt_labels(labels: Optional[dict]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = ""):
+        self.name, self.help = name, help_
+        self._vals: Dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels):
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._vals[key] = self._vals.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._vals.get(tuple(sorted(labels.items())), 0.0)
+
+    def render(self) -> str:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:  # scrapes race with inc() from worker threads
+            vals = dict(self._vals)
+        for key, v in sorted(vals.items()):
+            out.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
+        if not vals:
+            out.append(f"{self.name} 0")
+        return "\n".join(out)
+
+
+class Gauge(Counter):
+    def set(self, value: float, **labels):
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._vals[key] = float(value)
+
+    def render(self) -> str:
+        return super().render().replace(" counter", " gauge", 1)
+
+
+class Histogram:
+    """Fixed-bucket histogram (Prometheus cumulative-bucket convention)."""
+
+    DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0)
+
+    def __init__(self, name: str, help_: str = "",
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self.name, self.help = name, help_
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float):
+        with self._lock:
+            self._sum += value
+            self._n += 1
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def time(self):
+        """Context manager: observe elapsed seconds."""
+        hist = self
+
+        class _T:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                hist.observe(time.perf_counter() - self.t0)
+
+        return _T()
+
+    def render(self) -> str:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:  # consistent bucket/sum/count snapshot under load
+            counts = list(self._counts)
+            total_sum, total_n = self._sum, self._n
+        cum = 0
+        for b, c in zip(self.buckets, counts):
+            cum += c
+            out.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
+        cum += counts[-1]
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+        out.append(f"{self.name}_sum {total_sum}")
+        out.append(f"{self.name}_count {total_n}")
+        return "\n".join(out)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get(name, lambda: Counter(name, help_))
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get(name, lambda: Gauge(name, help_))
+
+    def histogram(self, name: str, help_: str = "", **kw) -> Histogram:
+        return self._get(name, lambda: Histogram(name, help_, **kw))
+
+    def _get(self, name, factory):
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = factory()
+            return self._metrics[name]
+
+    def render(self) -> str:
+        return "\n".join(m.render() for _, m in sorted(self._metrics.items())) + "\n"
+
+
+default_registry = Registry()
+records_consumed = default_registry.counter(
+    "iotml_records_consumed_total", "stream records decoded")
+records_trained = default_registry.counter(
+    "iotml_records_trained_total", "records through the train step")
+records_scored = default_registry.counter(
+    "iotml_records_scored_total", "records through the scorer")
+train_step_seconds = default_registry.histogram(
+    "iotml_train_step_seconds", "train-step latency")
+reconstruction_mse = default_registry.gauge(
+    "iotml_reconstruction_mse", "last mean reconstruction error")
+
+
+def start_http_server(port: int = 9100, registry: Registry = default_registry):
+    """Serve /metrics in Prometheus text format (daemon thread)."""
+    import http.server
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path != "/metrics":
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = registry.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv

@@ -1,0 +1,2 @@
+from .avro import AvroCodec  # noqa: F401
+from .framing import frame, unframe, SCHEMA_ID_DEFAULT  # noqa: F401

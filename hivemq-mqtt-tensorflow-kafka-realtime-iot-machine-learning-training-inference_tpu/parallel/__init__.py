@@ -1,0 +1,2 @@
+from .mesh import make_mesh, auto_mesh, batch_sharding, replicated  # noqa: F401
+from .data_parallel import ShardedTrainer, shard_params, param_specs, make_sharded_eval_step  # noqa: F401

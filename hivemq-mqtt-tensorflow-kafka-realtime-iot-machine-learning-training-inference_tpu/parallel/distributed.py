@@ -1,0 +1,52 @@
+"""Multi-host initialization and partition→host assignment.
+
+The reference's distributed backbone is Kafka over the datacenter network
+plus Kubernetes as control plane — no NCCL/MPI (SURVEY §2.7).  The TPU
+rebuild splits the two planes explicitly:
+
+- DCN side: each host runs its own stream consumers for an assigned subset
+  of topic partitions (`assign_partitions`), exactly the consumer-group
+  model the reference used between pods;
+- ICI side: `jax.distributed.initialize` joins the hosts into one JAX
+  process group, and the mesh's collectives (gradient all-reduce etc.)
+  compile over ICI within a pod slice, DCN across slices — XLA picks the
+  transport per axis, we just lay shardings out so the heavy traffic stays
+  on the 'data' axis inside the slice.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import jax
+
+
+def initialize(coordinator: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None):
+    """Join the multi-host process group. No-op single-host (the common
+    test/dev case), env-driven on TPU pods where the runtime injects
+    topology (jax.distributed reads it natively)."""
+    if num_processes in (None, 1) and not coordinator and \
+            "JAX_COORDINATOR" not in os.environ:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator or os.environ.get("JAX_COORDINATOR"),
+        num_processes=num_processes,
+        process_id=process_id)
+    return True
+
+
+def assign_partitions(n_partitions: int, n_hosts: int, host_id: int) -> List[int]:
+    """Static partition→host assignment (round-robin), the multi-host analogue
+    of the reference's Kafka consumer-group balancing — but deterministic, so
+    offset checkpoints stay host-stable across restarts."""
+    if not 0 <= host_id < n_hosts:
+        raise ValueError(f"host_id {host_id} out of range 0..{n_hosts - 1}")
+    return [p for p in range(n_partitions) if p % n_hosts == host_id]
+
+
+def consumer_specs(topic: str, partitions: List[int], offset: int = 0) -> List[str]:
+    """Subscription specs for this host's partitions (reference spec format)."""
+    return [f"{topic}:{p}:{offset}" for p in partitions]

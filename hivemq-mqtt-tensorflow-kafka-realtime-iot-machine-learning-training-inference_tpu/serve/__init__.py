@@ -1,0 +1,1 @@
+from .scorer import StreamScorer, format_prediction  # noqa: F401

@@ -1,0 +1,147 @@
+"""In-process broker emulator — the framework's test/dev data backbone.
+
+The reference's data plane is a full Confluent deployment (3 brokers, topics
+`sensor-data` / `model-predictions` with 10 partitions, RF 3 — reference
+`01_installConfluentPlatform.sh:180-183`), and its offline test story is a
+FileStreamSource connector replaying a CSV into a topic (reference
+`testdata/Test-Load-csv/`).  This module provides the equivalent in-process:
+a partitioned, offset-addressed append-only log with consumer-group offset
+storage and optional size/retention bounds, so every pipeline in the
+framework — train, score, streamproc, generator — runs unchanged against it.
+
+The same `Broker` duck-type is what the native (C++) engine and a real
+librdkafka-backed client expose, so swapping the emulator for a real cluster
+is a constructor change, not a code path change.
+
+Threading: one lock guards all mutation (topic metadata, appends, retention
+trims) and `fetch` — producers and background prefetch threads interleave
+freely.  This is the correctness-first emulator; the native C++ engine owns
+the lock-free hot path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import zlib
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Message:
+    """One record as fetched from a partition log."""
+
+    topic: str
+    partition: int
+    offset: int
+    value: bytes
+    key: Optional[bytes] = None
+    timestamp_ms: int = 0
+
+
+@dataclasses.dataclass
+class TopicSpec:
+    name: str
+    partitions: int = 1
+    # retention by message count (the reference uses retention.ms=100000 —
+    # time-based; count-based is the deterministic test-friendly analogue).
+    retention_messages: Optional[int] = None
+
+
+class _Partition:
+    __slots__ = ("log", "base_offset")
+
+    def __init__(self):
+        self.log: List[tuple] = []  # (key, value, ts)
+        self.base_offset = 0  # offset of log[0] after retention trimming
+
+
+class Broker:
+    """Partitioned in-memory commit log with Kafka-shaped semantics."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._topics: Dict[str, TopicSpec] = {}
+        self._parts: Dict[str, List[_Partition]] = {}
+        self._group_offsets: Dict[tuple, int] = {}  # (group, topic, part) → next offset
+        self._rr: Dict[str, int] = {}  # round-robin cursor per topic
+
+    # ------------------------------------------------------------- topics
+    def create_topic(self, name: str, partitions: int = 1,
+                     retention_messages: Optional[int] = None) -> TopicSpec:
+        with self._lock:
+            if name in self._topics:
+                return self._topics[name]
+            spec = TopicSpec(name, partitions, retention_messages)
+            self._topics[name] = spec
+            self._parts[name] = [_Partition() for _ in range(partitions)]
+            self._rr[name] = 0
+            return spec
+
+    def topics(self) -> List[str]:
+        with self._lock:
+            return sorted(self._topics)
+
+    def topic(self, name: str) -> TopicSpec:
+        return self._topics[name]
+
+    def _partition_for(self, topic: str, key: Optional[bytes]) -> int:
+        n = self._topics[topic].partitions
+        if key is None:
+            self._rr[topic] = (self._rr[topic] + 1) % n
+            return self._rr[topic]
+        # stable keyed partitioning (murmur-free but deterministic)
+        return zlib.crc32(key) % n
+
+    # ------------------------------------------------------------ produce
+    def produce(self, topic: str, value: bytes, key: Optional[bytes] = None,
+                partition: Optional[int] = None, timestamp_ms: int = 0) -> int:
+        """Append one record; returns its offset. Auto-creates 1-partition
+        topics (matching Kafka's auto.create default used by the reference's
+        local demos)."""
+        if topic not in self._topics:
+            self.create_topic(topic)
+        with self._lock:
+            p = self._partition_for(topic, key) if partition is None else partition
+            part = self._parts[topic][p]
+            part.log.append((key, value, timestamp_ms))
+            off = part.base_offset + len(part.log) - 1
+            spec = self._topics[topic]
+            if spec.retention_messages and len(part.log) > spec.retention_messages:
+                drop = len(part.log) - spec.retention_messages
+                del part.log[:drop]
+                part.base_offset += drop
+            return off
+
+    def produce_batch(self, topic: str, values, key=None, partition=None) -> int:
+        """Append many records; returns the offset of the last one."""
+        off = -1
+        for v in values:
+            off = self.produce(topic, v, key=key, partition=partition)
+        return off
+
+    # -------------------------------------------------------------- fetch
+    def end_offset(self, topic: str, partition: int = 0) -> int:
+        part = self._parts[topic][partition]
+        return part.base_offset + len(part.log)
+
+    def begin_offset(self, topic: str, partition: int = 0) -> int:
+        return self._parts[topic][partition].base_offset
+
+    def fetch(self, topic: str, partition: int, offset: int,
+              max_messages: int = 1024) -> List[Message]:
+        """Read up to max_messages starting at offset (monotone, no blocking)."""
+        part = self._parts[topic][partition]
+        with self._lock:
+            start = max(offset, part.base_offset)
+            idx = start - part.base_offset
+            chunk = part.log[idx:idx + max_messages]
+        return [Message(topic, partition, start + i, value, key, ts)
+                for i, (key, value, ts) in enumerate(chunk)]
+
+    # ------------------------------------------------- consumer-group API
+    def commit(self, group: str, topic: str, partition: int, next_offset: int):
+        self._group_offsets[(group, topic, partition)] = next_offset
+
+    def committed(self, group: str, topic: str, partition: int) -> Optional[int]:
+        return self._group_offsets.get((group, topic, partition))

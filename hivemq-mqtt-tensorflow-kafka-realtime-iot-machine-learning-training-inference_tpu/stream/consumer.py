@@ -1,0 +1,119 @@
+"""Offset-cursored stream consumer — the KafkaDataset equivalent.
+
+The reference consumes with ``kafka_io.KafkaDataset(["topic:partition:offset"],
+group=..., eof=True)`` (cardata-v3.py:46-47): an absolute-offset cursor over
+one partition, EOF when the log end is reached, re-readable from the same
+offset every epoch (the reference re-reads the topic per epoch,
+python-scripts/README.md:114-117).
+
+`StreamConsumer` reproduces those semantics over any broker duck-type
+(emulator or native engine) and adds what the reference lacked: explicit
+multi-partition specs, committed-offset resume, and a `seek` for epoch
+re-reads without reconstructing the pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from .broker import Broker, Message
+
+
+def parse_spec(spec: str) -> tuple:
+    """Parse the reference's "topic:partition:offset" subscription string."""
+    parts = spec.split(":")
+    if len(parts) == 1:
+        return parts[0], 0, 0
+    if len(parts) == 2:
+        return parts[0], int(parts[1]), 0
+    return parts[0], int(parts[1]), int(parts[2])
+
+
+class StreamConsumer:
+    """Cursor over one or more (topic, partition) logs.
+
+    Args:
+      broker: broker duck-type (`fetch`, `end_offset`, `commit`, `committed`).
+      specs: "topic:partition:offset" strings (reference subscription format).
+      group: consumer-group id for offset commits.
+      eof: if True, `poll` returns [] once all cursors hit the log end
+           (reference eof=True batch-mode); if False, callers may poll again
+           as data arrives (continuous scoring mode).
+    """
+
+    def __init__(self, broker: Broker, specs: Sequence[str],
+                 group: str = "iotml", eof: bool = True):
+        self.broker = broker
+        self.group = group
+        self.eof = eof
+        self._cursors = []  # [topic, partition, next_offset]
+        for s in specs:
+            t, p, o = parse_spec(s)
+            self._cursors.append([t, p, o])
+        self._start = [c[2] for c in self._cursors]
+        self._rr = 0
+
+    @classmethod
+    def from_committed(cls, broker: Broker, topic: str, partitions: Sequence[int],
+                       group: str, fallback_offset: int = 0, **kw):
+        """Resume from committed group offsets (cursor-checkpoint restart)."""
+        specs = []
+        for p in partitions:
+            off = broker.committed(group, topic, p)
+            specs.append(f"{topic}:{p}:{off if off is not None else fallback_offset}")
+        return cls(broker, specs, group=group, **kw)
+
+    # --------------------------------------------------------------- read
+    def poll(self, max_messages: int = 1024) -> List[Message]:
+        """Fetch up to max_messages across cursors (round-robin between
+        partitions so one hot partition cannot starve the rest)."""
+        out: List[Message] = []
+        n = len(self._cursors)
+        attempts = 0
+        while len(out) < max_messages and attempts < n:
+            cur = self._cursors[self._rr % n]
+            self._rr += 1
+            attempts += 1
+            topic, part, off = cur
+            batch = self.broker.fetch(topic, part, off, max_messages - len(out))
+            if batch:
+                cur[2] = batch[-1].offset + 1
+                out.extend(batch)
+                attempts = 0  # progress was made; give others another chance
+        return out
+
+    def at_end(self) -> bool:
+        return all(off >= self.broker.end_offset(t, p)
+                   for t, p, off in self._cursors)
+
+    def __iter__(self):
+        """Iterate to EOF (reference eof=True semantics)."""
+        while True:
+            batch = self.poll()
+            if not batch:
+                if self.eof or self.at_end():
+                    return
+            yield from batch
+
+    # ------------------------------------------------------------- cursor
+    def seek_to_start(self):
+        """Rewind to the construction offsets (per-epoch stream re-read)."""
+        for cur, off in zip(self._cursors, self._start):
+            cur[2] = off
+
+    def seek(self, topic: str, partition: int, offset: int):
+        for cur in self._cursors:
+            if cur[0] == topic and cur[1] == partition:
+                cur[2] = offset
+                return
+        raise KeyError((topic, partition))
+
+    def positions(self) -> List[tuple]:
+        """Current (topic, partition, next_offset) cursor state — this tuple
+        is the stream-side resume checkpoint (SURVEY §5 'offset is the resume
+        cursor')."""
+        return [tuple(c) for c in self._cursors]
+
+    def commit(self):
+        for t, p, off in self._cursors:
+            self.broker.commit(self.group, t, p, off)

@@ -1,0 +1,143 @@
+"""ctypes bindings for the C++ stream engine (cpp/avro_engine.cc).
+
+The engine is the perf twin of `ops.avro.AvroCodec`: one call decodes a
+whole poll's worth of Confluent-framed Avro messages into columnar numpy
+buffers (and encodes the other way).  Python stays the source of truth for
+correctness (the pure codec is the test oracle; `tests/test_native.py`
+cross-checks byte-for-byte); the engine is used automatically by the data
+path when the shared library is present.
+
+Build lazily on first use (`make -C iotml/cpp`, no external deps, <1s) and
+fall back silently to the pure-Python codec when no toolchain exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..core.schema import RecordSchema
+
+_TYPE_CODE = {"float": 0, "double": 1, "int": 2, "long": 3, "string": 4,
+              "boolean": 5}
+LABEL_STRIDE = 16  # fits "true"/"false"/"" labels with headroom
+
+_CPP_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "cpp")
+_SO_PATH = os.path.join(_CPP_DIR, "build", "libiotml_stream.so")
+
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _CPP_DIR], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_SO_PATH)
+    except Exception:
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The engine library, building it on first call; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_SO_PATH) and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.iotml_decode_batch.restype = ctypes.c_int64
+        lib.iotml_encode_batch.restype = ctypes.c_int64
+        lib.iotml_engine_version.restype = ctypes.c_int64
+        _lib = lib
+    except OSError:
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+class NativeCodec:
+    """Schema-compiled batch codec over the C++ engine."""
+
+    def __init__(self, schema: RecordSchema):
+        self.schema = schema
+        self.types = np.array([_TYPE_CODE[f.avro_type] for f in schema.fields],
+                              np.int8)
+        self.nullable = np.array([1 if f.nullable else 0 for f in schema.fields],
+                                 np.uint8)
+        self.n_fields = len(schema.fields)
+        self.n_strings = int((self.types == 4).sum())
+        self.n_numeric = self.n_fields - self.n_strings
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native stream engine unavailable")
+
+    # ------------------------------------------------------------- decode
+    def decode_batch(self, messages: List[bytes], strip: int = 0
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+        """→ (numeric [n, n_numeric] float64, labels [n, n_strings] '<U15').
+
+        Numeric columns are the schema's non-string fields in order — for
+        the car schemas that is exactly the 18-sensor matrix.
+        """
+        n = len(messages)
+        if n == 0:
+            return (np.zeros((0, self.n_numeric)),
+                    np.zeros((0, self.n_strings), f"S{LABEL_STRIDE}"))
+        blob = b"".join(messages)
+        offsets = np.zeros((n + 1,), np.int64)
+        np.cumsum([len(m) for m in messages], out=offsets[1:])
+        numeric = np.empty((n, self.n_numeric), np.float64)
+        labels = np.zeros((n, max(self.n_strings, 1)), f"S{LABEL_STRIDE}")
+        rc = self._lib.iotml_decode_batch(
+            ctypes.c_char_p(blob),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.c_int64(n),
+            self.types.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+            self.nullable.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.c_int64(self.n_fields),
+            ctypes.c_int64(strip),
+            numeric.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            labels.ctypes.data_as(ctypes.c_char_p),
+            ctypes.c_int64(LABEL_STRIDE))
+        if rc != n:
+            raise ValueError(f"malformed Avro message at row {-rc - 1}")
+        return numeric, labels[:, : self.n_strings]
+
+    # ------------------------------------------------------------- encode
+    def encode_batch(self, numeric: np.ndarray, labels: Optional[np.ndarray],
+                     schema_id: int = -1) -> List[bytes]:
+        """Columnar rows → list of (optionally framed) Avro messages."""
+        numeric = np.ascontiguousarray(numeric, np.float64)
+        n = numeric.shape[0]
+        if labels is None:
+            labels = np.zeros((n, self.n_strings), f"S{LABEL_STRIDE}")
+        labels = np.ascontiguousarray(labels.astype(f"S{LABEL_STRIDE}"))
+        cap = n * (5 + self.n_fields * 20 + self.n_strings * LABEL_STRIDE) + 64
+        out = np.empty((cap,), np.uint8)
+        offsets = np.zeros((n + 1,), np.int64)
+        total = self._lib.iotml_encode_batch(
+            numeric.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            labels.ctypes.data_as(ctypes.c_char_p),
+            ctypes.c_int64(LABEL_STRIDE),
+            ctypes.c_int64(n),
+            self.types.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+            self.nullable.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.c_int64(self.n_fields),
+            ctypes.c_int64(schema_id),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.c_int64(cap),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if total < 0:
+            raise ValueError("encode buffer overflow")
+        raw = out.tobytes()
+        return [raw[offsets[i]:offsets[i + 1]] for i in range(n)]

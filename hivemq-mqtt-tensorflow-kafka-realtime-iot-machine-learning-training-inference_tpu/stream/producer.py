@@ -1,0 +1,58 @@
+"""Ordered, indexed producer — the KafkaOutputSequence equivalent.
+
+The reference writes predictions back with ``kafka_io.KafkaOutputSequence``
+(cardata-v3.py:238-252): results are assigned an absolute *index* as batches
+complete, and ``flush()`` publishes them in index order, so the output topic
+preserves input-stream order even when batches finish out of order.  That
+ordering contract is what lets downstream consumers join predictions back to
+source offsets, so we keep it exactly: ``setitem(index, message)`` + ordered
+``flush()``, with gap detection instead of silent misalignment.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .broker import Broker
+
+
+class OutputSequence:
+    """Buffer of (index → message) flushed to a topic in index order."""
+
+    def __init__(self, broker: Broker, topic: str,
+                 partition: Optional[int] = None):
+        self.broker = broker
+        self.topic = topic
+        self.partition = partition
+        self._buf: Dict[int, bytes] = {}
+
+    def setitem(self, index: int, message):
+        if isinstance(message, str):
+            message = message.encode()
+        if index in self._buf:
+            raise ValueError(f"duplicate output index {index}")
+        self._buf[index] = message
+
+    def __setitem__(self, index: int, message):
+        self.setitem(index, message)
+
+    def flush(self, allow_gaps: bool = False) -> int:
+        """Publish buffered messages in ascending index order.
+
+        Returns the number of messages flushed.  With allow_gaps=False
+        (default) a missing index raises — an out-of-order scorer bug should
+        fail loudly, not ship misaligned predictions.
+        """
+        if not self._buf:
+            return 0
+        idxs = sorted(self._buf)
+        if not allow_gaps:
+            lo, hi = idxs[0], idxs[-1]
+            if hi - lo + 1 != len(idxs):
+                missing = set(range(lo, hi + 1)) - set(idxs)
+                raise ValueError(f"output sequence has gaps at {sorted(missing)[:8]}...")
+        for i in idxs:
+            self.broker.produce(self.topic, self._buf[i], partition=self.partition)
+        n = len(idxs)
+        self._buf.clear()
+        return n

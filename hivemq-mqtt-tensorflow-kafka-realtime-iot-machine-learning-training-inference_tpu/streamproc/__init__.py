@@ -1,0 +1,1 @@
+from .tasks import JsonToAvro, RekeyByCar, TumblingCounter, StreamTask  # noqa: F401

@@ -1,0 +1,155 @@
+"""KSQL-equivalent continuous stream transforms.
+
+The reference preprocesses broker-side with four KSQL objects
+(`01_installConfluentPlatform.sh:229-258`, SURVEY §2.3):
+
+  SENSOR_DATA_S                JSON stream over `sensor-data` (19 columns)
+  SENSOR_DATA_S_AVRO           CSAS: JSON → AVRO (the ML input topic)
+  SENSOR_DATA_S_AVRO_REKEY     CSAS: re-key by CAR (ROWKEY → partition key)
+  SENSOR_DATA_EVENTS_PER_5MIN_T CTAS: tumbling 5-min event count per car
+
+Here each is a `StreamTask`: an offset-cursored consumer plus a pure
+`process(messages) → [(key, value, ts)]` step appended to an output topic.
+Tasks are incremental (`process_available()`) so tests and the demo driver
+can interleave them with producers, and restartable via consumer commits —
+the same continuous-query semantics KSQL provides, in-process.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+from ..core.schema import CAR_SCHEMA, KSQL_CAR_SCHEMA
+from ..ops.avro import AvroCodec
+from ..ops.framing import frame
+from ..stream.broker import Broker, Message
+from ..stream.consumer import StreamConsumer
+
+
+class StreamTask:
+    """Continuous transform: src topic → process() → dst topic."""
+
+    def __init__(self, broker: Broker, src: str, dst: str,
+                 partitions: int = 1, group: Optional[str] = None,
+                 src_partitions: Optional[int] = None):
+        self.broker = broker
+        self.src = src
+        self.dst = dst
+        broker.create_topic(dst, partitions=partitions)
+        n_src = src_partitions if src_partitions is not None \
+            else broker.topic(src).partitions
+        # resume from committed group offsets so a restarted task does not
+        # re-emit already-transformed records (KSQL's continuous-query
+        # restart semantics)
+        self.consumer = StreamConsumer.from_committed(
+            broker, src, list(range(n_src)),
+            group=group or f"task-{dst}", fallback_offset=0, eof=True)
+
+    def process(self, messages: List[Message]) -> List[Tuple]:
+        """Return [(key, value, timestamp_ms)] outputs."""
+        raise NotImplementedError
+
+    def process_available(self, chunk: int = 4096) -> int:
+        """Consume and transform everything currently available."""
+        n = 0
+        while True:
+            msgs = self.consumer.poll(chunk)
+            if not msgs:
+                self.consumer.commit()
+                return n
+            for key, value, ts in self.process(msgs):
+                self.broker.produce(self.dst, value, key=key, timestamp_ms=ts)
+                n += 1
+
+
+class JsonToAvro(StreamTask):
+    """SENSOR_DATA_S_AVRO: JSON sensor records → Confluent-framed Avro.
+
+    Field matching is case-insensitive and accepts both producer names
+    (`tire_pressure_1_1`) and KSQL names (`TIRE_PRESSURE11`), mirroring
+    KSQL's case-insensitive column resolution.
+    """
+
+    def __init__(self, broker: Broker, src: str = "sensor-data",
+                 dst: str = "SENSOR_DATA_S_AVRO", **kw):
+        super().__init__(broker, src, dst, **kw)
+        self.codec = AvroCodec(KSQL_CAR_SCHEMA)
+        # lookup: lowercase alias → KSQL field name
+        self._alias: Dict[str, str] = {}
+        for f_prod, f_ksql in zip(CAR_SCHEMA.fields, KSQL_CAR_SCHEMA.sensor_fields):
+            self._alias[f_prod.name.lower()] = f_ksql.name
+            self._alias[f_ksql.name.lower()] = f_ksql.name
+        self._alias["failure_occurred"] = "FAILURE_OCCURRED"
+
+    def process(self, messages):
+        out = []
+        for m in messages:
+            obj = json.loads(m.value)
+            rec = {}
+            for k, v in obj.items():
+                name = self._alias.get(k.lower())
+                if name is None:
+                    continue
+                f = KSQL_CAR_SCHEMA.field(name)
+                if v is None:
+                    rec[name] = None
+                elif f.avro_type in ("int", "long"):
+                    rec[name] = int(v)
+                elif f.avro_type == "string":
+                    rec[name] = str(v)
+                else:
+                    rec[name] = float(v)
+            out.append((m.key, frame(self.codec.encode(rec)), m.timestamp_ms))
+        return out
+
+
+class RekeyByCar(StreamTask):
+    """SENSOR_DATA_S_AVRO_REKEY: partition the stream by car id.
+
+    The reference's `SELECT ROWKEY as CAR, * ... PARTITION BY CAR`: the MQTT
+    client id rides as the message key, so re-keying is routing every record
+    to the key-hashed partition of the output topic (keyed partitioning in
+    `Broker.produce`), giving per-car ordering — the property sequence models
+    need.
+    """
+
+    def process(self, messages):
+        return [(m.key, m.value, m.timestamp_ms) for m in messages]
+
+
+class TumblingCounter(StreamTask):
+    """SENSOR_DATA_EVENTS_PER_5MIN_T: tumbling-window event count per car.
+
+    Counts land in output as JSON {"CAR", "WINDOW_START_MS", "EVENT_COUNT"}.
+    Like KSQL tables, counts for a window are emitted as updates: every
+    `process_available()` round emits the current count for windows touched
+    in that round (KSQL's continuous refinement), so the latest record per
+    (car, window) key is the table value.
+    """
+
+    def __init__(self, broker: Broker, src: str = "SENSOR_DATA_S_AVRO_REKEY",
+                 dst: str = "SENSOR_DATA_EVENTS_PER_5MIN_T",
+                 window_ms: int = 5 * 60 * 1000, **kw):
+        super().__init__(broker, src, dst, **kw)
+        self.window_ms = window_ms
+        self.counts: Dict[tuple, int] = {}
+
+    def process(self, messages):
+        touched = set()
+        for m in messages:
+            car = (m.key or b"").decode() or "unknown"
+            win = (m.timestamp_ms // self.window_ms) * self.window_ms
+            k = (car, win)
+            self.counts[k] = self.counts.get(k, 0) + 1
+            touched.add(k)
+        out = []
+        for car, win in sorted(touched):
+            payload = json.dumps({"CAR": car, "WINDOW_START_MS": win,
+                                  "EVENT_COUNT": self.counts[(car, win)]}).encode()
+            out.append((car.encode(), payload, win))
+        return out
+
+    def table(self) -> Dict[tuple, int]:
+        """Materialized view of (car, window_start_ms) → count."""
+        return dict(self.counts)

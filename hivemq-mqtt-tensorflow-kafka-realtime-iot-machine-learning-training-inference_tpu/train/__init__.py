@@ -1,0 +1,3 @@
+from .loop import Trainer, TrainState, make_train_step, make_eval_step  # noqa: F401
+from .checkpoint import CheckpointManager  # noqa: F401
+from .artifacts import ArtifactStore  # noqa: F401

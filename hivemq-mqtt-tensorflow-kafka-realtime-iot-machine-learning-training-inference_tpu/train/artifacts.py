@@ -1,0 +1,75 @@
+"""Model artifact store — the GCS-bucket train→predict handoff.
+
+The reference uploads the saved model to a GCS bucket after training and the
+predict deployment downloads it fresh on start (cardata-v3.py:229-232,
+:255-261; bucket provisioned by terraform main.tf:121-125).  `ArtifactStore`
+abstracts that handoff: a local-directory backend (default, also the test
+backend) and an optional GCS backend when `google-cloud-storage` is
+installed.  Objects are opaque blobs keyed by name, so both orbax checkpoint
+dirs (zipped) and h5 files move through the same interface.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+
+class ArtifactStore:
+    """upload/download blobs by name; scheme chosen from the root URI."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self._gcs = root.startswith("gs://")
+        if self._gcs:
+            from google.cloud import storage  # optional dep
+
+            bucket_name, _, self._prefix = root[5:].partition("/")
+            self._bucket = storage.Client().get_bucket(bucket_name)
+        else:
+            os.makedirs(root, exist_ok=True)
+
+    # ------------------------------------------------------------ blobs
+    def upload(self, local_path: str, name: str) -> str:
+        if self._gcs:
+            blob = self._bucket.blob(os.path.join(self._prefix, name))
+            blob.upload_from_filename(local_path)
+            return f"{self.root}/{name}"
+        dst = os.path.join(self.root, name)
+        os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+        shutil.copy2(local_path, dst)
+        return dst
+
+    def download(self, name: str, local_path: str) -> str:
+        if self._gcs:
+            blob = self._bucket.blob(os.path.join(self._prefix, name))
+            blob.download_to_filename(local_path)
+            return local_path
+        src = os.path.join(self.root, name)
+        os.makedirs(os.path.dirname(os.path.abspath(local_path)), exist_ok=True)
+        shutil.copy2(src, local_path)
+        return local_path
+
+    def exists(self, name: str) -> bool:
+        if self._gcs:
+            return self._bucket.blob(os.path.join(self._prefix, name)).exists()
+        return os.path.exists(os.path.join(self.root, name))
+
+    # ------------------------------------------------- checkpoint trees
+    def upload_tree(self, local_dir: str, name: str) -> str:
+        """Ship a directory (e.g. an orbax step dir) as a zip blob."""
+        tmp = shutil.make_archive(os.path.join("/tmp", f"iotml_{name}"),
+                                  "zip", local_dir)
+        try:
+            return self.upload(tmp, f"{name}.zip")
+        finally:
+            os.unlink(tmp)
+
+    def download_tree(self, name: str, local_dir: str) -> str:
+        tmp = os.path.join("/tmp", f"iotml_dl_{name}.zip")
+        self.download(f"{name}.zip", tmp)
+        try:
+            shutil.unpack_archive(tmp, local_dir, "zip")
+        finally:
+            os.unlink(tmp)
+        return local_dir

@@ -1,0 +1,58 @@
+"""Checkpoint/resume: orbax model state + explicit stream cursors.
+
+The reference's resume story (SURVEY §5) is two-part: the model moves as a
+Keras h5 blob through GCS (cardata-v3.py:227-232, :255-261), and the *data
+position* is the Kafka offset, passed as an absolute CLI argument.  Here both
+halves live in one orbax checkpoint: params/opt-state/step plus the
+`(topic, partition, next_offset)` cursor list from
+`StreamConsumer.positions()`, so a restarted trainer resumes both model and
+stream exactly where it stopped.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    """Thin orbax wrapper: save/restore (state pytree, cursors)."""
+
+    def __init__(self, directory: str):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._ckpt = ocp.PyTreeCheckpointer()
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step:010d}")
+
+    def save(self, state, cursors=None, step: Optional[int] = None):
+        step = int(state.step) if step is None else step
+        payload = {
+            "params": jax.device_get(state.params),
+            "opt_state": jax.device_get(state.opt_state),
+            "step": np.asarray(int(state.step)),
+            "cursors": [list(c) for c in (cursors or [])],
+        }
+        self._ckpt.save(self._path(step), payload, force=True)
+        return self._path(step)
+
+    def latest_step(self) -> Optional[int]:
+        steps = []
+        for name in os.listdir(self.directory):
+            if name.startswith("step_"):
+                steps.append(int(name.split("_")[1]))
+        return max(steps) if steps else None
+
+    def restore(self, step: Optional[int] = None) -> Optional[dict]:
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        payload = self._ckpt.restore(self._path(step))
+        payload["cursors"] = [tuple([c[0], int(c[1]), int(c[2])])
+                              for c in payload.get("cursors", [])]
+        return payload

@@ -1,0 +1,245 @@
+"""jit-compiled micro-batch streaming training.
+
+The reference trains with `autoencoder.fit(dataset, epochs=20)` over a
+batched Kafka stream (cardata-v3.py:220-222): micro-batch streaming
+ingestion, *not* online learning (reference README.md:130-140) — every epoch
+re-reads the topic from the start offset.
+
+TPU-first translation:
+- one `jax.jit` train step, donated state, fixed [B, F] shapes (padded tails
+  carry a validity mask so the step never recompiles);
+- loss = masked MSE + Keras activity-regularizer penalty (models/autoencoder);
+- the Keras `accuracy` metric quirk (elementwise equality on a regression —
+  what `metrics=['accuracy']` resolves to under MSE loss) is reproduced so
+  history dicts match the reference logs' shape;
+- epochs iterate the *stream* via `SensorBatches.epochs`, preserving the
+  re-read-from-offset semantics.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from flax.core import FrozenDict
+
+from ..obs import metrics as obs_metrics
+
+
+@struct.dataclass
+class TrainState:
+    step: jnp.ndarray
+    params: FrozenDict
+    opt_state: optax.OptState
+    apply_fn: Callable = struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+    @classmethod
+    def create(cls, model, rng, sample_x, tx: Optional[optax.GradientTransformation] = None,
+               learning_rate: float = 1e-3):
+        """Init params from a sample batch. lr 1e-3 = Keras Adam default
+        (what `optimizer='adam'` means in the reference)."""
+        tx = tx or optax.adam(learning_rate)
+        params = model.init(rng, jnp.asarray(sample_x))["params"]
+        return cls(step=jnp.zeros((), jnp.int32), params=params,
+                   opt_state=tx.init(params), apply_fn=model.apply, tx=tx)
+
+
+def _masked_mse(pred, target, mask):
+    """Mean squared error over valid rows only (mask is [B] of 0/1)."""
+    per_elem = jnp.square(pred - target)
+    # broadcast mask over trailing dims
+    m = mask.reshape(mask.shape + (1,) * (per_elem.ndim - 1))
+    denom = jnp.maximum(jnp.sum(m) * per_elem[0].size, 1.0)
+    return jnp.sum(per_elem * m) / denom
+
+
+def _keras_accuracy(pred, target, mask):
+    m = mask.reshape(mask.shape + (1,) * (pred.ndim - 1))
+    eq = (pred == target).astype(jnp.float32) * m
+    return jnp.sum(eq) / jnp.maximum(jnp.sum(m) * pred[0].size, 1.0)
+
+
+def make_loss_fn(model, supervised: bool = False):
+    """Loss closure.  Autoencoder mode targets the input itself
+    (zip(x, x), cardata-v3.py:218); supervised mode uses (x, y) windows."""
+
+    def loss_fn(params, x, y, mask):
+        out = model.apply({"params": params}, x, with_penalty=True) \
+            if not supervised else (model.apply({"params": params}, x), 0.0)
+        pred, penalty = out if isinstance(out, tuple) else (out, 0.0)
+        target = x if not supervised else y
+        loss = _masked_mse(pred, target, mask) + penalty
+        return loss, (pred, target)
+
+    return loss_fn
+
+
+def make_raw_train_step(model, tx, supervised: bool = False):
+    """Un-jitted step — `parallel.data_parallel` re-jits it with mesh
+    shardings; single-chip callers use `make_train_step`."""
+    loss_fn = make_loss_fn(model, supervised)
+
+    def step(state: TrainState, x, y, mask):
+        (loss, (pred, target)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params, x, y, mask)
+        updates, opt_state = state.tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss, "accuracy": _keras_accuracy(pred, target, mask)}
+        return state.replace(step=state.step + 1, params=params,
+                             opt_state=opt_state), metrics
+
+    return step
+
+
+def make_train_step(model, tx, supervised: bool = False):
+    return jax.jit(make_raw_train_step(model, tx, supervised))
+
+
+def make_scanned_fit(model, tx, supervised: bool = False):
+    """Whole-fit-as-one-XLA-program: lax.scan over batches (inner) and
+    epochs (outer), state donated, data device-resident.
+
+    Per-step dispatch is the TPU throughput killer for small models — the
+    reference's 100-row batches are microseconds of MXU work, so a
+    step-per-dispatch loop is pure host/link latency.  Scanning the entire
+    fit compiles once and runs N_epochs × N_batches updates in a single
+    device program; numerically identical to the step loop.
+    """
+    raw = make_raw_train_step(model, tx, supervised)
+
+    def fit(state: TrainState, xs, ys, masks, epochs: int):
+        def batch_step(st, inp):
+            x, y, m = inp
+            st, metrics = raw(st, x, y, m)
+            return st, (metrics["loss"], metrics["accuracy"])
+
+        def epoch_step(st, _):
+            st, (losses, accs) = jax.lax.scan(batch_step, st, (xs, ys, masks))
+            return st, (jnp.mean(losses), jnp.mean(accs))
+
+        return jax.lax.scan(epoch_step, state, None, length=epochs)
+
+    return jax.jit(fit, static_argnames=("epochs",), donate_argnums=(0,))
+
+
+# jax.jit caches per function object; a fresh closure per fit_compiled call
+# would re-trace (and without backend caching, re-compile) every time.  Keyed
+# on (model, tx identity-or-descriptor, supervised) so repeated jobs — e.g.
+# bench warm passes, periodic retrains — reuse the compiled program.
+_SCANNED_CACHE: dict = {}
+
+
+def scanned_fit_cached(model, tx, supervised: bool, tx_key=None):
+    key = (model, tx_key if tx_key is not None else id(tx), supervised)
+    fn = _SCANNED_CACHE.get(key)
+    if fn is None:
+        fn = _SCANNED_CACHE[key] = make_scanned_fit(model, tx, supervised)
+    return fn
+
+
+def make_eval_step(model, supervised: bool = False):
+    @jax.jit
+    def step(params, x):
+        return model.apply({"params": params}, x)
+
+    return step
+
+
+class Trainer:
+    """model.fit for streams: epochs × batches with history, like Keras."""
+
+    def __init__(self, model, rng=None, learning_rate: float = 1e-3,
+                 supervised: bool = False, tx=None):
+        self.model = model
+        self.rng = rng if rng is not None else jax.random.PRNGKey(0)
+        # tx_key: hashable descriptor for the jit cache when we built the
+        # optimizer ourselves (a user-supplied tx is keyed by identity)
+        self._tx_key = ("adam", learning_rate) if tx is None else None
+        self.tx = tx or optax.adam(learning_rate)
+        self.supervised = supervised
+        self.state: Optional[TrainState] = None
+        self._step = None
+
+    def _ensure_state(self, sample_x):
+        if self.state is None:
+            self.state = TrainState.create(self.model, self.rng, sample_x, tx=self.tx)
+            self._step = make_train_step(self.model, self.tx, self.supervised)
+
+    def fit(self, batches, epochs: int = 1, verbose: bool = False,
+            callbacks=()) -> dict:
+        """batches: SensorBatches (or any iterable-of-Batch with .epochs)."""
+        history = {"loss": [], "accuracy": [], "records": [], "seconds": []}
+        epoch_iter = batches.epochs(epochs) if hasattr(batches, "epochs") \
+            else (iter(batches) for _ in range(epochs))
+        for e, it in enumerate(epoch_iter):
+            t0 = time.perf_counter()
+            tot_loss = tot_acc = 0.0
+            n = records = 0
+            for b in it:
+                self._ensure_state(b.x)
+                y = b.y if b.y is not None else b.x
+                with obs_metrics.train_step_seconds.time():
+                    self.state, m = self._step(self.state, b.x, y, b.mask)
+                obs_metrics.records_trained.inc(b.n_valid)
+                tot_loss += float(m["loss"])
+                tot_acc += float(m["accuracy"])
+                n += 1
+                records += b.n_valid
+                for cb in callbacks:
+                    cb.on_batch_end(b, m)
+            dt = time.perf_counter() - t0
+            history["loss"].append(tot_loss / max(n, 1))
+            history["accuracy"].append(tot_acc / max(n, 1))
+            history["records"].append(records)
+            history["seconds"].append(dt)
+            if verbose:
+                print(f"epoch {e + 1}/{epochs} - loss {history['loss'][-1]:.6f} "
+                      f"- {records} records - {dt:.2f}s")
+        return history
+
+    def fit_compiled(self, batches, epochs: int = 1) -> dict:
+        """One-XLA-program fit: decode the epoch's batches once, move them to
+        device, and run all epochs × batches inside a single jitted
+        `lax.scan` (see `make_scanned_fit`).  Semantically identical to
+        `fit` over an immutable log slice; orders of magnitude less dispatch
+        overhead for small step sizes."""
+        import numpy as np
+
+        t0 = time.perf_counter()
+        bs = list(iter(batches))
+        if not bs:
+            return {"loss": [], "accuracy": [], "records": [], "seconds": []}
+        xs = np.stack([b.x for b in bs])
+        ys = np.stack([b.y if b.y is not None else b.x for b in bs])
+        masks = np.stack([b.mask for b in bs])
+        records = sum(b.n_valid for b in bs)
+        self._ensure_state(bs[0].x)
+        scanned = scanned_fit_cached(self.model, self.tx, self.supervised,
+                                     tx_key=self._tx_key)
+        xs, ys, masks = jax.device_put((xs, ys, masks))
+        self.state, (losses, accs) = scanned(self.state, xs, ys, masks, epochs)
+        obs_metrics.records_trained.inc(records * epochs)
+        losses = np.asarray(jax.device_get(losses))
+        accs = np.asarray(jax.device_get(accs))
+        dt = time.perf_counter() - t0
+        return {"loss": losses.tolist(), "accuracy": accs.tolist(),
+                "records": [records] * epochs, "seconds": [dt / epochs] * epochs}
+
+    def predict(self, batches, callbacks=(), params=None):
+        """Batched jit inference; calls callbacks with (batch, outputs) for
+        ordered write-back (the OutputCallback pattern, cardata-v3.py:243-249).
+        `params` overrides trained state (e.g. weights loaded from h5/orbax)."""
+        ev = make_eval_step(self.model, self.supervised)
+        params = params if params is not None else self.state.params
+        outs = []
+        for b in batches:
+            out = ev(params, b.x)
+            for cb in callbacks:
+                cb.on_predict_batch_end(b, out)
+            outs.append(jax.device_get(out)[: b.n_valid])
+        return outs

@@ -1,0 +1,48 @@
+"""Test environment: force JAX onto CPU with 8 virtual devices.
+
+Mirrors the reference's simulator-as-cluster trick (SURVEY §4.4): multi-chip
+code paths are exercised on a virtual 8-device CPU mesh, no TPU required.
+Must run before jax initializes, hence env vars at import time.
+"""
+
+import os
+import sys
+
+# Force CPU even when the image points JAX at a TPU tunnel (the axon
+# sitecustomize calls jax.config.update("jax_platforms", "axon,cpu") at
+# interpreter start, overriding the JAX_PLATFORMS env var): unit tests must
+# be hermetic and fast; TPU execution is the bench/driver's job.  Override
+# with IOTML_TEST_PLATFORM=tpu to run the suite on chip.
+_platform = os.environ.get("IOTML_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+if _platform == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+# Repo root on sys.path so `import iotml` works without installation.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+REFERENCE_ROOT = "/root/reference"
+
+
+def reference_available() -> bool:
+    return os.path.isdir(REFERENCE_ROOT)
+
+
+requires_reference = pytest.mark.skipif(
+    not reference_available(),
+    reason="read-only reference checkout not mounted")
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,105 @@
+"""Broker emulator, consumer cursors, ordered producer."""
+
+import pytest
+
+from iotml.stream.broker import Broker
+from iotml.stream.consumer import StreamConsumer, parse_spec
+from iotml.stream.producer import OutputSequence
+
+
+def test_produce_fetch_offsets():
+    b = Broker()
+    b.create_topic("t", partitions=2)
+    offs = [b.produce("t", f"m{i}".encode(), partition=0) for i in range(5)]
+    assert offs == [0, 1, 2, 3, 4]
+    msgs = b.fetch("t", 0, 0)
+    assert [m.value for m in msgs] == [f"m{i}".encode() for i in range(5)]
+    assert b.end_offset("t", 0) == 5
+    assert b.end_offset("t", 1) == 0
+    assert b.fetch("t", 0, 3)[0].offset == 3
+
+
+def test_keyed_partitioning_is_stable():
+    b = Broker()
+    b.create_topic("t", partitions=10)
+    for _ in range(3):
+        b.produce("t", b"v", key=b"car42")
+    # all three copies on the same partition
+    parts = [p for p in range(10) if b.end_offset("t", p) > 0]
+    assert len(parts) == 1
+    assert b.end_offset("t", parts[0]) == 3
+
+
+def test_retention_trims_and_offsets_stay_absolute():
+    b = Broker()
+    b.create_topic("t", retention_messages=10)
+    for i in range(25):
+        b.produce("t", str(i).encode(), partition=0)
+    assert b.begin_offset("t", 0) == 15
+    assert b.end_offset("t", 0) == 25
+    msgs = b.fetch("t", 0, 0)  # request from trimmed region clamps forward
+    assert msgs[0].offset == 15
+
+
+def test_parse_spec():
+    assert parse_spec("topic:3:500") == ("topic", 3, 500)
+    assert parse_spec("topic:3") == ("topic", 3, 0)
+    assert parse_spec("topic") == ("topic", 0, 0)
+
+
+def test_consumer_eof_and_seek():
+    b = Broker()
+    b.create_topic("t")
+    for i in range(7):
+        b.produce("t", str(i).encode(), partition=0)
+    c = StreamConsumer(b, ["t:0:2"])
+    vals = [m.value for m in c]
+    assert vals == [b"2", b"3", b"4", b"5", b"6"]
+    assert c.at_end()
+    c.seek_to_start()
+    assert [m.value for m in c][0] == b"2"
+
+
+def test_consumer_multi_partition_round_robin():
+    b = Broker()
+    b.create_topic("t", partitions=3)
+    for p in range(3):
+        for i in range(4):
+            b.produce("t", f"p{p}m{i}".encode(), partition=p)
+    c = StreamConsumer(b, [f"t:{p}:0" for p in range(3)])
+    msgs = list(c)
+    assert len(msgs) == 12
+    assert {m.partition for m in msgs} == {0, 1, 2}
+
+
+def test_consumer_commit_resume():
+    b = Broker()
+    b.create_topic("t")
+    for i in range(10):
+        b.produce("t", str(i).encode(), partition=0)
+    c = StreamConsumer(b, ["t:0:0"], group="g")
+    c.poll(4)
+    c.commit()
+    c2 = StreamConsumer.from_committed(b, "t", [0], group="g")
+    assert c2.poll(1)[0].value == b"4"
+
+
+def test_output_sequence_orders_and_detects_gaps():
+    b = Broker()
+    b.create_topic("out")
+    seq = OutputSequence(b, "out", partition=0)
+    seq.setitem(2, "two")
+    seq.setitem(0, "zero")
+    seq.setitem(1, "one")
+    assert seq.flush() == 3
+    assert [m.value for m in b.fetch("out", 0, 0)] == [b"zero", b"one", b"two"]
+
+    seq.setitem(5, "five")
+    seq.setitem(7, "seven")
+    with pytest.raises(ValueError, match="gaps"):
+        seq.flush()
+    assert seq.flush(allow_gaps=True) == 2
+
+    seq.setitem(9, "x")
+    with pytest.raises(ValueError, match="duplicate"):
+        seq.setitem(9, "again")

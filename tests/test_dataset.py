@@ -1,0 +1,101 @@
+"""Stream → fixed-shape batch pipeline (decode, filter, pad, window)."""
+
+import numpy as np
+
+from iotml.core.schema import KSQL_CAR_SCHEMA
+from iotml.data.dataset import SensorBatches
+from iotml.gen.simulator import FleetGenerator, FleetScenario
+from iotml.stream.broker import Broker
+from iotml.stream.consumer import StreamConsumer
+
+
+def make_stream(num_cars=30, ticks=10, failure_rate=0.0, topic="SENSOR_DATA_S_AVRO"):
+    broker = Broker()
+    gen = FleetGenerator(FleetScenario(num_cars=num_cars, failure_rate=failure_rate))
+    n = gen.publish(broker, topic, n_ticks=ticks)
+    consumer = StreamConsumer(broker, [f"{topic}:0:0"], group="test")
+    return broker, consumer, n
+
+
+def test_batches_fixed_shape_and_padding():
+    _, consumer, n = make_stream(num_cars=30, ticks=10)  # 300 records
+    batches = list(SensorBatches(consumer, batch_size=64))
+    assert len(batches) == 5  # 4 full + 1 padded tail
+    for b in batches[:-1]:
+        assert b.x.shape == (64, 18) and b.n_valid == 64
+    tail = batches[-1]
+    assert tail.x.shape == (64, 18)
+    assert tail.n_valid == 300 - 4 * 64
+    assert np.all(tail.x[tail.n_valid:] == 0.0)
+    assert tail.mask.sum() == tail.n_valid
+
+
+def test_take_skip_and_indices():
+    _, consumer, _ = make_stream(num_cars=50, ticks=10)  # 500 records
+    bs = SensorBatches(consumer, batch_size=50, skip=2, take=3)
+    batches = list(bs)
+    assert len(batches) == 3
+    # indices are post-skip (reference OutputCallback starts at 0 after the
+    # skip slice, cardata-v3.py:243-249)
+    assert [b.first_index for b in batches] == [0, 50, 100]
+
+
+def test_skip_applies_once_across_drains():
+    """A continuous scorer re-entering the iterator must not re-skip newly
+    arrived data (skip targets the stream head only)."""
+    broker, consumer, _ = make_stream(num_cars=50, ticks=2)  # 100 records
+    bs = SensorBatches(consumer, batch_size=50, skip=1)
+    first = list(bs)
+    assert len(first) == 1  # one batch skipped, one emitted
+    # more data arrives; drain again — nothing further may be skipped
+    gen = FleetGenerator(FleetScenario(num_cars=50))
+    gen.publish(broker, "SENSOR_DATA_S_AVRO", n_ticks=1)
+    second = list(bs)
+    assert sum(b.n_valid for b in second) == 50
+
+
+def test_only_normal_filters_failures():
+    _, consumer, _ = make_stream(num_cars=200, ticks=5, failure_rate=0.2)
+    bs = SensorBatches(consumer, batch_size=32, only_normal=True, keep_labels=True)
+    got = 0
+    for b in bs:
+        assert all(l == "false" for l in b.labels[: b.n_valid])
+        got += b.n_valid
+    assert 0 < got < 1000  # some rows filtered
+
+
+def test_values_normalized_range():
+    _, consumer, _ = make_stream(num_cars=20, ticks=5)
+    for b in SensorBatches(consumer, batch_size=100):
+        assert b.x.dtype == np.float32
+        # normalized sensors live in ~[-1, 1]; zeroed cols exactly 0
+        assert np.all(b.x[:, 0] == 0.0)
+        assert np.all(np.abs(b.x[: b.n_valid, 1]) <= 1.0 + 1e-5)
+
+
+def test_epoch_reread_is_deterministic():
+    _, consumer, _ = make_stream(num_cars=30, ticks=4)
+    bs = SensorBatches(consumer, batch_size=40)
+    epochs = []
+    for it in bs.epochs(2):
+        epochs.append(np.concatenate([b.x[: b.n_valid] for b in it]))
+    np.testing.assert_array_equal(epochs[0], epochs[1])
+
+
+def test_windowed_batches_next_step_target():
+    _, consumer, _ = make_stream(num_cars=10, ticks=30)  # 300 sequential records
+    bs = SensorBatches(consumer, batch_size=8, window=4)
+    b = next(iter(bs))
+    assert b.x.shape == (8, 4, 18)
+    assert b.y.shape == (8, 1, 18)
+    # window shift=1: row k's window starts at record k; target = record k+4
+    # => x[1,0] == x[0,1] (overlapping windows)
+    np.testing.assert_array_equal(b.x[1, 0], b.x[0, 1])
+    # => y[0] == x[4,3]? target of window 0 is record 4 == first row of window 4
+    np.testing.assert_array_equal(b.y[0, 0], b.x[4, 0])
+
+
+def test_ksql_schema_is_default():
+    _, consumer, _ = make_stream(num_cars=5, ticks=2)
+    bs = SensorBatches(consumer)
+    assert bs.schema is KSQL_CAR_SCHEMA

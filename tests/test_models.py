@@ -1,0 +1,108 @@
+"""Model zoo: shapes, regularizer semantics, h5 import parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.conftest import requires_reference, REFERENCE_ROOT
+from iotml.models.autoencoder import (CAR_AUTOENCODER, CREDITCARD_AUTOENCODER,
+                                      DenseAutoencoder, reconstruction_error)
+from iotml.models.lstm import LSTMSeq2Seq
+from iotml.models.mnist import MNISTClassifier, MNISTBaseline
+
+
+def _init(model, shape):
+    x = jnp.zeros(shape, jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    return params, x
+
+
+def test_autoencoder_shapes_and_param_counts():
+    params, x = _init(CAR_AUTOENCODER, (4, 18))
+    out = CAR_AUTOENCODER.apply({"params": params}, x)
+    assert out.shape == (4, 18)
+    # layer dims 18→14→7→7→18 (cardata-v3.py:176-194)
+    assert params["encoder0"]["kernel"].shape == (18, 14)
+    assert params["encoder1"]["kernel"].shape == (14, 7)
+    assert params["decoder0"]["kernel"].shape == (7, 7)
+    assert params["decoder1"]["kernel"].shape == (7, 18)
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    # keras summary for this model: 18*14+14 + 14*7+7 + 7*7+7 + 7*18+18 = 571
+    assert n_params == 571
+
+
+def test_activity_penalty_matches_keras_semantics():
+    params, _ = _init(CAR_AUTOENCODER, (4, 18))
+    x = jnp.ones((4, 18))
+    out, pen = CAR_AUTOENCODER.apply({"params": params}, x, with_penalty=True)
+    # keras: l1 * sum(|tanh(xW+b)|) / batch
+    h = np.tanh(x @ params["encoder0"]["kernel"] + params["encoder0"]["bias"])
+    expect = 1e-7 * np.sum(np.abs(h)) / 4
+    assert float(pen) == pytest.approx(float(expect), rel=1e-5)
+
+
+def test_autoencoder_encode_latent():
+    params, _ = _init(CAR_AUTOENCODER, (4, 18))
+    x = jnp.ones((4, 18))
+    from iotml.models.autoencoder import DenseAutoencoder
+
+    code = CAR_AUTOENCODER.apply({"params": params}, x,
+                                 method=DenseAutoencoder.encode)
+    assert code.shape == (4, 7)
+    # encode must agree with the first two layers of __call__'s math
+    h = np.tanh(x @ params["encoder0"]["kernel"] + params["encoder0"]["bias"])
+    expect = np.maximum(h @ params["encoder1"]["kernel"]
+                        + params["encoder1"]["bias"], 0.0)
+    np.testing.assert_allclose(np.asarray(code), expect, rtol=1e-5, atol=1e-6)
+
+
+def test_creditcard_variant_is_30_dim():
+    params, x = _init(CREDITCARD_AUTOENCODER, (2, 30))
+    out = CREDITCARD_AUTOENCODER.apply({"params": params}, x)
+    assert out.shape == (2, 30)
+
+
+def test_reconstruction_error_per_row():
+    model = DenseAutoencoder(input_dim=6)
+    params, _ = _init(model, (3, 6))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(3, 6)), jnp.float32)
+    err = reconstruction_error(model, params, x)
+    assert err.shape == (3,)
+    assert np.all(np.asarray(err) >= 0)
+
+
+def test_lstm_seq2seq_shapes():
+    model = LSTMSeq2Seq(features=18, look_back=1)
+    x = jnp.zeros((2, 1, 18))
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    out = model.apply({"params": params}, x)
+    assert out.shape == (2, 1, 18)
+    # longer windows compile too (host windower may use T > 1)
+    model4 = LSTMSeq2Seq(features=18, look_back=4)
+    x4 = jnp.zeros((2, 4, 18))
+    p4 = model4.init(jax.random.PRNGKey(0), x4)["params"]
+    assert model4.apply({"params": p4}, x4).shape == (2, 4, 18)
+
+
+def test_mnist_models():
+    for cls in (MNISTClassifier, MNISTBaseline):
+        m = cls()
+        x = jnp.zeros((2, 28, 28))
+        params = m.init(jax.random.PRNGKey(0), x)["params"]
+        assert m.apply({"params": params}, x).shape == (2, 10)
+
+
+@requires_reference
+def test_h5_import_reference_checkpoint():
+    """Load the reference's trained 30-dim autoencoder and score with it."""
+    from iotml.models.h5_import import autoencoder_params_from_h5
+
+    path = f"{REFERENCE_ROOT}/models/autoencoder_sensor_anomaly_detection.h5"
+    params = autoencoder_params_from_h5(path)
+    assert params["encoder0"]["kernel"].shape == (30, 14)
+    assert params["decoder1"]["kernel"].shape == (7, 30)
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(5, 30)), jnp.float32)
+    out = CREDITCARD_AUTOENCODER.apply({"params": jax.tree.map(jnp.asarray, params)}, x)
+    assert out.shape == (5, 30)
+    assert np.all(np.isfinite(np.asarray(out)))

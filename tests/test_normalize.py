@@ -1,0 +1,64 @@
+"""Normalizer parity with the reference normalize_fn (cardata-v3.py:105-148)."""
+
+import numpy as np
+import pytest
+
+from iotml.core.normalize import Normalizer, CAR_NORMALIZER
+from iotml.core.schema import CAR_SCHEMA
+
+
+def reference_normalize(row):
+    """Literal per-field transcription of the reference's math, as the oracle."""
+    def scale(v, lo, hi):
+        return (v - lo) / (hi - lo) * 2.0 - 1.0
+
+    (coolant, intake_t, intake_f, batt_pct, batt_v, cur, speed, vib, thr,
+     tp11, tp12, tp21, tp22, a11, a12, a21, a22, fw) = row
+    return np.array([
+        0.0,
+        scale(intake_t, 15.0, 40.0),
+        0.0,
+        scale(batt_pct, 0.0, 100.0),
+        0.0,
+        0.0,
+        scale(speed, 0.0, 50.0),
+        scale(vib, 0.0, 7500.0),
+        scale(thr, 0.0, 1.0),
+        scale(tp11, 20.0, 35.0), scale(tp12, 20.0, 35.0),
+        scale(tp21, 20.0, 35.0), scale(tp22, 20.0, 35.0),
+        scale(a11, 0.0, 7.0), scale(a12, 0.0, 7.0),
+        scale(a21, 0.0, 7.0), scale(a22, 0.0, 7.0),
+        scale(fw, 1000.0, 2000.0),
+    ])
+
+
+def test_parity_with_reference_math(rng):
+    rows = rng.uniform(0, 100, size=(64, 18))
+    expected = np.stack([reference_normalize(r) for r in rows])
+    got = np.asarray(CAR_NORMALIZER(rows))
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-5)
+    # host-side numpy twin agrees with the jax path
+    np.testing.assert_allclose(CAR_NORMALIZER.np(rows), got, rtol=1e-6, atol=1e-6)
+
+
+def test_zeroed_fields_are_zero(rng):
+    x = rng.uniform(-1e3, 1e3, size=(8, 18))
+    out = np.asarray(CAR_NORMALIZER(x))
+    for idx in (0, 2, 4, 5):  # coolant, air_flow, voltage, current
+        assert np.all(out[:, idx] == 0.0)
+
+
+def test_range_endpoints_map_to_unit_interval():
+    x = np.zeros((1, 18))
+    x[0, 1] = 15.0  # intake_air_temp lo
+    out = np.asarray(CAR_NORMALIZER(x))
+    assert out[0, 1] == pytest.approx(-1.0)
+    x[0, 1] = 40.0
+    assert np.asarray(CAR_NORMALIZER(x))[0, 1] == pytest.approx(1.0)
+
+
+def test_non_parity_mode_calibrates_todo_fields(rng):
+    n = Normalizer(CAR_SCHEMA, parity=False)
+    x = rng.uniform(0, 100, size=(8, 18))
+    out = np.asarray(n(x))
+    assert not np.all(out[:, 0] == 0.0)  # coolant_temp now normalized

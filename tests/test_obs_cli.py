@@ -1,0 +1,68 @@
+"""Observability (Prometheus text format, scalar logs) and the
+reference-compatible CLI."""
+
+import json
+import urllib.request
+
+from iotml.obs.metrics import Registry, start_http_server
+from iotml.obs.tb import ScalarLogger
+from iotml.cli.cardata import main as cardata_main
+
+
+def test_registry_render_prometheus_text():
+    reg = Registry()
+    c = reg.counter("iotml_records_consumed_total", "records")
+    c.inc(5, topic="sensor-data")
+    c.inc(2, topic="sensor-data")
+    g = reg.gauge("iotml_reconstruction_mse", "mse")
+    g.set(0.25)
+    h = reg.histogram("iotml_train_step_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.render()
+    assert 'iotml_records_consumed_total{topic="sensor-data"} 7.0' in text
+    assert "# TYPE iotml_reconstruction_mse gauge" in text
+    assert 'iotml_train_step_seconds_bucket{le="0.1"} 1' in text
+    assert 'iotml_train_step_seconds_bucket{le="+Inf"} 3' in text
+    assert "iotml_train_step_seconds_count 3" in text
+
+
+def test_metrics_http_server():
+    reg = Registry()
+    reg.counter("iotml_test_total").inc(3)
+    srv = start_http_server(port=0, registry=reg)  # port 0 = ephemeral
+    try:
+        port = srv.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "iotml_test_total 3.0" in body
+    finally:
+        srv.shutdown()
+
+
+def test_scalar_logger_jsonl(tmp_path):
+    log = ScalarLogger(str(tmp_path), use_tensorboard=False)
+    log.history({"loss": [0.5, 0.4], "accuracy": [0.0, 0.0],
+                 "seconds": [1.0, 1.0]})
+    log.close()
+    rows = [json.loads(l) for l in open(tmp_path / "scalars.jsonl")]
+    assert rows[0]["tag"] == "train/loss"
+    assert rows[1]["value"] == 0.4
+    assert {r["tag"] for r in rows} == {"train/loss", "train/accuracy",
+                                        "train/epoch_seconds"}
+
+
+def test_cli_train_predict_handoff(tmp_path):
+    root = str(tmp_path / "store")
+    rc = cardata_main(["emulator:11000", "SENSOR_DATA_S_AVRO", "0",
+                       "model-predictions", "train", "m1", root])
+    assert rc == 0
+    rc = cardata_main(["emulator:21000", "SENSOR_DATA_S_AVRO", "0",
+                       "model-predictions", "predict", "m1", root])
+    assert rc == 0
+
+
+def test_cli_arg_validation():
+    assert cardata_main(["too", "few"]) == 1
+    assert cardata_main(["emulator", "t", "0", "r", "badmode", "m", "/tmp/x"]) == 1

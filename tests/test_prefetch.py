@@ -1,0 +1,50 @@
+"""Device prefetcher: overlap, early-abandon, single-use contract."""
+
+import numpy as np
+import pytest
+
+from iotml.data.dataset import Batch
+from iotml.data.prefetch import DevicePrefetcher
+
+
+def _batches(n=6, b=16):
+    for i in range(n):
+        yield Batch(x=np.full((b, 18), float(i), np.float32), n_valid=b,
+                    first_index=i * b)
+
+
+def test_prefetch_delivers_all_in_order():
+    got = []
+    for (x, y), b in DevicePrefetcher(_batches(6)):
+        assert y is None
+        got.append((float(np.asarray(x)[0, 0]), b.first_index))
+    assert got == [(float(i), i * 16) for i in range(6)]
+
+
+def test_prefetch_propagates_source_error():
+    def bad():
+        yield from _batches(2)
+        raise RuntimeError("stream died")
+
+    pf = DevicePrefetcher(bad())
+    it = iter(pf)
+    next(it), next(it)
+    with pytest.raises(RuntimeError, match="stream died"):
+        next(it)
+
+
+def test_prefetch_early_break_releases_worker():
+    pf = DevicePrefetcher(_batches(100), depth=2)
+    for i, item in enumerate(pf):
+        if i == 1:
+            break
+    # worker must terminate rather than block on q.put forever
+    pf._thread.join(timeout=5)
+    assert not pf._thread.is_alive()
+
+
+def test_prefetch_is_single_use():
+    pf = DevicePrefetcher(_batches(2))
+    list(pf)
+    with pytest.raises(RuntimeError, match="single-use"):
+        list(pf)

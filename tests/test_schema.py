@@ -1,0 +1,64 @@
+"""Schema derivation: both Avro variants must match the reference's files."""
+
+import json
+
+import numpy as np
+
+from tests.conftest import requires_reference, REFERENCE_ROOT
+from iotml.core.schema import CAR_SCHEMA, KSQL_CAR_SCHEMA, CSV_COLUMNS
+
+
+def test_producer_schema_shape():
+    assert CAR_SCHEMA.num_sensors == 18
+    assert CAR_SCHEMA.label_field is None
+    assert CAR_SCHEMA.field_names[0] == "coolant_temp"
+    assert CAR_SCHEMA.field_names[-1] == "control_unit_firmware"
+
+
+def test_ksql_schema_shape():
+    assert len(KSQL_CAR_SCHEMA.fields) == 19
+    assert KSQL_CAR_SCHEMA.num_sensors == 18
+    assert KSQL_CAR_SCHEMA.label_field == "FAILURE_OCCURRED"
+    # KSQL name collapsing quirk
+    names = KSQL_CAR_SCHEMA.field_names
+    assert "TIRE_PRESSURE11" in names
+    assert "ACCELEROMETER11_VALUE" in names
+    assert all(f.nullable for f in KSQL_CAR_SCHEMA.fields)
+
+
+def test_avro_json_roundtrips():
+    parsed = json.loads(CAR_SCHEMA.avro_json())
+    assert parsed["name"] == "CarData"
+    assert len(parsed["fields"]) == 18
+    parsed = json.loads(KSQL_CAR_SCHEMA.avro_json())
+    assert parsed["fields"][-1]["name"] == "FAILURE_OCCURRED"
+    assert parsed["fields"][0]["type"] == ["null", "double"]
+
+
+@requires_reference
+def test_schema_matches_reference_avsc():
+    """Field names/types/order must match the reference .avsc byte-for-intent."""
+    with open(f"{REFERENCE_ROOT}/testdata/cardata-v1.avsc") as f:
+        ref = json.load(f)
+    ours = json.loads(CAR_SCHEMA.avro_json())
+    assert [f["name"] for f in ref["fields"]] == [f["name"] for f in ours["fields"]]
+    assert [f["type"] for f in ref["fields"]] == [f["type"] for f in ours["fields"]]
+
+    with open(f"{REFERENCE_ROOT}/python-scripts/AUTOENCODER-TensorFlow-IO-Kafka/cardata-v1.avsc") as f:
+        ref = json.load(f)
+    ours = json.loads(KSQL_CAR_SCHEMA.avro_json())
+    assert [f["name"] for f in ref["fields"]] == [f["name"] for f in ours["fields"]]
+    assert [f["type"] for f in ref["fields"]] == [f["type"] for f in ours["fields"]]
+
+
+@requires_reference
+def test_csv_columns_match_reference_fixture():
+    with open(f"{REFERENCE_ROOT}/testdata/car-sensor-data.csv") as f:
+        header = f.readline().strip().split(",")
+    assert tuple(header) == CSV_COLUMNS
+
+
+def test_numpy_dtypes():
+    assert CAR_SCHEMA.field("speed").np_dtype == np.float32
+    assert CAR_SCHEMA.field("tire_pressure_1_1").np_dtype == np.int32
+    assert KSQL_CAR_SCHEMA.field("SPEED").np_dtype == np.float64
