@@ -1,3 +1,4 @@
 from .autoencoder import DenseAutoencoder, CAR_AUTOENCODER, CREDITCARD_AUTOENCODER  # noqa: F401
 from .lstm import LSTMSeq2Seq  # noqa: F401
 from .mnist import MNISTClassifier, MNISTBaseline  # noqa: F401
+from .transformer import SensorFormer  # noqa: F401

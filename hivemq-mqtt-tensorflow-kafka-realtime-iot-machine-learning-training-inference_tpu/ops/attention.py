@@ -1,0 +1,266 @@
+"""Attention ops: reference implementation + Pallas flash-attention kernel.
+
+The reference system's only sequence model is an LSTM at look_back=1
+(SURVEY §5 'long-context: nothing') — but this framework treats long per-car
+sensor histories as first-class: fleets emit unbounded streams, and anomaly
+models that look at hours of context need sequence lengths the LSTM path
+never contemplated.  The attention stack here:
+
+- `attention_reference`: straight jnp softmax attention — the oracle for
+  every other path, and the XLA-fused fallback on CPU.
+- `flash_attention`: blocked online-softmax attention as a Pallas TPU
+  kernel — O(T) memory instead of O(T²), MXU-shaped [128×128] tiles, the
+  single-chip hot op of the transformer model family.
+- `blockwise_update`: one online-softmax accumulation step, shared between
+  the flash kernel's inner loop (conceptually) and the ring-attention
+  cross-chip loop (`parallel.ring_attention`), which is the same math with
+  the KV blocks arriving over ICI instead of from VMEM.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def attention_reference(q, k, v, causal: bool = True,
+                        q_offset: int = 0, k_offset: int = 0):
+    """Plain softmax attention. q,k,v: [B, T, H, D] → [B, Tq, H, D].
+
+    q_offset/k_offset give the global positions of local blocks so the
+    causal mask stays correct under sequence sharding.
+    """
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])[:, None]
+        kpos = k_offset + jnp.arange(k.shape[1])[None, :]
+        mask = qpos >= kpos
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def blockwise_update(o, m, l, q, k_blk, v_blk, scale,
+                     mask: Optional[jnp.ndarray] = None):
+    """One online-softmax accumulation against a KV block.
+
+    o: [B, Tq, H, D] running (unnormalized) output
+    m: [B, H, Tq] running rowmax, l: [B, H, Tq] running denominator
+    mask: [Tq, Tk] boolean (True = attend), already global-position-aware.
+    Returns updated (o, m, l).  Final output is o / l[..., None].
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # guard fully-masked rows (m_new == NEG_INF): exp(NEG_INF - NEG_INF)=1
+    # would pollute l; clamp the correction to 0 there.
+    alive = m_new > NEG_INF / 2
+    corr = jnp.where(alive, jnp.exp(m - m_new), 0.0)
+    p = jnp.where(alive[..., None], jnp.exp(s - m_new[..., None]), 0.0)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = o * jnp.transpose(corr, (0, 2, 1))[..., None] + \
+        jnp.einsum("bhqk,bkhd->bqhd", p, v_blk)
+    return o_new, m_new, l_new
+
+
+def finalize_blockwise(o, l):
+    """Normalize accumulated output; fully-masked rows come out zero."""
+    denom = jnp.transpose(jnp.where(l == 0.0, 1.0, l), (0, 2, 1))[..., None]
+    return o / denom
+
+
+# --------------------------------------------------------------------- pallas
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
+                  scale: float, causal: bool, block_q: int, block_k: int):
+    """Flash attention kernel.  Grid: (batch*heads, q_blocks, kv_blocks) —
+    the kv dimension iterates sequentially on-core, so K/V stream through
+    VMEM one [block_k, D] tile at a time (O(T) VMEM, long-context safe) and
+    the online-softmax state lives in scratch that persists across the kv
+    iterations of one q block."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    def compute():
+        q = q_ref[:].astype(jnp.float32) * scale
+        k_blk = k_ref[:].astype(jnp.float32)
+        v_blk = v_ref[:].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qi = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + i * block_q
+            kj = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1) + j * block_k
+            s = jnp.where(qi >= kj, s, NEG_INF)
+        m = m_s[:]
+        l = l_s[:]
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        alive = m_new > NEG_INF / 2
+        corr = jnp.where(alive, jnp.exp(m - m_new), 0.0)
+        p = jnp.where(alive, jnp.exp(s - m_new), 0.0)
+        m_s[:] = m_new
+        l_s[:] = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc[:] = acc[:] * corr + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # whole KV block strictly in the future of this q block → skip
+        @pl.when(j * block_k <= i * block_q + (block_q - 1))
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(j == nk - 1)
+    def _emit():
+        l = l_s[:]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[:] = (acc[:] / safe_l).astype(o_ref.dtype)
+        # log-sum-exp per query row (needed by the custom-VJP backward)
+        lse_ref[:] = jnp.where(l == 0.0, NEG_INF, m_s[:] + jnp.log(safe_l))
+
+
+def _flash_forward(q, k, v, causal: bool, block_q: int,
+                   block_k: int, interpret: bool):
+    """Run the Pallas kernel; returns (out [B,T,H,D], lse [B,H,T])."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    Tq = ((T + block_q - 1) // block_q) * block_q
+    Tk = ((T + block_k - 1) // block_k) * block_k
+    if not causal and Tk != T:
+        # padded keys are only excluded by the causal mask; non-causal
+        # callers must supply block-multiple sequence lengths
+        raise ValueError(f"non-causal flash attention needs T % {block_k} == 0")
+    if Tq != T:
+        pad = [(0, 0), (0, Tq - T), (0, 0), (0, 0)]
+        q = jnp.pad(q, pad)
+    if Tk != T:
+        pad = [(0, 0), (0, Tk - T), (0, 0), (0, 0)]
+        # pad keys so padded positions never win the max: values 0, and the
+        # causal mask (global positions) excludes them for every real query
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+
+    # layout: fold batch & heads into the grid's first axis, T-major blocks
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B * H, Tq // block_q, Tk // block_k),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Tq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf)
+    out = out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)[:, :T]
+    lse = lse.reshape(B, H, Tq)[:, :, :T]
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """Pallas flash attention. q,k,v: [B, T, H, D] → [B, T, H, D].
+
+    T is padded to the block size internally (padding keys are masked out by
+    the causal structure; non-causal callers must pass T multiple of the
+    block).  `interpret=True` runs the same kernel on CPU for tests.
+
+    Differentiable via custom VJP: the forward kernel emits the per-row
+    log-sum-exp; the backward recomputes attention probabilities blockwise
+    in jnp (lax.scan over KV blocks — O(T·block) memory, XLA-fused), the
+    standard flash-attention recompute strategy.
+    """
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    B, T, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    qf = q.astype(jnp.float32)
+    do = do.astype(jnp.float32)
+    # rowwise D_i = sum_d dO_i·O_i  (the softmax-jacobian diagonal term)
+    delta = jnp.einsum("bqhd,bqhd->bhq", do, out.astype(jnp.float32))
+
+    nkb = (T + block_k - 1) // block_k
+    Tp = nkb * block_k
+    pad = [(0, 0), (0, Tp - T), (0, 0), (0, 0)]
+    kp = jnp.pad(k.astype(jnp.float32), pad).reshape(B, nkb, block_k, H, D)
+    vp = jnp.pad(v.astype(jnp.float32), pad).reshape(B, nkb, block_k, H, D)
+    kpos_pad = jnp.arange(Tp).reshape(nkb, block_k)
+    qpos = jnp.arange(T)
+
+    def kv_block(dq_acc, blk):
+        k_blk, v_blk, kpos = blk  # [B,block_k,H,D], [block_k]
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk) * scale
+        mask = kpos[None, :] < T  # padding guard
+        if causal:
+            mask = mask & (qpos[:, None] >= kpos[None, :])
+        s = jnp.where(mask[None, None], s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])  # [B,H,Tq,block_k]; 0 where masked
+        dv = jnp.einsum("bhqk,bqhd->bkhd", p, do)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", do, v_blk)
+        ds = p * (dp - delta[..., None]) * scale
+        dq_acc = dq_acc + jnp.einsum("bhqk,bkhd->bqhd", ds, k_blk)
+        dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+        return dq_acc, (dk, dv)
+
+    dq0 = jnp.zeros((B, T, H, D), jnp.float32)
+    dq, (dks, dvs) = jax.lax.scan(
+        kv_block, dq0,
+        (kp.transpose(1, 0, 2, 3, 4), vp.transpose(1, 0, 2, 3, 4), kpos_pad))
+    dk = dks.transpose(1, 0, 2, 3, 4).reshape(B, Tp, H, D)[:, :T]
+    dv = dvs.transpose(1, 0, 2, 3, 4).reshape(B, Tp, H, D)[:, :T]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

@@ -1,2 +1,5 @@
 from .mesh import make_mesh, auto_mesh, batch_sharding, replicated  # noqa: F401
 from .data_parallel import ShardedTrainer, shard_params, param_specs, make_sharded_eval_step  # noqa: F401
+from .ring_attention import make_ring_attention, ring_attention  # noqa: F401
+from .seq_parallel import make_sp_train_step  # noqa: F401
+from . import distributed  # noqa: F401
