@@ -88,6 +88,10 @@ class SensorFormer(nn.Module):
     def __call__(self, x, positions: Optional[jnp.ndarray] = None):
         B, T, F = x.shape
         h = nn.Dense(self.d_model, name="embed")(x)
+        if positions is None and T > self.max_len:
+            raise ValueError(
+                f"sequence length {T} exceeds max_len={self.max_len}; "
+                f"under jit the Embed gather would silently clamp")
         pos = jnp.arange(T) if positions is None else positions
         pe = nn.Embed(self.max_len, self.d_model, name="pos")(pos)
         h = h + pe  # broadcasts over batch for [T]- or [B,T]-shaped positions

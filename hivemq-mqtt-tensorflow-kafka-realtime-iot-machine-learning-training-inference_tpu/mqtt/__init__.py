@@ -1,0 +1,9 @@
+"""MQTT ingestion layer: broker core, wire protocol, Kafka bridge,
+device-simulator scenarios (reference L1/L2 — SURVEY §1)."""
+
+from .broker import MqttBroker, QueueClient  # noqa: F401
+from .bridge import KafkaBridge, TopicMapping  # noqa: F401
+from .scenario import (EVALUATION_SCENARIO, Scenario, ScenarioRunner,  # noqa: F401
+                       parse_scenario)
+from .topic_tree import TopicTree, topic_matches  # noqa: F401
+from .wire import MqttClient, MqttServer  # noqa: F401

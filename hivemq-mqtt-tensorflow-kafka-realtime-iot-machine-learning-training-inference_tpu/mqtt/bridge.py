@@ -1,0 +1,72 @@
+"""MQTT→stream bridge — the HiveMQ Kafka-extension equivalent.
+
+The reference bridges MQTT into Kafka with a broker extension configured by
+topic-mappings: every publish matching `vehicles/sensor/data/#` is produced
+to Kafka topic `sensor-data` (reference
+`infrastructure/hivemq/kafka-config.yaml:20-29`).  The record key is the
+MQTT topic, which is what lets the downstream KSQL re-key and the MongoDB
+sink HoistField the car id (reference
+`infrastructure/kafka-connect/mongodb/mongodb-connector-configmap.yaml:14-16`).
+
+`KafkaBridge` subscribes to the MQTT broker core with each mapping's filter
+and produces the payload bytes unchanged into the framework's stream
+broker, counting forwards under the reference's metric family name
+(`kafka_extension_total_*`, charted by `hivemq.json`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional
+
+from ..obs.metrics import default_registry
+from ..stream.broker import Broker
+from .broker import MqttBroker
+
+
+@dataclasses.dataclass(frozen=True)
+class TopicMapping:
+    """One <topic-mapping>: MQTT filter(s) → stream topic."""
+
+    mqtt_topic_filters: tuple
+    stream_topic: str
+    id: str = ""
+
+    @classmethod
+    def sensor_data(cls) -> "TopicMapping":
+        """The reference's single production mapping."""
+        return cls(("vehicles/sensor/data/#",), "sensor-data",
+                   id="sensor-data")
+
+
+class KafkaBridge:
+    """Forward matching MQTT publishes into stream-broker topics."""
+
+    def __init__(self, mqtt: MqttBroker, stream: Broker,
+                 mappings: Optional[List[TopicMapping]] = None,
+                 partitions: int = 10):
+        self.mqtt = mqtt
+        self.stream = stream
+        self.mappings = mappings or [TopicMapping.sensor_data()]
+        self._m_fwd = default_registry.counter(
+            "kafka_extension_total_forwarded",
+            "MQTT publishes bridged into the stream broker (reference "
+            "family kafka_extension_*)")
+        for i, m in enumerate(self.mappings):
+            # the reference provisions sensor-data with 10 partitions
+            stream.create_topic(m.stream_topic, partitions=partitions)
+            cid = f"__bridge__{m.id or i}"
+            dest = m.stream_topic
+
+            def deliver(topic, payload, qos, retain, _dest=dest):
+                self.stream.produce(_dest, payload, key=topic.encode(),
+                                    timestamp_ms=int(time.time() * 1000))
+                self._m_fwd.inc()
+
+            mqtt.connect(cid, deliver, clean_start=True)
+            for f in m.mqtt_topic_filters:
+                mqtt.subscribe(cid, f)
+
+    def forwarded(self) -> int:
+        return int(self._m_fwd.value())

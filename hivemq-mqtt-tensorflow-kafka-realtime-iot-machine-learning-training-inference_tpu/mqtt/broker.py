@@ -1,0 +1,168 @@
+"""In-process MQTT broker core — the HiveMQ-cluster equivalent.
+
+The reference fronts its pipeline with a 5-node HiveMQ cluster (reference
+`infrastructure/hivemq/hivemq-crd.yaml:10-26`): MQTT sessions, wildcard and
+shared subscriptions, QoS 0/1, and extension hooks (the Kafka extension
+registers for a topic filter and forwards publishes).  This core implements
+those broker semantics in-process; `iotml.mqtt.wire` puts a real TCP/MQTT
+protocol front on it, and `iotml.mqtt.bridge.KafkaBridge` is the extension
+equivalent.  Metrics use the same family names the reference's Grafana
+dashboards chart (`com_hivemq_messages_*`, SURVEY §5).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..obs.metrics import default_registry
+from .topic_tree import TopicTree, validate_filter
+
+# callback(topic, payload, qos, retain) — delivery to one session
+DeliveryFn = Callable[[str, bytes, int, bool], None]
+
+
+class Session:
+    __slots__ = ("client_id", "deliver", "clean_start", "connected_at")
+
+    def __init__(self, client_id: str, deliver: DeliveryFn,
+                 clean_start: bool = True):
+        self.client_id = client_id
+        self.deliver = deliver
+        self.clean_start = clean_start
+        self.connected_at = time.time()
+
+
+class MqttBroker:
+    """Session + subscription + retained-message state with synchronous
+    fan-out delivery.  Thread-safe; delivery callbacks run on the
+    publisher's thread (the wire server hands each connection its own
+    writer lock, so concurrent fan-out is safe)."""
+
+    def __init__(self, name: str = "iotml-mqtt"):
+        self.name = name
+        self._sessions: Dict[str, Session] = {}
+        self._tree = TopicTree()
+        self._retained: Dict[str, Tuple[bytes, int]] = {}
+        self._lock = threading.Lock()
+        reg = default_registry
+        self._m_in = reg.counter(
+            "mqtt_messages_incoming_publish_count",
+            "PUBLISH packets received (reference family "
+            "com_hivemq_messages_incoming_publish_count)")
+        self._m_out = reg.counter(
+            "mqtt_messages_outgoing_publish_count",
+            "PUBLISH packets delivered to subscribers")
+        self._m_dropped = reg.counter(
+            "mqtt_messages_dropped_count",
+            "publishes that matched no subscription")
+        self._g_sessions = reg.gauge(
+            "mqtt_sessions_overall_current", "live MQTT sessions")
+
+    # ---------------------------------------------------------- sessions
+    def connect(self, client_id: str, deliver: DeliveryFn,
+                clean_start: bool = True) -> Session:
+        """Register a session.  A reconnect with the same client id takes
+        over (the old delivery path is dropped — MQTT session takeover)."""
+        with self._lock:
+            if clean_start:
+                self._tree.unsubscribe_all(client_id)
+            s = Session(client_id, deliver, clean_start)
+            self._sessions[client_id] = s
+            self._g_sessions.set(len(self._sessions))
+            return s
+
+    def disconnect(self, client_id: str,
+                   session: Optional[Session] = None) -> None:
+        """End a session.  Pass the Session returned by connect() so a
+        stale connection's teardown cannot destroy a session that was
+        taken over by a newer connection with the same client id."""
+        with self._lock:
+            cur = self._sessions.get(client_id)
+            if cur is None or (session is not None and cur is not session):
+                return
+            del self._sessions[client_id]
+            if cur.clean_start:
+                self._tree.unsubscribe_all(client_id)
+            self._g_sessions.set(len(self._sessions))
+
+    def session_count(self) -> int:
+        return len(self._sessions)
+
+    # ----------------------------------------------------- subscriptions
+    def subscribe(self, client_id: str, filter_: str, qos: int = 0) -> int:
+        """Returns granted qos (0/1 supported; 2 downgraded to 1 — the
+        reference caps at maxQos 2 but its pipeline only uses 0/1)."""
+        validate_filter(filter_)
+        granted = min(qos, 1)
+        self._tree.subscribe(client_id, filter_, granted)
+        # retained delivery on subscribe (spec §3.8.4)
+        from .topic_tree import split_share, topic_matches
+        group, real = split_share(filter_)
+        if group is None:  # retained messages are not sent to shared subs
+            sess = self._sessions.get(client_id)
+            if sess is not None:
+                for topic, (payload, rqos) in list(self._retained.items()):
+                    if topic_matches(real, topic):
+                        sess.deliver(topic, payload, min(granted, rqos), True)
+        return granted
+
+    def unsubscribe(self, client_id: str, filter_: str) -> bool:
+        return self._tree.unsubscribe(client_id, filter_)
+
+    # ------------------------------------------------------------ publish
+    def publish(self, topic: str, payload: bytes, qos: int = 0,
+                retain: bool = False) -> int:
+        """Fan a publish out to every matching session; returns the number
+        of deliveries."""
+        if "+" in topic or "#" in topic:
+            raise ValueError(f"wildcards not allowed in publish topic: {topic!r}")
+        self._m_in.inc()
+        if retain:
+            if payload:
+                self._retained[topic] = (payload, qos)
+            else:
+                self._retained.pop(topic, None)  # empty retained = clear
+        receivers = self._tree.receivers(topic)
+        delivered = 0
+        for cid, granted in receivers:
+            sess = self._sessions.get(cid)
+            if sess is None:
+                continue
+            sess.deliver(topic, payload, min(qos, granted), False)
+            delivered += 1
+        if delivered:
+            self._m_out.inc(delivered)
+        else:
+            self._m_dropped.inc()
+        return delivered
+
+    def retained(self) -> Dict[str, bytes]:
+        return {t: p for t, (p, _q) in self._retained.items()}
+
+
+class QueueClient:
+    """In-process client: collects deliveries into a list (tests, sinks)."""
+
+    def __init__(self, broker: MqttBroker, client_id: str,
+                 clean_start: bool = True):
+        self.broker = broker
+        self.client_id = client_id
+        self.messages: List[Tuple[str, bytes, int, bool]] = []
+        self._lock = threading.Lock()
+        self._session = broker.connect(client_id, self._deliver, clean_start)
+
+    def _deliver(self, topic: str, payload: bytes, qos: int, retain: bool):
+        with self._lock:
+            self.messages.append((topic, payload, qos, retain))
+
+    def subscribe(self, filter_: str, qos: int = 0) -> int:
+        return self.broker.subscribe(self.client_id, filter_, qos)
+
+    def publish(self, topic: str, payload: bytes, qos: int = 0,
+                retain: bool = False) -> int:
+        return self.broker.publish(topic, payload, qos, retain)
+
+    def disconnect(self):
+        self.broker.disconnect(self.client_id, self._session)

@@ -1,0 +1,366 @@
+"""MQTT wire protocol: packet codec, TCP server, and a small client.
+
+The reference's device fleet speaks real MQTT over TCP to HiveMQ on :1883
+(reference `infrastructure/hivemq/hivemq-mqtt.yaml:12-14`, scenario clients
+`mqttVersion 5`).  This module gives the framework the same boundary: an
+MQTT 3.1.1 server (protocol level 4; level-5 CONNECT/SUBSCRIBE/PUBLISH
+packets are accepted by parsing and skipping their properties block) in
+front of `MqttBroker`, plus a blocking client used by the load-generator
+agents.  QoS 0 and 1 are implemented end to end (PUBLISH→PUBACK); that is
+everything the reference's pipeline uses (scenario qos 0 / evaluation
+qos 1).
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .broker import MqttBroker
+
+# packet types
+CONNECT, CONNACK, PUBLISH, PUBACK = 1, 2, 3, 4
+SUBSCRIBE, SUBACK, UNSUBSCRIBE, UNSUBACK = 8, 9, 10, 11
+PINGREQ, PINGRESP, DISCONNECT = 12, 13, 14
+
+
+# ------------------------------------------------------------------ codec
+def encode_varlen(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n % 128
+        n //= 128
+        out.append(b | 0x80 if n else b)
+        if not n:
+            return bytes(out)
+
+
+def decode_varlen(read: Callable[[int], bytes]) -> int:
+    mult, val = 1, 0
+    for _ in range(4):
+        (b,) = read(1)
+        val += (b & 0x7F) * mult
+        if not b & 0x80:
+            return val
+        mult *= 128
+    raise ValueError("malformed remaining-length")
+
+
+def _mqtt_str(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">H", len(b)) + b
+
+
+def _read_str(buf: bytes, pos: int) -> Tuple[str, int]:
+    (n,) = struct.unpack_from(">H", buf, pos)
+    return buf[pos + 2:pos + 2 + n].decode(), pos + 2 + n
+
+
+def _skip_props(buf: bytes, pos: int) -> int:
+    """Skip an MQTT 5 properties block: variable-byte-integer length, then
+    that many bytes (spec §2.2.2 — NOT a single length byte)."""
+    cur = [pos]
+
+    def read(n: int) -> bytes:
+        chunk = buf[cur[0]:cur[0] + n]
+        cur[0] += n
+        return chunk
+
+    length = decode_varlen(read)  # advances cur past the varint itself
+    return cur[0] + length
+
+
+def packet(ptype: int, flags: int, body: bytes) -> bytes:
+    return bytes([(ptype << 4) | flags]) + encode_varlen(len(body)) + body
+
+
+def connect_packet(client_id: str, protocol_level: int = 4,
+                   keepalive: int = 60, clean: bool = True) -> bytes:
+    name = "MQTT"
+    flags = 0x02 if clean else 0x00
+    body = _mqtt_str(name) + bytes([protocol_level, flags]) + \
+        struct.pack(">H", keepalive)
+    if protocol_level == 5:
+        body += b"\x00"  # empty properties
+    body += _mqtt_str(client_id)
+    return packet(CONNECT, 0, body)
+
+
+def publish_packet(topic: str, payload: bytes, qos: int = 0,
+                   retain: bool = False, packet_id: int = 0,
+                   protocol_level: int = 4) -> bytes:
+    flags = (qos << 1) | (1 if retain else 0)
+    body = _mqtt_str(topic)
+    if qos > 0:
+        body += struct.pack(">H", packet_id)
+    if protocol_level == 5:
+        body += b"\x00"
+    body += payload
+    return packet(PUBLISH, flags, body)
+
+
+def subscribe_packet(packet_id: int, filters: List[Tuple[str, int]],
+                     protocol_level: int = 4) -> bytes:
+    body = struct.pack(">H", packet_id)
+    if protocol_level == 5:
+        body += b"\x00"
+    for f, q in filters:
+        body += _mqtt_str(f) + bytes([q])
+    return packet(SUBSCRIBE, 0x02, body)
+
+
+# ------------------------------------------------------------------ server
+class _Conn(socketserver.BaseRequestHandler):
+    """One MQTT connection.  The handler loop reads packets and mutates the
+    shared MqttBroker; outbound publishes are serialized by a per-connection
+    write lock (broker fan-out may run on other publishers' threads)."""
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.request.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            buf += chunk
+        return buf
+
+    def _send(self, data: bytes) -> None:
+        with self._wlock:
+            self.request.sendall(data)
+
+    def _deliver(self, topic: str, payload: bytes, qos: int, retain: bool):
+        pid = 0
+        if qos > 0:
+            with self._wlock:
+                self._next_pid = self._next_pid % 65535 + 1
+                pid = self._next_pid
+        try:
+            self._send(publish_packet(topic, payload, qos, retain, pid,
+                                      protocol_level=self._level))
+        except OSError:
+            pass  # connection torn down mid-fanout; session cleanup follows
+
+    def handle(self):
+        broker: MqttBroker = self.server.broker  # type: ignore[attr-defined]
+        self._wlock = threading.Lock()
+        self._next_pid = 0
+        self._level = 4
+        client_id = None
+        session = None
+        try:
+            while True:
+                (h,) = self._read_exact(1)
+                ptype, flags = h >> 4, h & 0x0F
+                length = decode_varlen(self._read_exact)
+                body = self._read_exact(length) if length else b""
+                if ptype == CONNECT:
+                    _name, pos = _read_str(body, 0)
+                    self._level = body[pos]
+                    clean = bool(body[pos + 1] & 0x02)
+                    pos += 4  # level + flags + keepalive
+                    if self._level >= 5:
+                        pos = _skip_props(body, pos)
+                    client_id, pos = _read_str(body, pos)
+                    client_id = client_id or f"anon-{id(self):x}"
+                    session = broker.connect(client_id, self._deliver, clean)
+                    ack = b"\x00\x00\x00" if self._level >= 5 else b"\x00\x00"
+                    self._send(packet(CONNACK, 0, ack))
+                elif ptype == PUBLISH:
+                    qos = (flags >> 1) & 0x03
+                    retain = bool(flags & 0x01)
+                    topic, pos = _read_str(body, 0)
+                    pid = 0
+                    if qos > 0:
+                        (pid,) = struct.unpack_from(">H", body, pos)
+                        pos += 2
+                    if self._level >= 5:
+                        pos = _skip_props(body, pos)
+                    broker.publish(topic, body[pos:], qos, retain)
+                    if qos == 1:
+                        self._send(packet(PUBACK, 0, struct.pack(">H", pid)))
+                elif ptype == SUBSCRIBE:
+                    (pid,) = struct.unpack_from(">H", body, 0)
+                    pos = 2
+                    if self._level >= 5:
+                        pos = _skip_props(body, pos)
+                    codes = bytearray()
+                    while pos < len(body):
+                        f, pos = _read_str(body, pos)
+                        qos = body[pos] & 0x03
+                        pos += 1
+                        try:
+                            codes.append(broker.subscribe(client_id, f, qos))
+                        except ValueError:
+                            codes.append(0x80)  # per-filter failure code
+                    self._send(packet(SUBACK, 0,
+                                      struct.pack(">H", pid) +
+                                      (b"\x00" if self._level >= 5 else b"") +
+                                      bytes(codes)))
+                elif ptype == UNSUBSCRIBE:
+                    (pid,) = struct.unpack_from(">H", body, 0)
+                    pos = 2
+                    if self._level >= 5:
+                        pos = _skip_props(body, pos)
+                    while pos < len(body):
+                        f, pos = _read_str(body, pos)
+                        broker.unsubscribe(client_id, f)
+                    self._send(packet(UNSUBACK, 0, struct.pack(">H", pid)))
+                elif ptype == PINGREQ:
+                    self._send(packet(PINGRESP, 0, b""))
+                elif ptype == PUBACK:
+                    pass  # client acks for our qos1 deliveries
+                elif ptype == DISCONNECT:
+                    break
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            if client_id is not None:
+                # identity-checked: a session taken over by a newer
+                # connection with this client id survives our teardown
+                broker.disconnect(client_id, session)
+
+
+class MqttServer(socketserver.ThreadingTCPServer):
+    """TCP front for MqttBroker.  `with MqttServer(broker) as s:` serves on
+    an ephemeral localhost port (`s.port`) until the block exits."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, broker: MqttBroker, host: str = "127.0.0.1",
+                 port: int = 0):
+        super().__init__((host, port), _Conn)
+        self.broker = broker
+        self.port = self.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MqttServer":
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def __enter__(self) -> "MqttServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+        self.server_close()
+
+
+# ------------------------------------------------------------------ client
+class MqttClient:
+    """Small blocking MQTT client (the simulator agents' network path)."""
+
+    def __init__(self, host: str, port: int, client_id: str,
+                 protocol_level: int = 4, clean: bool = True,
+                 on_message: Optional[Callable[[str, bytes], None]] = None):
+        self.client_id = client_id
+        self._level = protocol_level
+        self._sock = socket.create_connection((host, port), timeout=10)
+        self._on_message = on_message
+        self._acks: Dict[int, threading.Event] = {}
+        self._suback = threading.Event()
+        self._pingresp = threading.Event()
+        self._next_pid = 0
+        self._wlock = threading.Lock()
+        self._sock.sendall(connect_packet(client_id, protocol_level,
+                                          clean=clean))
+        h, body = self._read_packet()
+        if h >> 4 != CONNACK:
+            raise ConnectionError(f"expected CONNACK, got {h >> 4}")
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("server closed")
+            buf += chunk
+        return buf
+
+    def _read_packet(self) -> Tuple[int, bytes]:
+        (h,) = self._read_exact(1)
+        length = decode_varlen(self._read_exact)
+        return h, self._read_exact(length) if length else b""
+
+    def _read_loop(self):
+        try:
+            while True:
+                h, body = self._read_packet()
+                ptype, flags = h >> 4, h & 0x0F
+                if ptype == PUBLISH:
+                    qos = (flags >> 1) & 0x03
+                    topic, pos = _read_str(body, 0)
+                    if qos > 0:
+                        (pid,) = struct.unpack_from(">H", body, pos)
+                        pos += 2
+                        with self._wlock:
+                            self._sock.sendall(
+                                packet(PUBACK, 0, struct.pack(">H", pid)))
+                    if self._level >= 5:
+                        pos = _skip_props(body, pos)
+                    if self._on_message:
+                        self._on_message(topic, body[pos:])
+                elif ptype == PUBACK:
+                    (pid,) = struct.unpack_from(">H", body, 0)
+                    ev = self._acks.pop(pid, None)
+                    if ev:
+                        ev.set()
+                elif ptype == SUBACK:
+                    self._suback.set()
+                elif ptype == PINGRESP:
+                    self._pingresp.set()
+        except (ConnectionError, OSError):
+            pass
+
+    def publish(self, topic: str, payload: bytes, qos: int = 0,
+                retain: bool = False, timeout: float = 10.0) -> None:
+        pid, ev = 0, None
+        if qos > 0:
+            with self._wlock:
+                self._next_pid = self._next_pid % 65535 + 1
+                pid = self._next_pid
+            ev = threading.Event()
+            self._acks[pid] = ev
+        with self._wlock:
+            self._sock.sendall(publish_packet(topic, payload, qos, retain,
+                                              pid, self._level))
+        if ev is not None and not ev.wait(timeout):
+            raise TimeoutError(f"no PUBACK for packet {pid}")
+
+    def subscribe(self, filter_: str, qos: int = 0,
+                  timeout: float = 10.0) -> None:
+        with self._wlock:
+            self._next_pid = self._next_pid % 65535 + 1
+            pid = self._next_pid
+        self._suback.clear()
+        with self._wlock:
+            self._sock.sendall(subscribe_packet(pid, [(filter_, qos)],
+                                                self._level))
+        if not self._suback.wait(timeout):
+            raise TimeoutError("no SUBACK")
+
+    def ping(self, timeout: float = 10.0) -> None:
+        """PINGREQ/PINGRESP round-trip.  Because the server processes each
+        connection's packets in order, a returned ping guarantees every
+        prior qos-0 publish on this connection has been fully fanned out —
+        the deterministic quiesce barrier the scenario runner uses."""
+        self._pingresp.clear()
+        with self._wlock:
+            self._sock.sendall(packet(PINGREQ, 0, b""))
+        if not self._pingresp.wait(timeout):
+            raise TimeoutError("no PINGRESP")
+
+    def disconnect(self) -> None:
+        try:
+            with self._wlock:
+                self._sock.sendall(packet(DISCONNECT, 0, b""))
+            self._sock.close()
+        except OSError:
+            pass

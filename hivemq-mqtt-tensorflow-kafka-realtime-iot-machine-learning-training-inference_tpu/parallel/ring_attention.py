@@ -58,17 +58,22 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
                                 k_blk.astype(jnp.float32),
                                 v_blk.astype(jnp.float32), scale, mask)
 
+    # hop 0 consumes the resident KV block; the scan then does exactly the
+    # n-1 rotations needed (a rotate-last loop would ppermute a full K+V
+    # shard per layer that nothing reads).
+    o, m, l = hop_update(o0, m0, l0, k, v, 0)
+
     def hop(carry, s):
         o, m, l, k_blk, v_blk = carry
-        o, m, l = hop_update(o, m, l, k_blk, v_blk, s)
         # rotate KV to the right neighbor (receive from the left)
         perm = [(i, (i + 1) % n) for i in range(n)]
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        o, m, l = hop_update(o, m, l, k_blk, v_blk, s)
         return (o, m, l, k_blk, v_blk), None
 
-    (o, m, l, _, _), _ = jax.lax.scan(hop, (o0, m0, l0, k, v),
-                                      jnp.arange(n))
+    (o, m, l, _, _), _ = jax.lax.scan(hop, (o, m, l, k, v),
+                                      jnp.arange(1, n))
     return finalize_blockwise(o, l).astype(q.dtype)
 
 

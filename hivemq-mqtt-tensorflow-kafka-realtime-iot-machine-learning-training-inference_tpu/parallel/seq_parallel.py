@@ -59,6 +59,12 @@ def make_sp_train_step(model, tx, mesh: Mesh, data_axis: str = "data",
     def local_loss(params, x_local):
         B, Tl, F = x_local.shape
         my = jax.lax.axis_index(seq_axis)
+        n = jax.lax.psum(1, seq_axis)  # static: mesh axis size
+        max_len = getattr(model, "max_len", None)
+        if max_len is not None and n * Tl > max_len:
+            raise ValueError(
+                f"global sequence {n * Tl} exceeds model.max_len={max_len}; "
+                f"the position Embed gather would silently clamp under jit")
         positions = my * Tl + jnp.arange(Tl)
         pred = model.apply({"params": params}, x_local, positions=positions)
         target = shift_in_next(x_local, seq_axis)

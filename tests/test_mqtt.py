@@ -1,0 +1,303 @@
+"""MQTT layer: topic matching, broker semantics, wire protocol, bridge,
+scenario runner — the reference's L1/L2 (HiveMQ + device simulator)."""
+
+import json
+
+import pytest
+
+from iotml.mqtt.topic_tree import TopicTree, split_share, topic_matches
+from iotml.mqtt.broker import MqttBroker, QueueClient
+from iotml.mqtt.bridge import KafkaBridge, TopicMapping
+from iotml.mqtt.scenario import (EVALUATION_SCENARIO, ScenarioRunner,
+                                 expand_pattern, parse_rate, parse_scenario)
+from iotml.mqtt.wire import MqttClient, MqttServer
+from iotml.stream.broker import Broker
+
+
+# ------------------------------------------------------------- matching
+@pytest.mark.parametrize("filt,topic,expect", [
+    ("vehicles/sensor/data/#", "vehicles/sensor/data/car-1", True),
+    ("vehicles/sensor/data/#", "vehicles/sensor/data/a/b/c", True),
+    ("vehicles/sensor/data/#", "vehicles/sensor/data", True),  # parent
+    ("vehicles/sensor/data/#", "vehicles/sensor/other/car-1", False),
+    ("vehicles/+/data/+", "vehicles/sensor/data/car-1", True),
+    ("vehicles/+/data/+", "vehicles/sensor/data/a/b", False),
+    ("+", "vehicles", True),
+    ("+", "vehicles/sensor", False),
+    ("#", "anything/at/all", True),
+    ("#", "$SYS/broker/load", False),      # $-topic shielded from root #
+    ("+/monitor", "$SYS/monitor", False),  # ... and from root +
+    ("$SYS/#", "$SYS/broker/load", True),  # explicit $ filter matches
+    ("sport/tennis/player1/#", "sport/tennis/player1/ranking", True),
+])
+def test_topic_matches(filt, topic, expect):
+    assert topic_matches(filt, topic) is expect
+
+
+def test_split_share():
+    assert split_share("$share/consumers/vehicles/#") == \
+        ("consumers", "vehicles/#")
+    assert split_share("vehicles/#") == (None, "vehicles/#")
+    with pytest.raises(ValueError):
+        split_share("$share/nogroup")
+
+
+def test_tree_wildcards_and_overlap():
+    tree = TopicTree()
+    tree.subscribe("a", "vehicles/sensor/data/#")
+    tree.subscribe("b", "vehicles/+/data/car-1")
+    tree.subscribe("c", "vehicles/sensor/data/car-1")
+    got = dict(tree.receivers("vehicles/sensor/data/car-1"))
+    assert set(got) == {"a", "b", "c"}
+    # a client matching via two overlapping filters is delivered once
+    tree.subscribe("a", "vehicles/#")
+    assert [cid for cid, _ in
+            tree.receivers("vehicles/sensor/data/car-2")].count("a") == 1
+
+
+def test_shared_subscription_round_robin():
+    """$share/consumers/... delivers each publish to exactly one member
+    (reference scenario.xml:33-35 — six shared consumers)."""
+    tree = TopicTree()
+    for i in range(3):
+        tree.subscribe(f"consumer-{i}", "$share/consumers/vehicles/#")
+    hits = []
+    for _ in range(9):
+        got = tree.receivers("vehicles/sensor/data/car-7")
+        assert len(got) == 1
+        hits.append(got[0][0])
+    assert set(hits) == {"consumer-0", "consumer-1", "consumer-2"}
+    assert hits.count("consumer-0") == 3  # balanced
+
+
+# --------------------------------------------------------------- broker
+def test_broker_publish_subscribe_retained():
+    b = MqttBroker()
+    c1 = QueueClient(b, "sub-1")
+    c1.subscribe("tele/+/status")
+    b.publish("tele/dev1/status", b"up", retain=True)
+    assert c1.messages[-1][:2] == ("tele/dev1/status", b"up")
+    # late subscriber receives the retained message, flagged retain=True
+    c2 = QueueClient(b, "sub-2")
+    c2.subscribe("tele/#")
+    assert c2.messages[-1] == ("tele/dev1/status", b"up", 0, True)
+    # empty payload clears the retained message
+    b.publish("tele/dev1/status", b"", retain=True)
+    c3 = QueueClient(b, "sub-3")
+    c3.subscribe("tele/#")
+    assert c3.messages == []
+
+
+def test_broker_session_takeover_and_disconnect():
+    b = MqttBroker()
+    c1 = QueueClient(b, "dev")
+    c1.subscribe("t/#")
+    b.publish("t/x", b"1")
+    assert len(c1.messages) == 1
+    QueueClient(b, "dev")  # takeover: clean session drops old subs
+    b.publish("t/x", b"2")
+    assert len(c1.messages) == 1
+    b.disconnect("dev")
+    assert b.session_count() == 0
+
+
+def test_publish_rejects_wildcards():
+    b = MqttBroker()
+    with pytest.raises(ValueError):
+        b.publish("vehicles/#", b"x")
+
+
+# ----------------------------------------------------------------- wire
+def test_wire_end_to_end_qos0_qos1():
+    broker = MqttBroker()
+    with MqttServer(broker) as srv:
+        got = []
+        sub = MqttClient("127.0.0.1", srv.port, "sub",
+                         on_message=lambda t, p: got.append((t, p)))
+        sub.subscribe("vehicles/sensor/data/#", qos=1)
+        pub = MqttClient("127.0.0.1", srv.port, "pub")
+        pub.publish("vehicles/sensor/data/car-1", b"hello", qos=0)
+        pub.publish("vehicles/sensor/data/car-2", b"acked", qos=1)  # waits for PUBACK
+        import time
+        deadline = time.time() + 5
+        while len(got) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert sorted(got) == [("vehicles/sensor/data/car-1", b"hello"),
+                               ("vehicles/sensor/data/car-2", b"acked")]
+        pub.disconnect()
+        sub.disconnect()
+
+
+def test_wire_mqtt5_client():
+    """Protocol-level-5 packets (with properties byte) round-trip."""
+    broker = MqttBroker()
+    with MqttServer(broker) as srv:
+        got = []
+        sub = MqttClient("127.0.0.1", srv.port, "sub5", protocol_level=5,
+                         on_message=lambda t, p: got.append((t, p)))
+        sub.subscribe("a/b", qos=1)
+        pub = MqttClient("127.0.0.1", srv.port, "pub5", protocol_level=5)
+        pub.publish("a/b", b"v5", qos=1)
+        import time
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.01)
+        assert got == [("a/b", b"v5")]
+        pub.disconnect()
+        sub.disconnect()
+
+
+# --------------------------------------------------------------- bridge
+def test_bridge_topic_mapping():
+    """vehicles/sensor/data/# → stream topic sensor-data, key = MQTT topic
+    (reference kafka-config.yaml:20-29)."""
+    mqtt = MqttBroker()
+    stream = Broker()
+    bridge = KafkaBridge(mqtt, stream, partitions=10)
+    pub = QueueClient(mqtt, "car")
+    pub.publish("vehicles/sensor/data/electric-vehicle-00001", b"payload-1")
+    pub.publish("vehicles/other/evt", b"not-mapped")
+    assert bridge.forwarded() >= 1
+    total = sum(len(stream.fetch("sensor-data", p, 0))
+                for p in range(10))
+    assert total == 1
+    msgs = [m for p in range(10) for m in stream.fetch("sensor-data", p, 0)]
+    assert msgs[0].value == b"payload-1"
+    assert msgs[0].key == b"vehicles/sensor/data/electric-vehicle-00001"
+
+
+# ------------------------------------------------------------- scenario
+def test_parse_helpers():
+    assert parse_rate("1/10s") == pytest.approx(0.1)
+    assert parse_rate("5/s") == pytest.approx(5.0)
+    assert expand_pattern("electric-vehicle-[0-9]{5}", 7) == \
+        "electric-vehicle-00007"
+
+
+def test_parse_reference_shaped_xml():
+    xml = """<?xml version="1.0"?>
+    <scenario>
+      <brokers><broker id="b1"><address>h</address><port>1883</port></broker></brokers>
+      <clientGroups>
+        <clientGroup id="cg1"><clientIdPattern>car-[0-9]{3}</clientIdPattern>
+          <count>10</count><mqttVersion>5</mqttVersion></clientGroup>
+      </clientGroups>
+      <topicGroups>
+        <topicGroup id="tg1"><topicNamePattern>vehicles/sensor/data/car-[0-9]{3}</topicNamePattern>
+          <count>10</count></topicGroup>
+      </topicGroups>
+      <subscriptions>
+        <subscription id="s1"><topicFilter>$share/consumers/vehicles/sensor/data/#</topicFilter></subscription>
+      </subscriptions>
+      <stages>
+        <stage id="st1">
+          <lifeCycle id="publ" clientGroup="cg1">
+            <rampUp duration="20s"/>
+            <publish topicGroup="tg1" qos="0" count="3" rate="1/10s"/>
+            <disconnect/>
+          </lifeCycle>
+        </stage>
+      </stages>
+    </scenario>"""
+    sc = parse_scenario(xml)
+    assert sc.client_groups["cg1"].count == 10
+    assert sc.stages[0].lifecycles[0].publish.rate_per_s == pytest.approx(0.1)
+    assert sc.stages[0].lifecycles[0].ramp_up_s == 20.0
+    assert sc.subscriptions[0].topic_filter.startswith("$share/")
+
+
+def test_scenario_run_to_training_batches():
+    """Full ingestion slice: scenario agents → MQTT → bridge → sensor-data
+    → KSQL-equivalent JSON→Avro → consumable training batches."""
+    from iotml.data.dataset import SensorBatches
+    from iotml.stream.consumer import StreamConsumer
+    from iotml.streamproc.tasks import JsonToAvro
+
+    mqtt = MqttBroker()
+    stream = Broker()
+    KafkaBridge(mqtt, stream, partitions=1)
+    runner = ScenarioRunner(EVALUATION_SCENARIO, mqtt)
+    summary = runner.run()
+    assert summary["published"] == 25 * 40
+    # shared consumer group saw every publish exactly once
+    assert summary["consumer-sub-1-shared"] == 25 * 40
+
+    task = JsonToAvro(stream, src="sensor-data", dst="SENSOR_DATA_S_AVRO")
+    assert task.process_available() == 1000
+    consumer = StreamConsumer(stream, ["SENSOR_DATA_S_AVRO:0:0"],
+                              group="test-mqtt-slice")
+    batches = list(SensorBatches(consumer, batch_size=100))
+    assert sum(b.n_valid for b in batches) == 1000
+    assert batches[0].x.shape == (100, 18)
+
+
+def test_wire_session_takeover_survives_old_teardown():
+    """A reconnect with the same client id must survive the stale
+    connection's teardown (identity-checked disconnect)."""
+    import time
+    broker = MqttBroker()
+    with MqttServer(broker) as srv:
+        got = []
+        c_old = MqttClient("127.0.0.1", srv.port, "dev")
+        c_new = MqttClient("127.0.0.1", srv.port, "dev",
+                           on_message=lambda t, p: got.append((t, p)))
+        c_new.subscribe("t/#")
+        c_old.disconnect()  # stale teardown must not kill c_new's session
+        time.sleep(0.1)
+        pub = MqttClient("127.0.0.1", srv.port, "pub")
+        pub.publish("t/x", b"alive", qos=1)
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.01)
+        assert got == [("t/x", b"alive")]
+        pub.disconnect()
+        c_new.disconnect()
+
+
+def test_wire_mqtt5_large_properties_varint():
+    """Properties blocks >=128 bytes use a multi-byte varint length; the
+    parser must skip them exactly (spec 2.2.2)."""
+    import struct
+    import time
+    from iotml.mqtt.wire import (PUBLISH, _mqtt_str, encode_varlen, packet)
+    broker = MqttBroker()
+    with MqttServer(broker) as srv:
+        got = []
+        sub = MqttClient("127.0.0.1", srv.port, "sub",
+                         on_message=lambda t, p: got.append((t, p)))
+        sub.subscribe("big/props")
+        pub = MqttClient("127.0.0.1", srv.port, "pub5", protocol_level=5)
+        # hand-build a level-5 PUBLISH with a 200-byte properties block
+        # (user property 0x26)
+        props = bytes([0x26]) + _mqtt_str("k" * 95) + _mqtt_str("v" * 98)
+        assert len(props) >= 128
+        body = _mqtt_str("big/props") + encode_varlen(len(props)) + props \
+            + b"payload"
+        pub._sock.sendall(packet(PUBLISH, 0, body))
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.01)
+        assert got == [("big/props", b"payload")]
+        pub.disconnect()
+        sub.disconnect()
+
+
+def test_scenario_tcp_transport_qos0_quiesce():
+    """qos-0 over real TCP: ping-barrier quiesce makes counts exact."""
+    import dataclasses as dc
+    from iotml.mqtt.scenario import (EVALUATION_SCENARIO, PublishSpec,
+                                     LifeCycle, Stage)
+    sc = dc.replace(
+        EVALUATION_SCENARIO,
+        client_groups={"cg1": dc.replace(
+            EVALUATION_SCENARIO.client_groups["cg1"], count=5)},
+        stages=[Stage("publish", [LifeCycle(
+            "publ", "cg1", connect=True,
+            publish=PublishSpec("tg1", qos=0, count=4, rate_per_s=1e9),
+            disconnect=True)])])
+    broker = MqttBroker()
+    with MqttServer(broker) as srv:
+        runner = ScenarioRunner(sc, broker, transport="tcp", port=srv.port)
+        summary = runner.run()
+    assert summary["published"] == 20
+    assert summary["consumer-sub-1-shared"] == 20
