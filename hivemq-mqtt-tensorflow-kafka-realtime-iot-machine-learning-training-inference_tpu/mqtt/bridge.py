@@ -17,6 +17,7 @@ broker, counting forwards under the reference's metric family name
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import List, Optional
 
@@ -53,6 +54,10 @@ class KafkaBridge:
             "kafka_extension_total_forwarded",
             "MQTT publishes bridged into the stream broker (reference "
             "family kafka_extension_*)")
+        # the registry counter is process-global (shared across bridges for
+        # scrape purposes); per-instance accounting needs its own counter
+        self._n_fwd = 0
+        self._n_lock = threading.Lock()
         for i, m in enumerate(self.mappings):
             # the reference provisions sensor-data with 10 partitions
             stream.create_topic(m.stream_topic, partitions=partitions)
@@ -63,10 +68,13 @@ class KafkaBridge:
                 self.stream.produce(_dest, payload, key=topic.encode(),
                                     timestamp_ms=int(time.time() * 1000))
                 self._m_fwd.inc()
+                with self._n_lock:
+                    self._n_fwd += 1
 
             mqtt.connect(cid, deliver, clean_start=True)
             for f in m.mqtt_topic_filters:
                 mqtt.subscribe(cid, f)
 
     def forwarded(self) -> int:
-        return int(self._m_fwd.value())
+        with self._n_lock:
+            return self._n_fwd

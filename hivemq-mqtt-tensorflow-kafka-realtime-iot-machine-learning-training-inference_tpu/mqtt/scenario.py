@@ -208,20 +208,50 @@ class ScenarioRunner:
 
     def _make_client(self, client_id: str, version: int):
         if self.transport == "tcp":
-            return MqttClient("127.0.0.1", self.port, client_id,
+            # explicit port= means "the local test server" and overrides the
+            # scenario's (typically cluster-internal) broker address too
+            if self.port is not None:
+                host, port = "127.0.0.1", self.port
+            else:
+                host = self.scenario.broker_address or "127.0.0.1"
+                port = self.scenario.broker_port
+            if port is None:
+                raise ValueError(
+                    "tcp transport needs a port: pass port= or set "
+                    "<broker><port> in the scenario")
+            return MqttClient(host, port, client_id,
                               protocol_level=4 if version < 5 else 5)
         return QueueClient(self.broker, client_id)
+
+    def _group_filters(self, tg, wildcard: bool):
+        """Subscription filters for a topic group.
+
+        wildcard=True collapses the group to one valid filter: every level
+        from the first one containing a pattern construct onward becomes a
+        single trailing '#' ('vehicles/sensor/data/electric-vehicle-[0-9]{5}'
+        → 'vehicles/sensor/data/#', the shape the reference's consumers use,
+        scenario.xml sub-1).  wildcard=False subscribes each expanded topic
+        of the group individually — the pattern itself is not a topic.
+        """
+        if wildcard:
+            levels = tg.pattern.split("/")
+            keep = []
+            for lv in levels:
+                if re.search(r"[\[\]{}()*+?\\]", lv):
+                    break
+                keep.append(lv)
+            return ["/".join(keep + ["#"]) if len(keep) < len(levels)
+                    else tg.pattern]
+        return [expand_pattern(tg.pattern, i) for i in range(tg.count)]
 
     def _attach_consumers(self):
         consumers = []
         for sub in self.scenario.subscriptions:
-            filt = sub.topic_filter
-            if filt is None and sub.topic_group:
+            filters = [sub.topic_filter] if sub.topic_filter else []
+            if not filters and sub.topic_group:
                 tg = self.scenario.topic_groups[sub.topic_group]
-                base = re.sub(r"\[0-9\]\{\d+\}.*$", "#", tg.pattern) \
-                    if sub.wildcard else tg.pattern
-                filt = base
-            if filt is None:
+                filters = self._group_filters(tg, sub.wildcard)
+            if not filters:
                 continue
             cid = f"consumer-{sub.id}"
             self.consumer_counts[cid] = 0
@@ -231,7 +261,8 @@ class ScenarioRunner:
                     self.consumer_counts[_cid] += 1
 
             self.broker.connect(cid, deliver)
-            self.broker.subscribe(cid, filt)
+            for filt in filters:
+                self.broker.subscribe(cid, filt)
             consumers.append(cid)
         return consumers
 
@@ -256,7 +287,10 @@ class ScenarioRunner:
                                              cg.mqtt_version)
                            for i in range(cg.count)]
                 self._m_conn.inc(cg.count)
-                topics = [expand_pattern(tg.pattern, i)
+                # agents wrap around the topic group's declared size — a
+                # client group larger than the topic group must not invent
+                # topics its subscribers never declared
+                topics = [expand_pattern(tg.pattern, i % tg.count)
                           for i in range(cg.count)]
                 for tick in range(lc.publish.count):
                     cols = gen.step_columns()

@@ -112,6 +112,16 @@ def subscribe_packet(packet_id: int, filters: List[Tuple[str, int]],
     return packet(SUBSCRIBE, 0x02, body)
 
 
+def _recv_exact(sock, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
 # ------------------------------------------------------------------ server
 class _Conn(socketserver.BaseRequestHandler):
     """One MQTT connection.  The handler loop reads packets and mutates the
@@ -119,13 +129,7 @@ class _Conn(socketserver.BaseRequestHandler):
     write lock (broker fan-out may run on other publishers' threads)."""
 
     def _read_exact(self, n: int) -> bytes:
-        buf = b""
-        while len(buf) < n:
-            chunk = self.request.recv(n - len(buf))
-            if not chunk:
-                raise ConnectionError("peer closed")
-            buf += chunk
-        return buf
+        return _recv_exact(self.request, n)
 
     def _send(self, data: bytes) -> None:
         with self._wlock:
@@ -216,6 +220,11 @@ class _Conn(socketserver.BaseRequestHandler):
                     break
         except (ConnectionError, OSError):
             pass
+        except (ValueError, struct.error):
+            # protocol violation (wildcard PUBLISH topic, malformed
+            # varint/short body): MQTT says drop the connection — without
+            # letting socketserver dump a traceback per bad client
+            pass
         finally:
             if client_id is not None:
                 # identity-checked: a session taken over by a newer
@@ -264,6 +273,7 @@ class MqttClient:
         self._on_message = on_message
         self._acks: Dict[int, threading.Event] = {}
         self._suback = threading.Event()
+        self._suback_codes: List[int] = []
         self._pingresp = threading.Event()
         self._next_pid = 0
         self._wlock = threading.Lock()
@@ -272,17 +282,14 @@ class MqttClient:
         h, body = self._read_packet()
         if h >> 4 != CONNACK:
             raise ConnectionError(f"expected CONNACK, got {h >> 4}")
+        # the connect timeout must not survive into the reader thread: an
+        # idle subscriber would hit recv timeout after 10s and die silently
+        self._sock.settimeout(None)
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
 
     def _read_exact(self, n: int) -> bytes:
-        buf = b""
-        while len(buf) < n:
-            chunk = self._sock.recv(n - len(buf))
-            if not chunk:
-                raise ConnectionError("server closed")
-            buf += chunk
-        return buf
+        return _recv_exact(self._sock, n)
 
     def _read_packet(self) -> Tuple[int, bytes]:
         (h,) = self._read_exact(1)
@@ -313,6 +320,10 @@ class MqttClient:
                     if ev:
                         ev.set()
                 elif ptype == SUBACK:
+                    pos = 2  # packet id
+                    if self._level >= 5:
+                        pos = _skip_props(body, pos)
+                    self._suback_codes = list(body[pos:])
                     self._suback.set()
                 elif ptype == PINGRESP:
                     self._pingresp.set()
@@ -345,6 +356,10 @@ class MqttClient:
                                                 self._level))
         if not self._suback.wait(timeout):
             raise TimeoutError("no SUBACK")
+        codes = getattr(self, "_suback_codes", [])
+        if any(c >= 0x80 for c in codes):
+            raise ValueError(
+                f"server rejected subscription {filter_!r} (SUBACK {codes})")
 
     def ping(self, timeout: float = 10.0) -> None:
         """PINGREQ/PINGRESP round-trip.  Because the server processes each

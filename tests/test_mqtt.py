@@ -157,13 +157,17 @@ def test_bridge_topic_mapping():
     pub = QueueClient(mqtt, "car")
     pub.publish("vehicles/sensor/data/electric-vehicle-00001", b"payload-1")
     pub.publish("vehicles/other/evt", b"not-mapped")
-    assert bridge.forwarded() >= 1
+    assert bridge.forwarded() == 1
     total = sum(len(stream.fetch("sensor-data", p, 0))
                 for p in range(10))
     assert total == 1
     msgs = [m for p in range(10) for m in stream.fetch("sensor-data", p, 0)]
     assert msgs[0].value == b"payload-1"
     assert msgs[0].key == b"vehicles/sensor/data/electric-vehicle-00001"
+    # per-instance accounting: a second bridge on fresh brokers starts at 0
+    bridge2 = KafkaBridge(MqttBroker(), Broker(), partitions=1)
+    assert bridge2.forwarded() == 0
+    assert bridge.forwarded() == 1
 
 
 # ------------------------------------------------------------- scenario
@@ -301,3 +305,122 @@ def test_scenario_tcp_transport_qos0_quiesce():
         summary = runner.run()
     assert summary["published"] == 20
     assert summary["consumer-sub-1-shared"] == 20
+
+
+def test_topic_group_wildcard_subscription_runs():
+    """sub via <topicGroup> + <wildCard>true</wildCard> — the reference's
+    scenario.xml sub-1 shape — must derive a *valid* filter
+    ('vehicles/sensor/data/#') and count every publish; regression for the
+    invalid 'electric-vehicle-#' partial-level filter."""
+    xml = """<?xml version="1.0"?>
+    <scenario>
+      <clientGroups>
+        <clientGroup id="cg1"><clientIdPattern>car-[0-9]{2}</clientIdPattern>
+          <count>5</count></clientGroup>
+      </clientGroups>
+      <topicGroups>
+        <topicGroup id="tg1"><topicNamePattern>vehicles/sensor/data/car-[0-9]{2}</topicNamePattern>
+          <count>5</count></topicGroup>
+      </topicGroups>
+      <subscriptions>
+        <subscription id="s1"><topicGroup>tg1</topicGroup><wildCard>true</wildCard></subscription>
+        <subscription id="s2"><topicGroup>tg1</topicGroup><wildCard>false</wildCard></subscription>
+      </subscriptions>
+      <stages>
+        <stage id="st1">
+          <lifeCycle id="publ" clientGroup="cg1">
+            <publish topicGroup="tg1" qos="0" count="4"/>
+            <disconnect/>
+          </lifeCycle>
+        </stage>
+      </stages>
+    </scenario>"""
+    sc = parse_scenario(xml)
+    runner = ScenarioRunner(sc, MqttBroker())
+    summary = runner.run()
+    assert summary["published"] == 20
+    assert summary["consumer-s1"] == 20  # wildcard collapse
+    assert summary["consumer-s2"] == 20  # per-topic expansion
+
+
+def test_wire_subscribe_rejected_raises():
+    """A server-side 0x80 SUBACK code must surface as an error, not silent
+    no-delivery."""
+    broker = MqttBroker()
+    with MqttServer(broker) as srv:
+        c = MqttClient("127.0.0.1", srv.port, "c1")
+        try:
+            with pytest.raises(ValueError, match="rejected"):
+                c.subscribe("a/#/b")  # '#' not final ⇒ invalid filter
+            c.subscribe("a/#")  # valid one still works after the rejection
+        finally:
+            c.disconnect()
+
+
+def test_wire_client_clears_connect_timeout():
+    """The 10s connect timeout must not persist onto the reader socket —
+    an idle subscriber's reader thread would die on recv timeout."""
+    broker = MqttBroker()
+    with MqttServer(broker) as srv:
+        c = MqttClient("127.0.0.1", srv.port, "idle")
+        try:
+            assert c._sock.gettimeout() is None
+            assert c._reader.is_alive()
+        finally:
+            c.disconnect()
+
+
+def test_wire_server_survives_protocol_violation():
+    """A wildcard PUBLISH topic is a protocol error: the offender is
+    dropped without a stderr traceback and the server keeps serving."""
+    broker = MqttBroker()
+    with MqttServer(broker) as srv:
+        bad = MqttClient("127.0.0.1", srv.port, "bad")
+        bad.publish("a/+/b", b"x", qos=0)  # server drops the connection
+        import time
+        deadline = time.time() + 5
+        while bad._reader.is_alive() and time.time() < deadline:
+            time.sleep(0.01)
+        assert not bad._reader.is_alive()
+        # a fresh client still gets full service
+        got = []
+        ok = MqttClient("127.0.0.1", srv.port, "ok",
+                        on_message=lambda t, p: got.append(p))
+        ok.subscribe("a/#")
+        ok.publish("a/b", b"fine", qos=1)
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.01)
+        assert got == [b"fine"]
+        ok.disconnect()
+
+
+def test_scenario_topic_group_smaller_than_client_group():
+    """Agents must wrap onto the topic group's declared topics (i % count),
+    not invent undeclared ones that bypass the group's subscribers."""
+    xml = """<?xml version="1.0"?>
+    <scenario>
+      <clientGroups>
+        <clientGroup id="cg1"><clientIdPattern>car-[0-9]{2}</clientIdPattern>
+          <count>10</count></clientGroup>
+      </clientGroups>
+      <topicGroups>
+        <topicGroup id="tg1"><topicNamePattern>v/s/d/car-[0-9]{2}</topicNamePattern>
+          <count>5</count></topicGroup>
+      </topicGroups>
+      <subscriptions>
+        <subscription id="s2"><topicGroup>tg1</topicGroup><wildCard>false</wildCard></subscription>
+      </subscriptions>
+      <stages>
+        <stage id="st1">
+          <lifeCycle id="publ" clientGroup="cg1">
+            <publish topicGroup="tg1" qos="0" count="2"/>
+            <disconnect/>
+          </lifeCycle>
+        </stage>
+      </stages>
+    </scenario>"""
+    sc = parse_scenario(xml)
+    summary = ScenarioRunner(sc, MqttBroker()).run()
+    assert summary["published"] == 20
+    assert summary["consumer-s2"] == 20  # nothing bypasses the group
