@@ -15,9 +15,13 @@ as an idiomatic JAX/XLA/Flax/Pallas stack:
 - ``serve``      long-lived jit scorer with ordered write-back
 - ``parallel``   device mesh, data/tensor sharding, multi-host init
 - ``gen``        car-fleet load generator (scenario-driven, failure modes)
-- ``obs``        metrics registry (Prometheus text format) + TensorBoard scalars
-- ``cli``        reference-compatible entry points
-- ``utils``      config system, host buffers, misc
+- ``obs``        metrics registry (Prometheus text) + TensorBoard + generated Grafana dashboards
+- ``cli``        reference-compatible entry points (cardata, lstm, creditcard, mnist_smoke)
+- ``mqtt``       MQTT 5 broker/wire/bridge + scenario-driven device fleet
+- ``connect``    connector runtime (file source, document sink, Avro data lake)
+- ``evaluate``   anomaly eval: ROC/AUC, precision-recall, threshold confusion
+- ``config``     one typed config tree (defaults < file < env < flags)
+- ``utils``      host buffers, misc
 
 The package directory on disk is
 ``hivemq-mqtt-tensorflow-kafka-realtime-iot-machine-learning-training-inference_tpu``;

@@ -1,0 +1,147 @@
+"""Shared scaffolding for the reference-contract streaming CLIs.
+
+Both reference ML apps (`cardata-v3.py`, LSTM `cardata-v2.py`) are the same
+program with a different model: positional args, a train mode that fits on
+a stream slice and uploads the checkpoint, and a predict mode that restores
+it and writes ordered predictions back.  `run_streaming_app` is that
+program once; `cli.cardata` and `cli.lstm` supply the model and knobs.
+
+The typed config layer (`iotml.config`) fronts the positional contract:
+`--section.field=...` flags and `IOTML_*` env vars override an app's
+defaults (epochs, batch size, topics, SASL credentials for the wire
+client), and positionals pass through untouched — so the reference's K8s
+manifests work verbatim while everything stays configurable without code
+edits.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+from typing import Callable, Optional
+
+
+def _broker_for(servers: str, topic: str, cfg) -> object:
+    """Resolve <servers>: 'emulator[:n]' seeds an in-process broker with
+    generated fleet data; 'host:port[,...]' speaks the Kafka wire protocol
+    (stream.kafka_wire) to a real cluster or the framework's wire server."""
+    from ..stream.broker import Broker
+
+    if servers.startswith("emulator"):
+        n = int(servers.split(":", 1)[1]) if ":" in servers else 30_000
+        from ..gen.simulator import FleetGenerator, FleetScenario
+
+        broker = Broker()
+        gen = FleetGenerator(FleetScenario(num_cars=100, failure_rate=0.01))
+        gen.publish(broker, topic, n_ticks=max(1, n // 100))
+        broker.create_topic("model-predictions")
+        return broker
+    from ..stream.kafka_wire import KafkaWireBroker
+
+    return KafkaWireBroker(servers,
+                           sasl_username=cfg.broker.sasl_username or None,
+                           sasl_password=cfg.broker.sasl_password or None)
+
+
+def run_streaming_app(argv, *, prog: str, usage: str, make_model: Callable,
+                      group: str, epochs: int, batch_size: int,
+                      take_batches: int, predict_skip: int,
+                      predict_take: int, supervised: bool = False,
+                      window: Optional[int] = None) -> int:
+    from ..config import load_config
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    try:
+        cfg, argv = load_config(argv)
+    except ValueError as e:
+        print(f"config error: {e}")
+        return 1
+    print("Options: ", argv)
+    if len(argv) != 7:
+        print(usage)
+        return 1
+    servers, topic, offset, result_topic, mode, model_file, artifact_root = argv
+    mode = mode.strip().lower()
+    if mode not in ("train", "predict"):
+        print(f"Mode is invalid, must be either 'train' or 'predict': {mode}")
+        return 1
+    offset = int(offset)
+
+    applied = getattr(cfg, "applied", set())
+    if "train.epochs" in applied:
+        epochs = cfg.train.epochs
+    if "train.batch_size" in applied:
+        batch_size = cfg.train.batch_size
+    if "train.take_batches" in applied:
+        take_batches = cfg.train.take_batches
+
+    from ..data.dataset import SensorBatches
+    from ..stream.consumer import StreamConsumer
+    from ..train.artifacts import ArtifactStore
+    from ..train.checkpoint import CheckpointManager
+    from ..train.loop import Trainer
+
+    broker = _broker_for(servers, topic, cfg)
+    store = ArtifactStore(artifact_root)
+    consumer = StreamConsumer(broker, [f"{topic}:0:{offset}"], group=group)
+    model = make_model()
+
+    # an explicitly-configured mesh (IOTML_MESH_* / --mesh.*) means the
+    # operator reserved multiple chips: train sharded over a ('data',
+    # 'model') mesh instead of single-device
+    use_mesh = bool({"mesh.data", "mesh.model"} & applied)
+    if use_mesh:
+        import jax
+
+        from ..parallel.data_parallel import ShardedTrainer
+        from ..parallel.mesh import auto_mesh
+
+        model_par = max(cfg.mesh.model, 1)
+        n_dev = len(jax.devices()) if cfg.mesh.data in (-1, 0) \
+            else cfg.mesh.data * model_par
+        mesh = auto_mesh(n_dev, model_parallel=model_par)
+        print(f"mesh: {dict(mesh.shape)} over {n_dev} devices")
+        trainer = ShardedTrainer(model, mesh, supervised=supervised,
+                                 learning_rate=cfg.train.learning_rate)
+    else:
+        trainer = Trainer(model, supervised=supervised,
+                          learning_rate=cfg.train.learning_rate)
+
+    if mode == "train":
+        batches = SensorBatches(consumer, batch_size=batch_size,
+                                take=take_batches, window=window,
+                                only_normal=not supervised and
+                                cfg.train.only_normal)
+        history = trainer.fit(batches, epochs=epochs) if use_mesh \
+            else trainer.fit_compiled(batches, epochs=epochs)
+        print(f"Training complete, final loss {history['loss'][-1]:.6f}")
+        # unique dir: concurrent jobs on one host must not trample each other
+        ckpt_dir = tempfile.mkdtemp(prefix=f"iotml_{prog}_ckpt_")
+        mgr = CheckpointManager(ckpt_dir)
+        path = mgr.save(trainer.state, cursors=consumer.positions())
+        store.upload_tree(path, model_file)
+        print("Model stored successfully", model_file)
+        return 0
+
+    # predict
+    print("Downloading model", model_file)
+    local = os.path.join(tempfile.mkdtemp(prefix=f"iotml_{prog}_restore_"),
+                         "ckpt")
+    store.download_tree(model_file, local)
+    import orbax.checkpoint as ocp
+
+    payload = ocp.PyTreeCheckpointer().restore(local)
+    print("Loading model")
+    from ..serve.scorer import StreamScorer
+    from ..stream.producer import OutputSequence
+
+    batches = SensorBatches(consumer, batch_size=batch_size,
+                            window=window, skip=predict_skip,
+                            take=predict_take)
+    out = OutputSequence(broker, result_topic, partition=0)
+    scorer = StreamScorer(model, payload["params"], batches, out)
+    n = scorer.score_available()
+    print(f"predict complete: {n} records → {result_topic} "
+          f"(end offset {broker.end_offset(result_topic, 0)})")
+    return 0

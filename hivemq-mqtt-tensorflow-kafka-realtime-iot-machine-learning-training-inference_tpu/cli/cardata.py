@@ -1,13 +1,15 @@
-"""Reference-compatible CLI for the car-sensor train/predict jobs.
+"""Reference-compatible CLI for the car-sensor autoencoder train/predict jobs.
 
 The reference entry point is
 `python3 cardata-v3.py <servers> <topic> <offset> <result_topic> <mode>
 <model-file> <project>` (cardata-v3.py:24-37).  This CLI keeps that
 positional contract (so the reference's K8s manifests translate 1:1) with
-one extension: `<servers>` may be `emulator[:n_records]` to run against the
-in-process broker with generated fleet data — the cluster-free path used by
-tests, demos and benches.  `<project>` becomes the artifact-store root
-(local dir or gs:// bucket), replacing the hard-coded GCS bucket scheme.
+two extensions: `<servers>` may be `emulator[:n_records]` for the
+in-process broker with generated fleet data (the cluster-free path used by
+tests, demos and benches) or `host:port` for a Kafka-wire-protocol broker;
+and `--section.field=...` flags / `IOTML_*` env override the reference's
+hard-coded knobs (see `iotml.config`).  `<project>` becomes the
+artifact-store root (local dir or gs:// bucket).
 
 Train mode mirrors cardata-v3 exactly: filter label=="false", batch 100,
 take 100 batches, 20 epochs, then store the model.  Predict mode loads the
@@ -17,8 +19,7 @@ stored model, scores batches 100..200, and writes np.array2string rows to
 
 from __future__ import annotations
 
-import os
-import sys
+from ._app import _broker_for, run_streaming_app  # noqa: F401 (re-export)
 
 NB_EPOCH = 20
 BATCH_SIZE = 100
@@ -30,83 +31,18 @@ USAGE = ("usage: python -m iotml.cli.cardata <servers> <topic> <offset> "
          "  servers: emulator[:n_records] | host:port[,host:port...]")
 
 
-def _broker_for(servers: str, topic: str, offset: int):
-    """Resolve <servers>: the emulator scheme seeds an in-process broker;
-    anything else requires the native Kafka client (not yet wired — the
-    C++ data plane lands in cpp/stream)."""
-    from ..stream.broker import Broker
+def _make_model():
+    from ..models.autoencoder import CAR_AUTOENCODER
 
-    if servers.startswith("emulator"):
-        n = int(servers.split(":", 1)[1]) if ":" in servers else 30_000
-        from ..gen.simulator import FleetGenerator, FleetScenario
-
-        broker = Broker()
-        gen = FleetGenerator(FleetScenario(num_cars=100, failure_rate=0.01))
-        gen.publish(broker, topic, n_ticks=max(1, n // 100))
-        broker.create_topic("model-predictions")
-        return broker
-    raise SystemExit(
-        f"servers={servers!r}: external Kafka requires the native stream "
-        f"engine (cpp/stream); use 'emulator[:n]' for the in-process broker")
+    return CAR_AUTOENCODER
 
 
 def main(argv=None) -> int:
-    argv = list(sys.argv[1:] if argv is None else argv)
-    print("Options: ", argv)
-    if len(argv) != 7:
-        print(USAGE)
-        return 1
-    servers, topic, offset, result_topic, mode, model_file, artifact_root = argv
-    mode = mode.strip().lower()
-    if mode not in ("train", "predict"):
-        print(f"Mode is invalid, must be either 'train' or 'predict': {mode}")
-        return 1
-    offset = int(offset)
-
-    from ..data.dataset import SensorBatches
-    from ..models.autoencoder import CAR_AUTOENCODER
-    from ..stream.consumer import StreamConsumer
-    from ..train.artifacts import ArtifactStore
-    from ..train.checkpoint import CheckpointManager
-    from ..train.loop import Trainer
-
-    broker = _broker_for(servers, topic, offset)
-    store = ArtifactStore(artifact_root)
-    consumer = StreamConsumer(broker, [f"{topic}:0:{offset}"],
-                              group="cardata-autoencoder")
-    trainer = Trainer(CAR_AUTOENCODER)
-
-    if mode == "train":
-        batches = SensorBatches(consumer, batch_size=BATCH_SIZE,
-                                take=TAKE_BATCHES, only_normal=True)
-        history = trainer.fit_compiled(batches, epochs=NB_EPOCH)
-        print(f"Training complete, final loss {history['loss'][-1]:.6f}")
-        ckpt_dir = os.path.join("/tmp", "iotml_cli_ckpt")
-        mgr = CheckpointManager(ckpt_dir)
-        path = mgr.save(trainer.state, cursors=consumer.positions())
-        store.upload_tree(path, model_file)
-        print("Model stored successfully", model_file)
-        return 0
-
-    # predict
-    print("Downloading model", model_file)
-    local = os.path.join("/tmp", "iotml_cli_restore")
-    store.download_tree(model_file, local)
-    import orbax.checkpoint as ocp
-
-    payload = ocp.PyTreeCheckpointer().restore(local)
-    print("Loading model")
-    from ..serve.scorer import StreamScorer
-    from ..stream.producer import OutputSequence
-
-    batches = SensorBatches(consumer, batch_size=BATCH_SIZE,
-                            skip=PREDICT_SKIP, take=TAKE_BATCHES)
-    out = OutputSequence(broker, result_topic, partition=0)
-    scorer = StreamScorer(CAR_AUTOENCODER, payload["params"], batches, out)
-    n = scorer.score_available()
-    print(f"predict complete: {n} records → {result_topic} "
-          f"(end offset {broker.end_offset(result_topic, 0)})")
-    return 0
+    return run_streaming_app(
+        argv, prog="cardata", usage=USAGE, make_model=_make_model,
+        group="cardata-autoencoder", epochs=NB_EPOCH, batch_size=BATCH_SIZE,
+        take_batches=TAKE_BATCHES, predict_skip=PREDICT_SKIP,
+        predict_take=TAKE_BATCHES)
 
 
 if __name__ == "__main__":

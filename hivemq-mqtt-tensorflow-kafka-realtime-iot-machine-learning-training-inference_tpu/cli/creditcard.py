@@ -67,7 +67,10 @@ def run(argv=None) -> dict:
         trainer = Trainer(CREDITCARD_AUTOENCODER)
         history = trainer.fit_compiled(train_batches, epochs=args.epochs)
 
-        # score the *whole* stream (frauds included) for the eval report
+        # score the *whole* stream (frauds included) with the TRAINING
+        # moments frozen — eval must see the same scale the model trained on
+        if scaler is not None:
+            scaler.freeze()
         eval_batches = CreditcardBatches(
             StreamConsumer(broker, [f"{args.topic}:0:0"], group="creditcard-eval"),
             batch_size=args.batch_size, scaler=scaler)

@@ -1,0 +1,49 @@
+"""LSTM streaming app — the reference's supervised next-step predictor CLI.
+
+Positional contract mirrors `LSTM-TensorFlow-IO-Kafka/cardata-v2.py`
+(same shape as the autoencoder v3 CLI: servers topic offset result_topic
+mode model-file artifact-root), with `emulator[:n]` standing in for a
+cluster, like `cli.cardata`.
+
+Reference semantics kept (LSTM cardata-v1.py:165-200, v2 adds mode+GCS):
+windows of `look_back` consecutive records with the next record as target
+(window(look_back, shift=1) + skip(look_back)), MSE loss, 5 epochs; predict
+mode loads the stored model and writes next-step predictions to the result
+topic in stream order.  The TPU translation re-batches the reference's
+pathological batch=1 into [B, T, F] windows (SURVEY §7 hard part (f)) —
+same objective, same architecture, accelerator-sane shapes.
+"""
+
+from __future__ import annotations
+
+from ._app import run_streaming_app
+
+NB_EPOCH = 5
+BATCH_SIZE = 64       # reference trains batch=1; re-batched for the MXU
+LOOK_BACK = 1
+TRAIN_TAKE = 1000     # reference: 1000 train steps (batch 1) = 1000 windows
+PREDICT_TAKE = 200    # reference: 200 predict steps
+
+USAGE = ("usage: python -m iotml.cli.lstm <servers> <topic> <offset> "
+         "<result_topic> <mode:train|predict> <model-file> <artifact-root>\n"
+         "  servers: emulator[:n_records] | host:port[,host:port...]")
+
+
+def _make_model():
+    from ..models.lstm import LSTMSeq2Seq
+
+    return LSTMSeq2Seq(features=18, look_back=LOOK_BACK)
+
+
+def main(argv=None) -> int:
+    n_batches = max(1, TRAIN_TAKE // BATCH_SIZE)
+    return run_streaming_app(
+        argv, prog="lstm", usage=USAGE, make_model=_make_model,
+        group="cardata-lstm", epochs=NB_EPOCH, batch_size=BATCH_SIZE,
+        take_batches=n_batches, predict_skip=n_batches,
+        predict_take=max(1, PREDICT_TAKE // BATCH_SIZE),
+        supervised=True, window=LOOK_BACK)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,88 @@
+"""Long-lived continuous scorer — the serve-side entry point.
+
+The reference's inference Deployment scores a fixed slice, exits, and lets
+Kubernetes restart the pod forever — called out by its own docs as "not an
+ideal architecture … Python batch style" (reference
+python-scripts/README.md:24).  This CLI is the fix the reference wishes
+for, and what `deploy/model-predictions.yaml` actually runs: restore the
+model once, then poll the stream indefinitely, scoring what arrives and
+writing ordered predictions back, with consumer-group offset commits so a
+crash (or pod reschedule) resumes exactly where it stopped.
+
+    python -m iotml.cli.serve <servers> <topic> <offset|committed>
+        <result_topic> <model-file> <artifact-root>
+
+`offset` may be `committed` to resume from the consumer group's last
+committed position (fresh start at 0 if none).  `--serve.*` flags / env
+tune polling and the anomaly threshold (see `iotml.config`).
+"""
+
+from __future__ import annotations
+
+import sys
+import tempfile
+
+USAGE = ("usage: python -m iotml.cli.serve <servers> <topic> "
+         "<offset|committed> <result_topic> <model-file> <artifact-root>\n"
+         "  servers: emulator[:n_records] | host:port[,host:port...]")
+
+GROUP = "iotml-serve"
+
+
+def main(argv=None, max_rounds=None) -> int:
+    """max_rounds bounds the forever-loop for tests; None = run forever."""
+    from ..config import load_config
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    try:
+        cfg, argv = load_config(argv)
+    except ValueError as e:
+        print(f"config error: {e}")
+        return 1
+    print("Options: ", argv)
+    if len(argv) != 6:
+        print(USAGE)
+        return 1
+    servers, topic, offset, result_topic, model_file, artifact_root = argv
+
+    from ._app import _broker_for
+    from ..data.dataset import SensorBatches
+    from ..serve.scorer import StreamScorer
+    from ..stream.consumer import StreamConsumer
+    from ..stream.producer import OutputSequence
+    from ..train.artifacts import ArtifactStore
+
+    broker = _broker_for(servers, topic, cfg)
+    store = ArtifactStore(artifact_root)
+
+    print("Downloading model", model_file)
+    local = tempfile.mkdtemp(prefix="iotml_serve_") + "/ckpt"
+    store.download_tree(model_file, local)
+    import orbax.checkpoint as ocp
+
+    payload = ocp.PyTreeCheckpointer().restore(local)
+
+    if offset == "committed":
+        consumer = StreamConsumer.from_committed(
+            broker, topic, [0], group=GROUP, eof=False)
+    else:
+        consumer = StreamConsumer(broker, [f"{topic}:0:{int(offset)}"],
+                                  group=GROUP, eof=False)
+
+    from ..models.autoencoder import CAR_AUTOENCODER
+
+    threshold = getattr(cfg.serve, "threshold", 0.0) or None
+    batches = SensorBatches(consumer, batch_size=cfg.train.batch_size)
+    out = OutputSequence(broker, result_topic, partition=0)
+    scorer = StreamScorer(CAR_AUTOENCODER, payload["params"], batches, out,
+                          threshold=threshold)
+    print(f"serving: polling {topic} every {cfg.serve.poll_interval_s}s "
+          f"→ {result_topic}")
+    scorer.run_forever(poll_interval_s=cfg.serve.poll_interval_s,
+                       max_rounds=max_rounds)
+    print(f"serve loop exited after scoring {scorer.scored} records")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

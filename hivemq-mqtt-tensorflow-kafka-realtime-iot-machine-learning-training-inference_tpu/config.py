@@ -1,0 +1,222 @@
+"""One typed configuration system for the whole framework.
+
+The reference spreads configuration across five uncoordinated layers —
+positional sys.argv CLIs, env-var defaults in shell scripts, Terraform
+variables, Helm values, and two XML dialects — with the SASL credentials
+repeated verbatim in three of them (SURVEY §5, reference cardata-v3.py:7-15,
+gcp.yaml:29-32, kafka-config.yaml:12-17).  Here every knob lives in one
+dataclass tree with one resolution order:
+
+    defaults  <  config file (JSON)  <  environment  <  CLI flags
+
+Environment keys: ``IOTML_<SECTION>_<FIELD>`` (e.g. ``IOTML_TRAIN_EPOCHS``).
+CLI flags: ``--<section>.<field>=<value>`` (e.g. ``--train.epochs=20``).
+Values are coerced to the dataclass field's type, so a typo'd type fails
+loudly at load time instead of deep inside a job.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple, get_args, get_origin
+
+
+# --------------------------------------------------------------- sections
+@dataclasses.dataclass
+class BrokerConfig:
+    """Stream-broker connection (the reference's Kafka client config)."""
+
+    servers: str = "emulator"     # emulator[:n] | host:port,...
+    sasl_username: str = ""       # reference: hard-coded 'test' — never again
+    sasl_password: str = ""
+    partitions: int = 10          # reference topic provisioning
+    retention_messages: int = 0   # 0 = unbounded (reference: retention.ms)
+
+
+@dataclasses.dataclass
+class StreamConfig:
+    """Topics and cursor — the reference CLI's positional args."""
+
+    topic: str = "SENSOR_DATA_S_AVRO"
+    result_topic: str = "model-predictions"
+    offset: int = 0
+    group: str = "cardata-autoencoder"
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """The reference train job's knobs (cardata-v3.py:176-218)."""
+
+    epochs: int = 20
+    batch_size: int = 100
+    take_batches: int = 100
+    learning_rate: float = 1e-3
+    only_normal: bool = True
+    model: str = "autoencoder"    # autoencoder | lstm | sensorformer
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Continuous scorer (fixes the restart-the-pod loop)."""
+
+    skip_batches: int = 100
+    take_batches: int = 100
+    poll_interval_s: float = 0.5
+    checkpoint_every_batches: int = 50
+    threshold: float = 0.0   # >0: append anomaly verdicts (notebook thr 5)
+
+
+@dataclasses.dataclass
+class ArtifactConfig:
+    root: str = "/tmp/iotml-artifacts"   # dir or gs:// bucket
+    model_file: str = "model1"
+
+
+@dataclasses.dataclass
+class ScenarioConfig:
+    """Fleet load generation (the XML scenario dialect, typed)."""
+
+    num_cars: int = 25
+    msgs_per_car: int = 40
+    interval_s: float = 5.0
+    ramp_up_s: float = 5.0
+    qos: int = 1
+    failure_rate: float = 0.01
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Device-mesh shape for pjit (data/model/sequence axes)."""
+
+    data: int = -1      # -1 = all devices on the data axis
+    model: int = 1
+    seq: int = 1
+
+
+@dataclasses.dataclass
+class Config:
+    broker: BrokerConfig = dataclasses.field(default_factory=BrokerConfig)
+    stream: StreamConfig = dataclasses.field(default_factory=StreamConfig)
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+    artifacts: ArtifactConfig = dataclasses.field(default_factory=ArtifactConfig)
+    scenario: ScenarioConfig = dataclasses.field(default_factory=ScenarioConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def dumps(self) -> str:
+        return json.dumps(self.as_dict(), indent=2, sort_keys=True)
+
+
+# -------------------------------------------------------------- resolution
+def _coerce(value: Any, typ: type, where: str) -> Any:
+    if get_origin(typ) is not None:  # Optional[...] etc.
+        args = [a for a in get_args(typ) if a is not type(None)]
+        if len(args) == 1:
+            if value is None:
+                return None
+            typ = args[0]
+    if isinstance(value, typ) and not (typ is int and isinstance(value, bool)):
+        return value
+    if typ is bool:
+        if isinstance(value, str):
+            low = value.strip().lower()
+            if low in ("1", "true", "yes", "on"):
+                return True
+            if low in ("0", "false", "no", "off"):
+                return False
+        raise ValueError(f"{where}: cannot parse {value!r} as bool")
+    try:
+        return typ(value)
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"{where}: cannot parse {value!r} as "
+                         f"{typ.__name__}") from e
+
+
+def _apply(cfg: Any, dotted: str, value: Any,
+           applied: Optional[set] = None) -> None:
+    section, _, field = dotted.partition(".")
+    if not field:
+        raise ValueError(f"config key {dotted!r}: expected section.field")
+    if not hasattr(cfg, section):
+        raise ValueError(f"unknown config section {section!r} "
+                         f"(have: {[f.name for f in dataclasses.fields(cfg)]})")
+    sub = getattr(cfg, section)
+    flds = {f.name: f for f in dataclasses.fields(sub)}
+    if field not in flds:
+        raise ValueError(f"unknown config key {dotted!r} "
+                         f"(section {section!r} has: {sorted(flds)})")
+    typ = flds[field].type
+    if isinstance(typ, str):  # from __future__ annotations
+        typ = {"int": int, "float": float, "str": str, "bool": bool}.get(typ, str)
+    setattr(sub, field, _coerce(value, typ, dotted))
+    if applied is not None:
+        applied.add(dotted)
+
+
+def load_config(argv: Optional[Sequence[str]] = None,
+                env: Optional[Dict[str, str]] = None,
+                path: Optional[str] = None) -> Tuple[Config, List[str]]:
+    """Resolve a Config. Returns (config, leftover_argv).
+
+    argv: flags of the form --section.field=value (or --section.field value);
+      anything else is passed through in leftover_argv (so positional CLIs
+      keep working in front of this).
+    env: mapping (defaults to os.environ); keys IOTML_<SECTION>_<FIELD>.
+    path: JSON config file; also honors env IOTML_CONFIG.
+    """
+    cfg = Config()
+    applied: set = set()
+    env = dict(os.environ if env is None else env)
+
+    path = path or env.get("IOTML_CONFIG")
+    if path:
+        with open(path) as fh:
+            doc = json.load(fh)
+        for section, sub in doc.items():
+            if not isinstance(sub, dict):
+                raise ValueError(f"config file {path}: section {section!r} "
+                                 f"must be an object")
+            for field, value in sub.items():
+                _apply(cfg, f"{section}.{field}", value, applied)
+
+    sections = {f.name for f in dataclasses.fields(cfg)}
+    for key, value in env.items():
+        if not key.startswith("IOTML_") or key == "IOTML_CONFIG":
+            continue
+        rest = key[len("IOTML_"):].lower()
+        section, _, field = rest.partition("_")
+        if section not in sections:
+            # an IOTML_-prefixed var is an explicit instruction to this
+            # process — a typo'd section must fail as loudly as a typo'd
+            # field, not silently fall back to the default
+            raise ValueError(f"env {key}: unknown config section "
+                             f"{section!r} (have: {sorted(sections)})")
+        _apply(cfg, f"{section}.{field}", value, applied)
+
+    leftover: List[str] = []
+    argv = list(argv or [])
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a.startswith("--") and "." in a:
+            body = a[2:]
+            if "=" in body:
+                dotted, value = body.split("=", 1)
+            elif i + 1 < len(argv):
+                dotted, value = body, argv[i + 1]
+                i += 1
+            else:
+                raise ValueError(f"flag {a!r} is missing a value")
+            _apply(cfg, dotted, value, applied)
+        else:
+            leftover.append(a)
+        i += 1
+    # which keys any layer explicitly set — lets callers distinguish
+    # "configured" from "default" (CLIs keep their own defaults otherwise)
+    cfg.applied = applied
+    return cfg, leftover

@@ -1,0 +1,7 @@
+from .connectors import (DocumentStoreSink, FileStreamSource, HoistFieldKey,
+                         ObjectStoreSink)
+from .runtime import ConnectWorker, SinkConnector, SourceConnector, SourceRecord
+
+__all__ = ["ConnectWorker", "SourceConnector", "SinkConnector", "SourceRecord",
+           "FileStreamSource", "DocumentStoreSink", "ObjectStoreSink",
+           "HoistFieldKey"]

@@ -106,8 +106,18 @@ class StandardScaler:
         self.n = 0
         self.mean: Optional[np.ndarray] = None
         self.m2: Optional[np.ndarray] = None
+        self.frozen = False
+
+    def freeze(self) -> "StandardScaler":
+        """Stop accumulating moments: transform-only from here on.  Train
+        fits the scaler; eval must score with the *training* moments, or
+        the model sees differently-scaled inputs than it trained on."""
+        self.frozen = True
+        return self
 
     def partial_fit(self, x: np.ndarray) -> "StandardScaler":
+        if self.frozen:
+            return self
         x = np.asarray(x, np.float64)
         if self.mean is None:
             self.mean = np.zeros(x.shape[1])

@@ -208,3 +208,35 @@ class FleetGenerator:
                                timestamp_ms=ts)
                 count += 1
         return count
+
+
+def write_csv_fixture(path: str, n_rows: int = 10_000,
+                      scenario: Optional[FleetScenario] = None,
+                      start_time: int = 1_567_606_196) -> int:
+    """Write a `car-sensor-data.csv`-shaped offline fixture.
+
+    Header and column order match the reference's 10k-row test file
+    (`testdata/car-sensor-data.csv:1`): `time,car,<18 sensor columns>`, car
+    ids like `car1`, epoch-seconds timestamps.  Returns the row count.
+    """
+    from ..core.schema import CSV_COLUMNS
+
+    scenario = scenario or FleetScenario(num_cars=100)
+    gen = FleetGenerator(scenario)
+    header = list(CSV_COLUMNS)
+    n = 0
+    with open(path, "w") as fh:
+        fh.write(",".join(header) + "\n")
+        t = start_time
+        while n < n_rows:
+            cols = gen.step_columns()
+            for i in range(len(cols["car"])):
+                if n >= n_rows:
+                    break
+                rec = gen.row_record(cols, i, CAR_SCHEMA)
+                row = [str(t), f"car{int(cols['car'][i]) + 1}"] + [
+                    str(rec[f.name]) for f in CAR_SCHEMA.fields]
+                fh.write(",".join(row) + "\n")
+                n += 1
+            t += max(int(scenario.interval_s), 1)
+    return n

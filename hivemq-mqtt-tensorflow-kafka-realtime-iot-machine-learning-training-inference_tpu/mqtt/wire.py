@@ -112,14 +112,7 @@ def subscribe_packet(packet_id: int, filters: List[Tuple[str, int]],
     return packet(SUBSCRIBE, 0x02, body)
 
 
-def _recv_exact(sock, n: int) -> bytes:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        buf += chunk
-    return buf
+from ..utils.net import recv_exact as _recv_exact
 
 
 # ------------------------------------------------------------------ server

@@ -100,16 +100,20 @@ class AvroCodec:
 
     # ------------------------------------------------------------ decoding
     def decode(self, message: bytes) -> dict:
-        pos = 0
+        return self._decode_at(message, 0)[0]
+
+    def _decode_at(self, buf: bytes, pos: int) -> tuple:
+        """Decode one record starting at pos → (record, next_pos).  The
+        position-tracking form lets container blocks hold many records."""
         rec = {}
         for f in self._fields:
             if f.nullable:
-                branch, pos = zigzag_decode(message, pos)
+                branch, pos = zigzag_decode(buf, pos)
                 if branch == 0:
                     rec[f.name] = None
                     continue
-            rec[f.name], pos = self._decode_prim(message, pos, f.avro_type)
-        return rec
+            rec[f.name], pos = self._decode_prim(buf, pos, f.avro_type)
+        return rec, pos
 
     @staticmethod
     def _decode_prim(buf: bytes, pos: int, t: str):

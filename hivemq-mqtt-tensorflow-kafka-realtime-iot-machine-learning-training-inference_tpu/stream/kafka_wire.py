@@ -1,0 +1,705 @@
+"""Kafka wire protocol — TCP client and server for the stream layer.
+
+The reference's entire data plane is the Kafka protocol: `KafkaDataset`
+consumes `kafka:9071` with SASL/PLAIN (reference cardata-v3.py:7-15,46-47),
+`KafkaOutputSequence` produces to it, topics are provisioned with
+`kafka-topics --create` (reference `01_installConfluentPlatform.sh:180-183`).
+This module implements the protocol subset those paths need, natively:
+
+- `KafkaWireBroker` — a *client* exposing the same duck-type as
+  `stream.broker.Broker` (produce / fetch / end_offset / commit / ...), so
+  `StreamConsumer`, `SensorBatches`, `OutputSequence` and every CLI run
+  unchanged against a real cluster: `Broker()` → `KafkaWireBroker("host:port")`
+  is the whole migration.
+- `KafkaWireServer` — a TCP front for the in-process `Broker` emulator
+  speaking the same protocol, so the client (and any standard Kafka client)
+  can be exercised end-to-end without a cluster — the same trick as
+  `mqtt.wire.MqttServer`.
+
+Protocol details (all big-endian, classic encoding — no flexible/tagged
+fields): request header v1 (api_key, api_version, correlation_id,
+client_id); MessageSet v1 entries (magic 1, CRC over magic..value) for
+Produce v2 / Fetch v2; Metadata v1; ListOffsets v1; OffsetCommit v2 /
+OffsetFetch v1 (simple-consumer group offsets, generation −1);
+CreateTopics v0; ApiVersions v0; SaslHandshake v0 + raw PLAIN token frame
+(the pre-KIP-152 exchange the reference's SASL_PLAIN config uses).
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.net import recv_exact
+from .broker import Broker, Message, TopicSpec
+
+# api keys
+PRODUCE, FETCH, LIST_OFFSETS, METADATA = 0, 1, 2, 3
+OFFSET_COMMIT, OFFSET_FETCH = 8, 9
+SASL_HANDSHAKE, API_VERSIONS, CREATE_TOPICS = 17, 18, 19
+
+# error codes
+ERR_NONE = 0
+ERR_OFFSET_OUT_OF_RANGE = 1
+ERR_UNKNOWN_TOPIC = 3
+ERR_UNSUPPORTED_VERSION = 35
+ERR_TOPIC_EXISTS = 36
+ERR_SASL_AUTH_FAILED = 58
+
+_SUPPORTED = {PRODUCE: (2, 2), FETCH: (2, 2), LIST_OFFSETS: (1, 1),
+              METADATA: (1, 1), OFFSET_COMMIT: (2, 2), OFFSET_FETCH: (1, 1),
+              SASL_HANDSHAKE: (0, 0), API_VERSIONS: (0, 0),
+              CREATE_TOPICS: (0, 0)}
+
+
+# ------------------------------------------------------------- primitives
+class _Writer:
+    def __init__(self):
+        self.buf = bytearray()
+
+    def i8(self, v):  self.buf += struct.pack(">b", v); return self
+    def i16(self, v): self.buf += struct.pack(">h", v); return self
+    def i32(self, v): self.buf += struct.pack(">i", v); return self
+    def i64(self, v): self.buf += struct.pack(">q", v); return self
+    def u32(self, v): self.buf += struct.pack(">I", v); return self
+
+    def string(self, s: Optional[str]):
+        if s is None:
+            return self.i16(-1)
+        b = s.encode()
+        self.i16(len(b))
+        self.buf += b
+        return self
+
+    def bytes_(self, b: Optional[bytes]):
+        if b is None:
+            return self.i32(-1)
+        self.i32(len(b))
+        self.buf += b
+        return self
+
+    def array(self, items, fn):
+        self.i32(len(items))
+        for it in items:
+            fn(self, it)
+        return self
+
+
+class _Reader:
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.buf = buf
+        self.pos = pos
+
+    def _unpack(self, fmt, size):
+        (v,) = struct.unpack_from(fmt, self.buf, self.pos)
+        self.pos += size
+        return v
+
+    def i8(self):  return self._unpack(">b", 1)
+    def i16(self): return self._unpack(">h", 2)
+    def i32(self): return self._unpack(">i", 4)
+    def i64(self): return self._unpack(">q", 8)
+    def u32(self): return self._unpack(">I", 4)
+
+    def string(self) -> Optional[str]:
+        n = self.i16()
+        if n < 0:
+            return None
+        s = self.buf[self.pos:self.pos + n].decode()
+        self.pos += n
+        return s
+
+    def bytes_(self) -> Optional[bytes]:
+        n = self.i32()
+        if n < 0:
+            return None
+        b = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return b
+
+    def array(self, fn) -> list:
+        n = self.i32()
+        return [fn(self) for _ in range(max(n, 0))]
+
+
+# ---------------------------------------------------------- message sets
+def encode_message_set(entries: List[Tuple[int, Optional[bytes],
+                                           Optional[bytes], int]]) -> bytes:
+    """entries: [(offset, key, value, timestamp_ms)] → MessageSet v1 bytes."""
+    out = _Writer()
+    for offset, key, value, ts in entries:
+        body = _Writer()
+        body.i8(1).i8(0).i64(ts)          # magic 1, attributes 0, timestamp
+        body.bytes_(key).bytes_(value)
+        msg = struct.pack(">I", zlib.crc32(bytes(body.buf))) + bytes(body.buf)
+        out.i64(offset).i32(len(msg))
+        out.buf += msg
+    return bytes(out.buf)
+
+
+def decode_message_set(buf: bytes) -> List[Tuple[int, Optional[bytes],
+                                                 Optional[bytes], int]]:
+    """MessageSet v1 bytes → [(offset, key, value, timestamp_ms)].  A
+    truncated trailing entry (Kafka allows partial final messages in fetch
+    responses) is dropped."""
+    out = []
+    r = _Reader(buf)
+    while r.pos + 12 <= len(buf):
+        offset = r.i64()
+        size = r.i32()
+        if r.pos + size > len(buf):
+            break  # partial trailing message
+        end = r.pos + size
+        crc = r.u32()
+        if zlib.crc32(buf[r.pos:end]) != crc:
+            raise ValueError(f"message CRC mismatch at offset {offset}")
+        magic = r.i8()
+        r.i8()  # attributes (no compression support needed)
+        ts = r.i64() if magic >= 1 else 0
+        key = r.bytes_()
+        value = r.bytes_()
+        r.pos = end
+        out.append((offset, key, value, ts))
+    return out
+
+
+def _req_header(api_key: int, api_version: int, corr: int,
+                client_id: str) -> bytes:
+    w = _Writer()
+    w.i16(api_key).i16(api_version).i32(corr).string(client_id)
+    return bytes(w.buf)
+
+
+# ------------------------------------------------------------------ client
+class KafkaWireBroker:
+    """Kafka-protocol client with the `Broker` emulator's duck-type.
+
+    One socket, one lock: requests are serialized (the reference's data
+    path is single-consumer per process too).  Metadata is cached for the
+    client-side partitioner and refreshed on topic misses.
+    """
+
+    def __init__(self, servers: str, client_id: str = "iotml",
+                 sasl_username: Optional[str] = None,
+                 sasl_password: Optional[str] = None,
+                 timeout_s: float = 30.0):
+        host, _, port = servers.split(",")[0].partition(":")
+        self.client_id = client_id
+        self._lock = threading.Lock()
+        self._corr = 0
+        self._sock = socket.create_connection((host, int(port or 9092)),
+                                              timeout=timeout_s)
+        self._meta: Dict[str, int] = {}  # topic → partition count
+        self._rr: Dict[str, int] = {}
+        if sasl_username is not None:
+            self._sasl_plain(sasl_username, sasl_password or "")
+
+    # ---------------------------------------------------------- transport
+    def _recv_exact(self, n: int) -> bytes:
+        return recv_exact(self._sock, n, "broker closed connection")
+
+    def _send_frame(self, payload: bytes) -> None:
+        self._sock.sendall(struct.pack(">i", len(payload)) + payload)
+
+    def _recv_frame(self) -> bytes:
+        (size,) = struct.unpack(">i", self._recv_exact(4))
+        return self._recv_exact(size)
+
+    def _request(self, api_key: int, api_version: int, body: bytes) -> _Reader:
+        with self._lock:
+            self._corr += 1
+            corr = self._corr
+            self._send_frame(_req_header(api_key, api_version, corr,
+                                         self.client_id) + body)
+            resp = self._recv_frame()
+        r = _Reader(resp)
+        got = r.i32()
+        if got != corr:
+            raise ConnectionError(f"correlation id mismatch: {got} != {corr}")
+        return r
+
+    def _sasl_plain(self, username: str, password: str) -> None:
+        w = _Writer()
+        w.string("PLAIN")
+        r = self._request(SASL_HANDSHAKE, 0, bytes(w.buf))
+        err = r.i16()
+        mechanisms = r.array(lambda rd: rd.string())
+        if err != ERR_NONE:
+            raise ConnectionError(
+                f"SASL handshake failed ({err}); server offers {mechanisms}")
+        token = b"\x00" + username.encode() + b"\x00" + password.encode()
+        with self._lock:
+            self._send_frame(token)   # raw token frame (pre-KIP-152)
+            resp = self._recv_frame()
+        if resp != b"":
+            raise ConnectionError("SASL PLAIN authentication failed")
+
+    # ------------------------------------------------------------ metadata
+    def _metadata(self, topics: Optional[List[str]] = None) -> dict:
+        w = _Writer()
+        if topics is None:
+            w.i32(-1)
+        else:
+            w.array(topics, lambda wr, t: wr.string(t))
+        r = self._request(METADATA, 1, bytes(w.buf))
+
+        def broker(rd):
+            return (rd.i32(), rd.string(), rd.i32(), rd.string())
+
+        def partition(rd):
+            err, pid, leader = rd.i16(), rd.i32(), rd.i32()
+            rd.array(lambda x: x.i32())  # replicas
+            rd.array(lambda x: x.i32())  # isr
+            return (err, pid, leader)
+
+        def topic(rd):
+            err = rd.i16()
+            name = rd.string()
+            rd.i8()  # is_internal
+            parts = rd.array(partition)
+            return (err, name, parts)
+
+        brokers = r.array(broker)
+        r.i32()  # controller id
+        tops = r.array(topic)
+        meta = {"brokers": brokers, "topics": {}}
+        for err, name, parts in tops:
+            if err == ERR_NONE:
+                meta["topics"][name] = len(parts)
+                self._meta[name] = len(parts)
+        return meta
+
+    def topics(self) -> List[str]:
+        return sorted(self._metadata()["topics"])
+
+    def topic(self, name: str) -> TopicSpec:
+        n = self._meta.get(name) or self._metadata([name])["topics"].get(name)
+        if n is None:
+            raise KeyError(name)
+        return TopicSpec(name, n)
+
+    def create_topic(self, name: str, partitions: int = 1,
+                     retention_messages: Optional[int] = None) -> TopicSpec:
+        w = _Writer()
+
+        def one(wr, _):
+            wr.string(name).i32(partitions).i16(1)
+            wr.i32(0)  # replica assignment: none
+            wr.i32(0)  # configs: none
+
+        w.array([None], one)
+        w.i32(10_000)  # timeout ms
+        r = self._request(CREATE_TOPICS, 0, bytes(w.buf))
+        errs = r.array(lambda rd: (rd.string(), rd.i16()))
+        for _, err in errs:
+            if err not in (ERR_NONE, ERR_TOPIC_EXISTS):
+                raise RuntimeError(f"create_topic({name}) failed: error {err}")
+        self._meta[name] = max(self._meta.get(name, 0), partitions)
+        return TopicSpec(name, self._meta[name])
+
+    # ------------------------------------------------------------- produce
+    def _partition_for(self, topic: str, key: Optional[bytes]) -> int:
+        n = self._meta.get(topic)
+        if n is None:
+            n = self._metadata([topic])["topics"].get(topic, 1)
+        if key is None:
+            self._rr[topic] = (self._rr.get(topic, -1) + 1) % n
+            return self._rr[topic]
+        return zlib.crc32(key) % n
+
+    def produce(self, topic: str, value: bytes, key: Optional[bytes] = None,
+                partition: Optional[int] = None, timestamp_ms: int = 0) -> int:
+        return self.produce_many(topic, [(key, value, timestamp_ms)],
+                                 partition=partition)
+
+    def produce_batch(self, topic: str, values, key=None, partition=None) -> int:
+        return self.produce_many(topic, [(key, v, 0) for v in values],
+                                 partition=partition)
+
+    def produce_many(self, topic: str, entries, partition=None) -> int:
+        """entries: [(key, value, timestamp_ms)] → offset of the last one."""
+        by_part: Dict[int, list] = {}
+        for key, value, ts in entries:
+            p = self._partition_for(topic, key) if partition is None else partition
+            by_part.setdefault(p, []).append((0, key, value, ts))
+        last = -1
+        w = _Writer()
+        w.i16(-1).i32(10_000)  # acks=all, timeout
+
+        def part_entry(wr, item):
+            p, ents = item
+            wr.i32(p).bytes_(encode_message_set(ents))
+
+        def topic_entry(wr, _):
+            wr.string(topic).array(sorted(by_part.items()), part_entry)
+
+        w.array([None], topic_entry)
+        r = self._request(PRODUCE, 2, bytes(w.buf))
+
+        def part_resp(rd):
+            p, err, base = rd.i32(), rd.i16(), rd.i64()
+            rd.i64()  # log append time
+            return (p, err, base)
+
+        tops = r.array(lambda rd: (rd.string(), rd.array(part_resp)))
+        for _, parts in tops:
+            for p, err, base in parts:
+                if err != ERR_NONE:
+                    raise RuntimeError(f"produce to {topic}:{p} failed: {err}")
+                last = max(last, base + len(by_part[p]) - 1)
+        return last
+
+    # --------------------------------------------------------------- fetch
+    def fetch(self, topic: str, partition: int, offset: int,
+              max_messages: int = 1024) -> List[Message]:
+        w = _Writer()
+        w.i32(-1).i32(0).i32(1)  # replica -1, max_wait 0ms, min_bytes 1
+
+        def part(wr, _):
+            wr.i32(partition).i64(offset).i32(4 << 20)
+
+        w.array([None], lambda wr, _: (wr.string(topic),
+                                       wr.array([None], part)))
+        r = self._request(FETCH, 2, bytes(w.buf))
+        r.i32()  # throttle
+
+        out: List[Message] = []
+        tops = r.array(lambda rd: (rd.string(), rd.array(
+            lambda p: (p.i32(), p.i16(), p.i64(), p.bytes_()))))
+        for tname, parts in tops:
+            for pid, err, hwm, record_set in parts:
+                if err == ERR_OFFSET_OUT_OF_RANGE:
+                    continue
+                if err == ERR_UNKNOWN_TOPIC:
+                    raise KeyError(topic)
+                if err != ERR_NONE:
+                    raise RuntimeError(f"fetch {topic}:{pid} failed: {err}")
+                for off, key, value, ts in decode_message_set(record_set or b""):
+                    if off >= offset and len(out) < max_messages:
+                        out.append(Message(tname, pid, off, value or b"",
+                                           key, ts))
+        return out
+
+    # ------------------------------------------------------------- offsets
+    def _list_offset(self, topic: str, partition: int, timestamp: int) -> int:
+        w = _Writer()
+        w.i32(-1)
+
+        def part(wr, _):
+            wr.i32(partition).i64(timestamp)
+
+        w.array([None], lambda wr, _: (wr.string(topic),
+                                       wr.array([None], part)))
+        r = self._request(LIST_OFFSETS, 1, bytes(w.buf))
+        tops = r.array(lambda rd: (rd.string(), rd.array(
+            lambda p: (p.i32(), p.i16(), p.i64(), p.i64()))))
+        for _, parts in tops:
+            for pid, err, ts, off in parts:
+                if err != ERR_NONE:
+                    raise RuntimeError(f"list_offsets {topic}:{pid}: {err}")
+                return off
+        raise RuntimeError("empty ListOffsets response")
+
+    def end_offset(self, topic: str, partition: int = 0) -> int:
+        return self._list_offset(topic, partition, -1)
+
+    def begin_offset(self, topic: str, partition: int = 0) -> int:
+        return self._list_offset(topic, partition, -2)
+
+    # ------------------------------------------------- consumer-group API
+    def commit(self, group: str, topic: str, partition: int, next_offset: int):
+        w = _Writer()
+        w.string(group).i32(-1).string("")  # simple consumer: generation -1
+        w.i64(-1)  # retention: broker default
+
+        def part(wr, _):
+            wr.i32(partition).i64(next_offset).string(None)
+
+        w.array([None], lambda wr, _: (wr.string(topic),
+                                       wr.array([None], part)))
+        r = self._request(OFFSET_COMMIT, 2, bytes(w.buf))
+        tops = r.array(lambda rd: (rd.string(), rd.array(
+            lambda p: (p.i32(), p.i16()))))
+        for _, parts in tops:
+            for pid, err in parts:
+                if err != ERR_NONE:
+                    raise RuntimeError(f"offset commit {topic}:{pid}: {err}")
+
+    def committed(self, group: str, topic: str, partition: int) -> Optional[int]:
+        w = _Writer()
+        w.string(group)
+
+        def part(wr, _):
+            wr.i32(partition)
+
+        w.array([None], lambda wr, _: (wr.string(topic),
+                                       wr.array([None], part)))
+        r = self._request(OFFSET_FETCH, 1, bytes(w.buf))
+        tops = r.array(lambda rd: (rd.string(), rd.array(
+            lambda p: (p.i32(), p.i64(), p.string(), p.i16()))))
+        for _, parts in tops:
+            for pid, off, _meta, err in parts:
+                if err != ERR_NONE:
+                    raise RuntimeError(f"offset fetch {topic}:{pid}: {err}")
+                return None if off < 0 else off
+        return None
+
+    def close(self) -> None:
+        self._sock.close()
+
+
+# ------------------------------------------------------------------ server
+class _KafkaConn(socketserver.BaseRequestHandler):
+    """One client connection to the wire server."""
+
+    def _recv_exact(self, n: int) -> bytes:
+        return recv_exact(self.request, n)
+
+    def handle(self):
+        broker: Broker = self.server.broker  # type: ignore[attr-defined]
+        creds = self.server.credentials      # type: ignore[attr-defined]
+        authed = creds is None
+        sasl_pending = False
+        try:
+            while True:
+                (size,) = struct.unpack(">i", self._recv_exact(4))
+                frame = self._recv_exact(size)
+                if sasl_pending:
+                    # raw PLAIN token: [authzid] \0 user \0 password
+                    parts = frame.split(b"\x00")
+                    ok = len(parts) == 3 and \
+                        (parts[1].decode(), parts[2].decode()) == creds
+                    if not ok:
+                        return  # auth failure: drop connection
+                    authed, sasl_pending = True, False
+                    self.request.sendall(struct.pack(">i", 0))
+                    continue
+                r = _Reader(frame)
+                api_key, api_version, corr = r.i16(), r.i16(), r.i32()
+                r.string()  # client id
+                w = _Writer()
+                w.i32(corr)
+                lo_hi = _SUPPORTED.get(api_key)
+                if lo_hi is None or not lo_hi[0] <= api_version <= lo_hi[1]:
+                    w.i16(ERR_UNSUPPORTED_VERSION)
+                elif api_key == SASL_HANDSHAKE:
+                    mech = r.string()
+                    if mech == "PLAIN":
+                        w.i16(ERR_NONE)
+                        sasl_pending = not authed
+                    else:
+                        w.i16(ERR_SASL_AUTH_FAILED)
+                    w.array(["PLAIN"], lambda wr, m: wr.string(m))
+                elif not authed:
+                    return  # protocol requests before auth: drop
+                elif api_key == API_VERSIONS:
+                    w.i16(ERR_NONE)
+                    w.array(sorted(_SUPPORTED.items()),
+                            lambda wr, kv: wr.i16(kv[0]).i16(kv[1][0])
+                            .i16(kv[1][1]))
+                else:
+                    self._dispatch(broker, api_key, r, w)
+                resp = bytes(w.buf)
+                self.request.sendall(struct.pack(">i", len(resp)) + resp)
+        except (ConnectionError, OSError, struct.error):
+            pass
+
+    @staticmethod
+    def _valid_part(broker: Broker, topic: str, pid: int) -> bool:
+        """Guard every broker access: an out-of-range partition must come
+        back as Kafka error 3, not an IndexError that kills the connection."""
+        return topic in broker.topics() and \
+            0 <= pid < broker.topic(topic).partitions
+
+    # ------------------------------------------------------------ handlers
+    def _dispatch(self, broker: Broker, api_key: int, r: _Reader, w: _Writer):
+        if api_key == METADATA:
+            n = r.i32()
+            names = [r.string() for _ in range(max(n, 0))] if n >= 0 else None
+            if names is None or n == 0:
+                names = broker.topics()
+            host, port = self.server.server_address[:2]  # type: ignore
+            w.array([(0, host, port, None)],
+                    lambda wr, b: wr.i32(b[0]).string(b[1]).i32(b[2])
+                    .string(b[3]))
+            w.i32(0)  # controller id
+
+            def topic_entry(wr, name):
+                known = name in broker.topics()
+                wr.i16(ERR_NONE if known else ERR_UNKNOWN_TOPIC)
+                wr.string(name).i8(0)
+                parts = range(broker.topic(name).partitions) if known else []
+                wr.array(list(parts), lambda pw, p: pw.i16(ERR_NONE).i32(p)
+                         .i32(0).array([0], lambda x, v: x.i32(v))
+                         .array([0], lambda x, v: x.i32(v)))
+
+            w.array(names, topic_entry)
+        elif api_key == PRODUCE:
+            r.i16()  # acks
+            r.i32()  # timeout
+
+            def part(rd):
+                return (rd.i32(), rd.bytes_())
+
+            tops = r.array(lambda rd: (rd.string(), rd.array(part)))
+            resp = []
+            for tname, parts in tops:
+                presp = []
+                for pid, record_set in parts:
+                    entries = decode_message_set(record_set or b"")
+                    if tname not in broker.topics():
+                        broker.create_topic(tname, partitions=max(pid + 1, 1))
+                    if not self._valid_part(broker, tname, pid):
+                        presp.append((pid, ERR_UNKNOWN_TOPIC, -1))
+                        continue
+                    base = broker.end_offset(tname, pid)
+                    for _, key, value, ts in entries:
+                        broker.produce(tname, value or b"", key=key,
+                                       partition=pid, timestamp_ms=ts)
+                    presp.append((pid, ERR_NONE, base))
+                resp.append((tname, presp))
+            w.array(resp, lambda wr, t: (wr.string(t[0]), wr.array(
+                t[1], lambda pw, p: pw.i32(p[0]).i16(p[1]).i64(p[2])
+                .i64(-1))))
+            w.i32(0)  # throttle
+        elif api_key == FETCH:
+            r.i32()  # replica
+            r.i32()  # max wait
+            r.i32()  # min bytes
+
+            def part(rd):
+                return (rd.i32(), rd.i64(), rd.i32())
+
+            tops = r.array(lambda rd: (rd.string(), rd.array(part)))
+            resp = []
+            for tname, parts in tops:
+                presp = []
+                for pid, offset, max_bytes in parts:
+                    if not self._valid_part(broker, tname, pid):
+                        presp.append((pid, ERR_UNKNOWN_TOPIC, -1, b""))
+                        continue
+                    msgs = broker.fetch(tname, pid, offset, 4096)
+                    hwm = broker.end_offset(tname, pid)
+                    ms = encode_message_set(
+                        [(m.offset, m.key, m.value, m.timestamp_ms)
+                         for m in msgs])[:max(max_bytes, 0) or None]
+                    presp.append((pid, ERR_NONE, hwm, ms))
+                resp.append((tname, presp))
+            w.i32(0)  # throttle
+            w.array(resp, lambda wr, t: (wr.string(t[0]), wr.array(
+                t[1], lambda pw, p: pw.i32(p[0]).i16(p[1]).i64(p[2])
+                .bytes_(p[3]))))
+        elif api_key == LIST_OFFSETS:
+            r.i32()  # replica
+
+            def part(rd):
+                return (rd.i32(), rd.i64())
+
+            tops = r.array(lambda rd: (rd.string(), rd.array(part)))
+            resp = []
+            for tname, parts in tops:
+                presp = []
+                for pid, ts in parts:
+                    if not self._valid_part(broker, tname, pid):
+                        presp.append((pid, ERR_UNKNOWN_TOPIC, -1, -1))
+                    elif ts == -2:
+                        presp.append((pid, ERR_NONE, -1,
+                                      broker.begin_offset(tname, pid)))
+                    else:
+                        presp.append((pid, ERR_NONE, -1,
+                                      broker.end_offset(tname, pid)))
+                resp.append((tname, presp))
+            w.array(resp, lambda wr, t: (wr.string(t[0]), wr.array(
+                t[1], lambda pw, p: pw.i32(p[0]).i16(p[1]).i64(p[2])
+                .i64(p[3]))))
+        elif api_key == OFFSET_COMMIT:
+            group = r.string()
+            r.i32()  # generation
+            r.string()  # member
+            r.i64()  # retention
+
+            def part(rd):
+                return (rd.i32(), rd.i64(), rd.string())
+
+            tops = r.array(lambda rd: (rd.string(), rd.array(part)))
+            resp = []
+            for tname, parts in tops:
+                presp = []
+                for pid, off, _meta in parts:
+                    broker.commit(group, tname, pid, off)
+                    presp.append((pid, ERR_NONE))
+                resp.append((tname, presp))
+            w.array(resp, lambda wr, t: (wr.string(t[0]), wr.array(
+                t[1], lambda pw, p: pw.i32(p[0]).i16(p[1]))))
+        elif api_key == OFFSET_FETCH:
+            group = r.string()
+            tops = r.array(lambda rd: (rd.string(),
+                                       rd.array(lambda p: p.i32())))
+            resp = []
+            for tname, parts in tops:
+                presp = []
+                for pid in parts:
+                    off = broker.committed(group, tname, pid)
+                    presp.append((pid, -1 if off is None else off))
+                resp.append((tname, presp))
+            w.array(resp, lambda wr, t: (wr.string(t[0]), wr.array(
+                t[1], lambda pw, p: pw.i32(p[0]).i64(p[1]).string(None)
+                .i16(ERR_NONE))))
+        elif api_key == CREATE_TOPICS:
+            def topic(rd):
+                name = rd.string()
+                parts = rd.i32()
+                rd.i16()  # replication factor
+                rd.array(lambda x: (x.i32(), x.array(lambda y: y.i32())))
+                rd.array(lambda x: (x.string(), x.string()))
+                return (name, parts)
+
+            tops = r.array(topic)
+            r.i32()  # timeout
+            resp = []
+            for name, parts in tops:
+                if name in broker.topics():
+                    resp.append((name, ERR_TOPIC_EXISTS))
+                else:
+                    broker.create_topic(name, partitions=max(parts, 1))
+                    resp.append((name, ERR_NONE))
+            w.array(resp, lambda wr, t: wr.string(t[0]).i16(t[1]))
+
+
+class KafkaWireServer(socketserver.ThreadingTCPServer):
+    """TCP Kafka-protocol front for the in-process Broker.
+
+    `with KafkaWireServer(broker) as s:` serves on an ephemeral localhost
+    port (`s.port`).  Pass `credentials=(user, password)` to require the
+    SASL/PLAIN exchange the reference's cluster config mandates
+    (gcp.yaml:29-32).
+    """
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, broker: Broker, host: str = "127.0.0.1",
+                 port: int = 0,
+                 credentials: Optional[Tuple[str, str]] = None):
+        super().__init__((host, port), _KafkaConn)
+        self.broker = broker
+        self.credentials = credentials
+        self.port = self.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "KafkaWireServer":
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def __enter__(self) -> "KafkaWireServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+        self.server_close()

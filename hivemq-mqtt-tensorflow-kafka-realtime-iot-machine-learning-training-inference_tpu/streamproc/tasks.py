@@ -153,3 +153,46 @@ class TumblingCounter(StreamTask):
     def table(self) -> Dict[tuple, int]:
         """Materialized view of (car, window_start_ms) → count."""
         return dict(self.counts)
+
+
+class DelimitedToAvro(StreamTask):
+    """KSQL DELIMITED→AVRO recipe for the CSV fixture topic.
+
+    The reference replays `car-sensor-data.csv` through a FileStreamSource
+    into `car-data-csv`, declares a DELIMITED stream over it, and CSASes it
+    to Avro (reference `test_file_source_and _testdata.sh:49-61`).  Input
+    lines are `time,car,<18 sensors>`; output is Confluent-framed KSQL-schema
+    Avro keyed by car id, with the label defaulted to "false" (the fixture
+    has no failure column).
+    """
+
+    def __init__(self, broker: Broker, src: str = "car-data-csv",
+                 dst: str = "SENSOR_DATA_S_AVRO", label: str = "false", **kw):
+        super().__init__(broker, src, dst, **kw)
+        self.codec = AvroCodec(KSQL_CAR_SCHEMA)
+        self.label = label
+
+    def process(self, messages):
+        out = []
+        for m in messages:
+            try:
+                parts = m.value.decode().split(",")
+            except UnicodeDecodeError:
+                continue  # poisoned message: drop, don't halt the pipeline
+            if len(parts) != 2 + len(CAR_SCHEMA.fields):
+                continue  # malformed line: KSQL would null-fill; we drop
+            if parts[0] == "time":
+                continue  # replayed header
+            rec = {}
+            try:
+                for f_prod, f_ksql, raw in zip(CAR_SCHEMA.fields,
+                                               KSQL_CAR_SCHEMA.sensor_fields,
+                                               parts[2:]):
+                    rec[f_ksql.name] = int(float(raw)) \
+                        if f_ksql.avro_type in ("int", "long") else float(raw)
+            except ValueError:
+                continue  # non-numeric sensor value: drop the line
+            rec["FAILURE_OCCURRED"] = self.label
+            key = parts[1].encode()
+            out.append((key, frame(self.codec.encode(rec)), m.timestamp_ms))
+        return out

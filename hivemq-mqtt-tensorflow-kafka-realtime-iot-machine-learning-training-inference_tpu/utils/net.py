@@ -1,0 +1,14 @@
+"""Shared socket primitives for the wire-protocol layers (MQTT, Kafka)."""
+
+from __future__ import annotations
+
+
+def recv_exact(sock, n: int, closed_msg: str = "peer closed") -> bytes:
+    """Read exactly n bytes or raise ConnectionError on EOF."""
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError(closed_msg)
+        buf += chunk
+    return buf

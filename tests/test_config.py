@@ -1,0 +1,75 @@
+"""Typed config: layering order, coercion, error reporting."""
+
+import json
+
+import pytest
+
+from iotml.config import Config, load_config
+
+
+def test_defaults_match_reference_knobs():
+    cfg, rest = load_config([], env={})
+    assert rest == []
+    assert cfg.train.epochs == 20 and cfg.train.batch_size == 100
+    assert cfg.stream.topic == "SENSOR_DATA_S_AVRO"
+    assert cfg.broker.partitions == 10
+    assert cfg.scenario.num_cars == 25  # evaluation scenario scale
+
+
+def test_layering_file_env_cli(tmp_path):
+    path = str(tmp_path / "cfg.json")
+    json.dump({"train": {"epochs": 5, "batch_size": 64},
+               "artifacts": {"root": "/data"}}, open(path, "w"))
+    cfg, rest = load_config(
+        ["positional", "--train.epochs=7", "--mesh.data", "4", "pos2"],
+        env={"IOTML_TRAIN_EPOCHS": "6", "IOTML_SERVE_POLL_INTERVAL_S": "2.5"},
+        path=path)
+    # file < env < CLI
+    assert cfg.train.epochs == 7
+    assert cfg.train.batch_size == 64        # from file, untouched by others
+    assert cfg.serve.poll_interval_s == 2.5  # env, float-coerced
+    assert cfg.mesh.data == 4                # CLI space-separated form
+    assert cfg.artifacts.root == "/data"
+    assert rest == ["positional", "pos2"]    # positionals pass through
+
+
+def test_env_ignored_without_prefix_and_config_pointer(tmp_path):
+    path = str(tmp_path / "cfg.json")
+    json.dump({"train": {"epochs": 3}}, open(path, "w"))
+    cfg, _ = load_config([], env={"IOTML_CONFIG": path, "TRAIN_EPOCHS": "9"})
+    assert cfg.train.epochs == 3
+
+
+def test_bool_coercion_and_errors():
+    cfg, _ = load_config(["--train.only_normal=false"], env={})
+    assert cfg.train.only_normal is False
+    cfg, _ = load_config([], env={"IOTML_TRAIN_ONLY_NORMAL": "yes"})
+    assert cfg.train.only_normal is True
+    with pytest.raises(ValueError, match="bool"):
+        load_config(["--train.only_normal=maybe"], env={})
+    with pytest.raises(ValueError, match="unknown config key"):
+        load_config(["--train.epoch=3"], env={})
+    with pytest.raises(ValueError, match="unknown config section"):
+        load_config(["--trane.epochs=3"], env={})
+    with pytest.raises(ValueError, match="cannot parse"):
+        load_config(["--train.epochs=ten"], env={})
+    # a typo'd *section* in an IOTML_ env var fails as loudly as a field
+    with pytest.raises(ValueError, match="unknown config section"):
+        load_config([], env={"IOTML_SREVE_POLL_INTERVAL_S": "5"})
+
+
+def test_applied_keys_tracked():
+    cfg, _ = load_config(["--train.epochs=7"],
+                         env={"IOTML_MESH_DATA": "4"})
+    assert "train.epochs" in cfg.applied
+    assert "mesh.data" in cfg.applied
+    assert "train.batch_size" not in cfg.applied
+
+
+def test_dumps_roundtrip(tmp_path):
+    cfg, _ = load_config(["--scenario.num_cars=100000"], env={})
+    path = str(tmp_path / "out.json")
+    open(path, "w").write(cfg.dumps())
+    cfg2, _ = load_config([], env={}, path=path)
+    assert cfg2.as_dict() == cfg.as_dict()
+    assert cfg2.scenario.num_cars == 100_000

@@ -1,0 +1,38 @@
+"""Generated Grafana dashboards stay in sync with the metric registry."""
+
+import json
+
+from iotml.obs import metrics as m
+from iotml.obs.dashboards import dashboard_configmap, generate_dashboard
+
+
+def test_dashboard_covers_all_registered_metrics():
+    dash = generate_dashboard()
+    exprs = " ".join(t["expr"] for p in dash["panels"] for t in p["targets"])
+    for name in ("iotml_records_consumed_total", "iotml_records_trained_total",
+                 "iotml_records_scored_total", "iotml_train_step_seconds",
+                 "iotml_reconstruction_mse"):
+        assert name in exprs
+    assert len(dash["panels"]) == len(m.default_registry._metrics)
+    # counters rate()d, gauges raw, histograms averaged
+    assert any("rate(iotml_records_trained_total[1m]" in e
+               for e in exprs.split()) or "rate(iotml_records_trained_total[1m])" in exprs
+    assert "iotml_reconstruction_mse" in exprs
+    assert "rate(iotml_train_step_seconds_sum[1m])" in exprs
+
+
+def test_new_metric_gets_a_panel():
+    reg = m.Registry()
+    reg.counter("my_thing_total", "things done")
+    reg.gauge("my_level", "current level")
+    dash = generate_dashboard("t", registry=reg)
+    titles = [p["title"] for p in dash["panels"]]
+    assert "things done" in titles and "current level" in titles
+
+
+def test_configmap_shape():
+    doc = json.loads(dashboard_configmap())
+    assert doc["kind"] == "ConfigMap"
+    assert doc["metadata"]["labels"]["grafana_dashboard"] == "1"
+    inner = json.loads(doc["data"]["iotml.json"])
+    assert inner["schemaVersion"] == 16 and inner["panels"]

@@ -1,0 +1,103 @@
+"""Schema registry: Confluent semantics (ids, versions, idempotence) and
+avsc round-trip against both reference schema variants."""
+
+import json
+
+import pytest
+
+from iotml.core.schema import CAR_SCHEMA, KSQL_CAR_SCHEMA
+from iotml.ops.avro import AvroCodec
+from iotml.ops.framing import frame, unframe
+from iotml.stream.registry import (SchemaRegistry, fingerprint, parse_avsc,
+                                   subject_for_topic)
+
+
+def test_register_and_lookup():
+    reg = SchemaRegistry()
+    sid = reg.register("sensor-data-value", CAR_SCHEMA.avro_json())
+    assert sid == 1
+    rs = reg.by_id(sid)
+    assert rs.subject == "sensor-data-value" and rs.version == 1
+    assert reg.latest("sensor-data-value").schema_id == sid
+
+
+def test_idempotent_registration_same_id():
+    reg = SchemaRegistry()
+    a = reg.register("s-value", CAR_SCHEMA.avro_json())
+    b = reg.register("s-value", CAR_SCHEMA.avro_json())
+    assert a == b
+    assert reg.latest("s-value").version == 1  # no duplicate version
+
+
+def test_schema_evolution_versions():
+    reg = SchemaRegistry()
+    v1 = reg.register("s-value", CAR_SCHEMA.avro_json())
+    v2 = reg.register("s-value", KSQL_CAR_SCHEMA.avro_json())
+    assert v2 != v1
+    assert reg.latest("s-value").schema_id == v2
+    assert reg.version("s-value", 1).schema_id == v1
+    # the same schema under another subject keeps its global id
+    other = reg.register("other-value", CAR_SCHEMA.avro_json())
+    assert other == v1
+    assert reg.latest("other-value").version == 1
+
+
+def test_check_and_errors():
+    reg = SchemaRegistry()
+    assert reg.check("s-value", CAR_SCHEMA.avro_json()) is None
+    sid = reg.register("s-value", CAR_SCHEMA.avro_json())
+    assert reg.check("s-value", CAR_SCHEMA.avro_json()) == sid
+    with pytest.raises(KeyError):
+        reg.by_id(99)
+    with pytest.raises(KeyError):
+        reg.latest("nope")
+    with pytest.raises(ValueError):
+        reg.register("s-value", "{not json")
+
+
+def test_parse_avsc_roundtrip_both_variants():
+    for schema in (CAR_SCHEMA, KSQL_CAR_SCHEMA):
+        parsed = parse_avsc(schema.avro_json())
+        assert parsed.field_names == schema.field_names
+        assert [f.avro_type for f in parsed.fields] == \
+            [f.avro_type for f in schema.fields]
+        assert [f.nullable for f in parsed.fields] == \
+            [f.nullable for f in schema.fields]
+        assert parsed.label_field == schema.label_field
+
+
+def test_parse_reference_avsc_file():
+    """The KSQL-derived schema the reference ML apps actually load."""
+    avsc = open("/root/reference/python-scripts/AUTOENCODER-TensorFlow-IO-"
+                "Kafka/cardata-v1.avsc").read()
+    schema = parse_avsc(avsc)
+    assert len(schema.fields) == 19
+    assert schema.label_field == "FAILURE_OCCURRED"
+    assert all(f.nullable for f in schema.fields)
+    # and the codec round-trips a record under it
+    codec = AvroCodec(schema)
+    rec = {f.name: (1.5 if f.avro_type == "double" else
+                    3 if f.avro_type == "int" else "false")
+           for f in schema.fields}
+    assert codec.decode(codec.encode(rec)) == rec
+
+
+def test_registry_framing_integration():
+    """Wire path: register → frame with the real id → unframe → resolve."""
+    reg = SchemaRegistry()
+    sid = reg.register(subject_for_topic("SENSOR_DATA_S_AVRO"),
+                       KSQL_CAR_SCHEMA.avro_json())
+    codec = AvroCodec(KSQL_CAR_SCHEMA)
+    rec = {f.name: (0.5 if f.avro_type == "double" else
+                    1 if f.avro_type == "int" else "false")
+           for f in KSQL_CAR_SCHEMA.fields}
+    msg = frame(codec.encode(rec), schema_id=sid)
+    got_id, payload = unframe(msg)
+    schema = reg.by_id(got_id).record_schema
+    assert AvroCodec(schema).decode(payload) == rec
+
+
+def test_fingerprint_whitespace_invariant():
+    a = CAR_SCHEMA.avro_json()
+    b = json.dumps(json.loads(a))  # different formatting
+    assert a != b and fingerprint(a) == fingerprint(b)

@@ -1,0 +1,47 @@
+"""Serve CLI: long-lived scoring over the wire, committed-offset resume."""
+
+import numpy as np
+
+from iotml.cli.cardata import main as cardata_main
+from iotml.cli.serve import main as serve_main
+from iotml.gen.simulator import FleetGenerator, FleetScenario
+from iotml.stream.broker import Broker
+from iotml.stream.kafka_wire import KafkaWireServer
+
+
+def _train_model(backing, root):
+    gen = FleetGenerator(FleetScenario(num_cars=100, failure_rate=0.01))
+    gen.publish(backing, "SENSOR_DATA_S_AVRO", n_ticks=110)  # 11k records
+    with KafkaWireServer(backing) as srv:
+        assert cardata_main([f"127.0.0.1:{srv.port}", "SENSOR_DATA_S_AVRO",
+                             "0", "model-predictions", "train", "model1",
+                             root, "--train.epochs=1"]) == 0
+
+
+def test_serve_scores_and_resumes(tmp_path):
+    root = str(tmp_path / "artifacts")
+    backing = Broker()
+    _train_model(backing, root)
+    with KafkaWireServer(backing) as srv:
+        argv = [f"127.0.0.1:{srv.port}", "SENSOR_DATA_S_AVRO", "committed",
+                "model-predictions", "model1", root,
+                "--serve.poll_interval_s=0.01", "--serve.threshold=5"]
+        assert serve_main(argv, max_rounds=3) == 0
+        n1 = backing.end_offset("model-predictions", 0)
+        assert n1 == 11_000
+        # verdict suffix present (threshold configured)
+        msg = backing.fetch("model-predictions", 0, 0, 1)[0].value.decode()
+        assert "|normal|" in msg or "|anomaly|" in msg
+
+        # restart: new records arrive; committed offsets mean only THEY are
+        # scored (no re-scoring of the first 11k)
+        gen = FleetGenerator(FleetScenario(num_cars=100, failure_rate=0.01))
+        gen.publish(backing, "SENSOR_DATA_S_AVRO", n_ticks=5)  # +500
+        assert serve_main(argv, max_rounds=2) == 0
+        n2 = backing.end_offset("model-predictions", 0)
+        assert n2 == n1 + 500
+
+
+def test_serve_usage_error(capsys):
+    assert serve_main(["too", "few"]) == 1
+    assert "usage" in capsys.readouterr().out
