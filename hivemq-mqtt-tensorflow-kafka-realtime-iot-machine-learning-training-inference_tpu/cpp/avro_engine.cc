@@ -243,6 +243,8 @@ int64_t iotml_encode_batch(const double* numeric, const char* labels,
   return pos;
 }
 
-int64_t iotml_engine_version() { return 1; }
+// Bumped whenever the C ABI grows; stream/native.py rebuilds stale .so files
+// (version 2: + kafka wire client).
+int64_t iotml_engine_version() { return 2; }
 
 }  // extern "C"

@@ -1,0 +1,711 @@
+// iotml native Kafka wire client — the C++ half of the stream data plane.
+//
+// TPU-native replacement for the reference's librdkafka-backed tf.data ops
+// (tensorflow_io.kafka KafkaDataset / KafkaOutputSequence, reference
+// cardata-v3.py:46-47, :238-252): a blocking TCP client speaking the classic
+// Kafka protocol subset the framework's wire layer defines
+// (stream/kafka_wire.py): request header v1; MessageSet v1 (magic 1, CRC32
+// over magic..value); Produce v2, Fetch v2, ListOffsets v1, Metadata v1,
+// OffsetCommit v2, OffsetFetch v1, SaslHandshake v0 + raw PLAIN token,
+// ApiVersions v0, CreateTopics v0.
+//
+// The headline entry point is iotml_kafka_fetch_decode(): one call performs
+// fetch → Confluent 5-byte framing strip → schema-compiled Avro decode
+// (via iotml_decode_batch from avro_engine.cc, linked into the same .so)
+// straight into caller-owned columnar buffers — poll-to-matrix with zero
+// Python-object traffic, the exact job KafkaDataset+decode_avro did in the
+// reference's C++ layer.
+//
+// Error convention: functions return >= 0 on success; -2 for socket/frame
+// IO failure (-1 is reserved: OffsetFetch uses it for "no committed
+// offset"); -(1000 + kafka_error_code) for protocol-level errors, so
+// Python can map e.g. -1003 back to UNKNOWN_TOPIC_OR_PARTITION.
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" int64_t iotml_decode_batch(const uint8_t* blob,
+                                      const int64_t* offsets, int64_t n_msgs,
+                                      const int8_t* types,
+                                      const uint8_t* nullable,
+                                      int64_t n_fields, int64_t strip,
+                                      double* out_numeric, char* out_labels,
+                                      int64_t label_stride);
+
+namespace {
+
+constexpr int16_t API_PRODUCE = 0, API_FETCH = 1, API_LIST_OFFSETS = 2,
+                  API_METADATA = 3, API_OFFSET_COMMIT = 8,
+                  API_OFFSET_FETCH = 9, API_SASL_HANDSHAKE = 17,
+                  API_CREATE_TOPICS = 19;
+constexpr int16_t ERR_NONE = 0, ERR_TOPIC_EXISTS = 36;
+constexpr int64_t K_EIO = -2;  // -1 would collide with OffsetFetch's "no committed offset"
+
+inline int64_t proto_err(int16_t code) { return -(1000 + (int64_t)code); }
+
+// ---------------------------------------------------------------- crc32
+uint32_t crc32_table[256];
+struct CrcInit {
+  CrcInit() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      crc32_table[i] = c;
+    }
+  }
+} crc_init;
+
+uint32_t crc32(const uint8_t* p, size_t n) {
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i) c = crc32_table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// ------------------------------------------------------------ buffers
+struct Writer {
+  std::vector<uint8_t> buf;
+  void raw(const void* p, size_t n) {
+    const uint8_t* b = static_cast<const uint8_t*>(p);
+    buf.insert(buf.end(), b, b + n);
+  }
+  void i8(int8_t v) { buf.push_back(static_cast<uint8_t>(v)); }
+  void i16(int16_t v) {
+    buf.push_back((v >> 8) & 0xFF);
+    buf.push_back(v & 0xFF);
+  }
+  void i32(int32_t v) {
+    for (int s = 24; s >= 0; s -= 8) buf.push_back((v >> s) & 0xFF);
+  }
+  void u32(uint32_t v) {
+    for (int s = 24; s >= 0; s -= 8) buf.push_back((v >> s) & 0xFF);
+  }
+  void i64(int64_t v) {
+    for (int s = 56; s >= 0; s -= 8) buf.push_back((v >> s) & 0xFF);
+  }
+  void str(const char* s) {  // non-null Kafka STRING
+    int16_t n = s ? static_cast<int16_t>(strlen(s)) : 0;
+    i16(n);
+    if (s) raw(s, n);
+  }
+  void null_str() { i16(-1); }
+  void bytes(const uint8_t* p, int32_t n) {  // n < 0 → null BYTES
+    i32(n);
+    if (n > 0) raw(p, n);
+  }
+};
+
+struct Reader {
+  const uint8_t* buf;
+  size_t len, pos = 0;
+  bool fail = false;
+  Reader(const uint8_t* b, size_t n) : buf(b), len(n) {}
+  bool need(size_t n) {
+    if (pos + n > len) { fail = true; return false; }
+    return true;
+  }
+  int8_t i8() { return need(1) ? static_cast<int8_t>(buf[pos++]) : 0; }
+  int16_t i16() {
+    if (!need(2)) return 0;
+    int16_t v = (buf[pos] << 8) | buf[pos + 1];
+    pos += 2;
+    return v;
+  }
+  int32_t i32() {
+    if (!need(4)) return 0;
+    int32_t v = 0;
+    for (int i = 0; i < 4; ++i) v = (v << 8) | buf[pos++];
+    return v;
+  }
+  uint32_t u32() { return static_cast<uint32_t>(i32()); }
+  int64_t i64() {
+    if (!need(8)) return 0;
+    int64_t v = 0;
+    for (int i = 0; i < 8; ++i) v = (v << 8) | buf[pos++];
+    return v;
+  }
+  void skip_str() {
+    int16_t n = i16();
+    if (n > 0 && need(n)) pos += n;
+  }
+  std::string str() {
+    int16_t n = i16();
+    if (n <= 0 || !need(n)) return "";
+    std::string s(reinterpret_cast<const char*>(buf + pos), n);
+    pos += n;
+    return s;
+  }
+  // BYTES: returns length (-1 null) and sets *out to the in-place pointer.
+  int32_t bytes(const uint8_t** out) {
+    int32_t n = i32();
+    if (n < 0) { *out = nullptr; return -1; }
+    if (!need(n)) { *out = nullptr; return -1; }
+    *out = buf + pos;
+    pos += n;
+    return n;
+  }
+};
+
+// ------------------------------------------------------------- messages
+struct Staged {
+  int64_t offset;
+  int64_t timestamp;
+  std::vector<uint8_t> key;
+  bool key_null;
+  std::vector<uint8_t> value;
+};
+
+struct Client {
+  int fd = -1;
+  int32_t corr = 0;
+  std::string client_id;
+  std::vector<Staged> staged;
+  int64_t staged_high_watermark = -1;
+};
+
+// MessageSet v1 encode: entries share one timestamp array layout from caller.
+void encode_message_set(Writer& w, const uint8_t* values,
+                        const int64_t* val_off, const uint8_t* keys,
+                        const int64_t* key_off, const uint8_t* key_null,
+                        const int64_t* timestamps, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    Writer body;
+    body.i8(1);  // magic 1
+    body.i8(0);  // attributes
+    body.i64(timestamps ? timestamps[i] : 0);
+    if (keys && !(key_null && key_null[i])) {
+      int32_t kn = static_cast<int32_t>(key_off[i + 1] - key_off[i]);
+      body.bytes(keys + key_off[i], kn);  // kn == 0 → empty (non-null) key
+    } else {
+      body.bytes(nullptr, -1);
+    }
+    body.bytes(values + val_off[i],
+               static_cast<int32_t>(val_off[i + 1] - val_off[i]));
+    w.i64(0);  // offset (assigned broker-side on produce)
+    w.i32(static_cast<int32_t>(body.buf.size() + 4));
+    w.u32(crc32(body.buf.data(), body.buf.size()));
+    w.raw(body.buf.data(), body.buf.size());
+  }
+}
+
+// MessageSet v1 decode into staged entries; tolerates a truncated tail.
+bool decode_message_set(const uint8_t* buf, size_t len, int64_t min_offset,
+                        int64_t max_messages, std::vector<Staged>& out) {
+  Reader r(buf, len);
+  while (r.pos + 12 <= len &&
+         out.size() < static_cast<size_t>(max_messages)) {
+    int64_t offset = r.i64();
+    int32_t size = r.i32();
+    if (size < 0 || r.pos + static_cast<size_t>(size) > len) break;  // tail
+    size_t end = r.pos + size;
+    uint32_t crc = r.u32();
+    if (crc32(buf + r.pos, end - r.pos) != crc) return false;
+    int8_t magic = r.i8();
+    r.i8();  // attributes (no compression in this subset)
+    int64_t ts = magic >= 1 ? r.i64() : 0;
+    const uint8_t* kp;
+    int32_t kn = r.bytes(&kp);
+    const uint8_t* vp;
+    int32_t vn = r.bytes(&vp);
+    if (r.fail) return false;
+    r.pos = end;
+    if (offset < min_offset) continue;
+    Staged s;
+    s.offset = offset;
+    s.timestamp = ts;
+    s.key_null = kn < 0;
+    if (kn > 0) s.key.assign(kp, kp + kn);
+    if (vn > 0) s.value.assign(vp, vp + vn);
+    out.push_back(std::move(s));
+  }
+  return true;
+}
+
+// ------------------------------------------------------------ transport
+bool send_all(int fd, const uint8_t* p, size_t n) {
+  while (n) {
+    ssize_t k = ::send(fd, p, n, 0);
+    if (k <= 0) return false;
+    p += k;
+    n -= k;
+  }
+  return true;
+}
+
+bool recv_all(int fd, uint8_t* p, size_t n) {
+  while (n) {
+    ssize_t k = ::recv(fd, p, n, 0);
+    if (k <= 0) return false;
+    p += k;
+    n -= k;
+  }
+  return true;
+}
+
+bool send_frame(Client* c, const std::vector<uint8_t>& payload) {
+  uint8_t hdr[4];
+  uint32_t n = static_cast<uint32_t>(payload.size());
+  for (int i = 0; i < 4; ++i) hdr[i] = (n >> (24 - 8 * i)) & 0xFF;
+  return send_all(c->fd, hdr, 4) &&
+         send_all(c->fd, payload.data(), payload.size());
+}
+
+bool recv_frame(Client* c, std::vector<uint8_t>& out) {
+  uint8_t hdr[4];
+  if (!recv_all(c->fd, hdr, 4)) return false;
+  int32_t n = 0;
+  for (int i = 0; i < 4; ++i) n = (n << 8) | hdr[i];
+  if (n < 0 || n > (1 << 30)) return false;
+  out.resize(n);
+  return n == 0 || recv_all(c->fd, out.data(), n);
+}
+
+// Send header+body, receive response, verify correlation id.  Returns the
+// response bytes after the correlation id via `resp` (empty on failure).
+bool request(Client* c, int16_t api, int16_t version, const Writer& body,
+             std::vector<uint8_t>& resp) {
+  Writer w;
+  w.i16(api);
+  w.i16(version);
+  int32_t corr = ++c->corr;
+  w.i32(corr);
+  w.str(c->client_id.c_str());
+  w.raw(body.buf.data(), body.buf.size());
+  if (!send_frame(c, w.buf)) return false;
+  std::vector<uint8_t> frame;
+  if (!recv_frame(c, frame)) return false;
+  Reader r(frame.data(), frame.size());
+  if (r.i32() != corr) return false;
+  resp.assign(frame.begin() + 4, frame.end());
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Connect (optionally SASL/PLAIN-authenticating, the reference cluster's
+// mandatory mechanism — gcp.yaml:29-32).  Returns an opaque handle or NULL.
+void* iotml_kafka_connect(const char* host, int32_t port,
+                          const char* client_id, const char* user,
+                          const char* password, double timeout_s) {
+  addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  char portbuf[16];
+  snprintf(portbuf, sizeof portbuf, "%d", port);
+  if (getaddrinfo(host, portbuf, &hints, &res) != 0 || !res) return nullptr;
+  // Non-blocking connect with the caller's deadline — a plain ::connect
+  // ignores SO_SNDTIMEO and can block for the kernel TCP timeout (~2 min).
+  int fd = -1;
+  for (addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    int flags = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+    int rc = ::connect(fd, ai->ai_addr, ai->ai_addrlen);
+    if (rc != 0 && errno == EINPROGRESS) {
+      pollfd pfd{fd, POLLOUT, 0};
+      if (::poll(&pfd, 1, static_cast<int>(timeout_s * 1000)) == 1) {
+        int err = 0;
+        socklen_t len = sizeof err;
+        getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len);
+        rc = err == 0 ? 0 : -1;
+      } else {
+        rc = -1;  // timeout
+      }
+    }
+    if (rc == 0) {
+      fcntl(fd, F_SETFL, flags);  // back to blocking for send/recv timeouts
+      break;
+    }
+    ::close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) return nullptr;
+  timeval tv;
+  tv.tv_sec = static_cast<long>(timeout_s);
+  tv.tv_usec = static_cast<long>((timeout_s - tv.tv_sec) * 1e6);
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, 1 /*TCP_NODELAY*/, &one, sizeof one);
+
+  Client* c = new Client;
+  c->fd = fd;
+  c->client_id = client_id ? client_id : "iotml-native";
+
+  if (user) {
+    Writer body;
+    body.str("PLAIN");
+    std::vector<uint8_t> resp;
+    if (!request(c, API_SASL_HANDSHAKE, 0, body, resp)) {
+      delete c; ::close(fd); return nullptr;
+    }
+    Reader r(resp.data(), resp.size());
+    if (r.i16() != ERR_NONE) { delete c; ::close(fd); return nullptr; }
+    // raw PLAIN token frame (pre-KIP-152): \0 user \0 password
+    std::vector<uint8_t> token;
+    token.push_back(0);
+    token.insert(token.end(), user, user + strlen(user));
+    token.push_back(0);
+    const char* pw = password ? password : "";
+    token.insert(token.end(), pw, pw + strlen(pw));
+    std::vector<uint8_t> ok;
+    if (!send_frame(c, token) || !recv_frame(c, ok) || !ok.empty()) {
+      delete c; ::close(fd); return nullptr;
+    }
+  }
+  return c;
+}
+
+void iotml_kafka_close(void* h) {
+  Client* c = static_cast<Client*>(h);
+  if (!c) return;
+  if (c->fd >= 0) ::close(c->fd);
+  delete c;
+}
+
+// Partition count for one topic (Metadata v1); 0 = unknown topic.
+int64_t iotml_kafka_metadata(void* h, const char* topic) {
+  Client* c = static_cast<Client*>(h);
+  Writer body;
+  body.i32(1);
+  body.str(topic);
+  std::vector<uint8_t> resp;
+  if (!request(c, API_METADATA, 1, body, resp)) return K_EIO;
+  Reader r(resp.data(), resp.size());
+  int32_t n_brokers = r.i32();
+  for (int32_t i = 0; i < n_brokers; ++i) {
+    r.i32();        // node id
+    r.skip_str();   // host
+    r.i32();        // port
+    r.skip_str();   // rack
+  }
+  r.i32();  // controller
+  int32_t n_topics = r.i32();
+  int64_t parts = 0;
+  for (int32_t t = 0; t < n_topics; ++t) {
+    int16_t err = r.i16();
+    std::string name = r.str();
+    r.i8();  // is_internal
+    int32_t n_parts = r.i32();
+    for (int32_t p = 0; p < n_parts; ++p) {
+      r.i16();  // err
+      r.i32();  // partition id
+      r.i32();  // leader
+      int32_t nr = r.i32();
+      for (int32_t k = 0; k < nr; ++k) r.i32();
+      int32_t ni = r.i32();
+      for (int32_t k = 0; k < ni; ++k) r.i32();
+    }
+    if (name == topic && err == ERR_NONE) parts = n_parts;
+  }
+  return r.fail ? K_EIO : parts;
+}
+
+int64_t iotml_kafka_create_topic(void* h, const char* topic,
+                                 int32_t partitions) {
+  Client* c = static_cast<Client*>(h);
+  Writer body;
+  body.i32(1);
+  body.str(topic);
+  body.i32(partitions);
+  body.i16(1);   // replication factor
+  body.i32(0);   // replica assignments
+  body.i32(0);   // configs
+  body.i32(10000);  // timeout ms
+  std::vector<uint8_t> resp;
+  if (!request(c, API_CREATE_TOPICS, 0, body, resp)) return K_EIO;
+  Reader r(resp.data(), resp.size());
+  int32_t n = r.i32();
+  int64_t existed = 0;
+  for (int32_t i = 0; i < n; ++i) {
+    r.skip_str();
+    int16_t err = r.i16();
+    if (err == ERR_TOPIC_EXISTS) existed = 1;
+    else if (err != ERR_NONE) return proto_err(err);
+  }
+  // 0 = created as requested; 1 = already existed (caller must refresh the
+  // real partition count — the requested one may be wrong)
+  return r.fail ? K_EIO : existed;
+}
+
+// ListOffsets v1: timestamp -1 → end offset, -2 → begin offset.
+int64_t iotml_kafka_list_offset(void* h, const char* topic, int32_t partition,
+                                int64_t timestamp) {
+  Client* c = static_cast<Client*>(h);
+  Writer body;
+  body.i32(-1);  // replica id
+  body.i32(1);
+  body.str(topic);
+  body.i32(1);
+  body.i32(partition);
+  body.i64(timestamp);
+  std::vector<uint8_t> resp;
+  if (!request(c, API_LIST_OFFSETS, 1, body, resp)) return K_EIO;
+  Reader r(resp.data(), resp.size());
+  int32_t n_topics = r.i32();
+  for (int32_t t = 0; t < n_topics; ++t) {
+    r.skip_str();
+    int32_t n_parts = r.i32();
+    for (int32_t p = 0; p < n_parts; ++p) {
+      r.i32();  // partition
+      int16_t err = r.i16();
+      r.i64();  // timestamp
+      int64_t off = r.i64();
+      if (r.fail) return K_EIO;
+      if (err != ERR_NONE) return proto_err(err);
+      return off;
+    }
+  }
+  return K_EIO;
+}
+
+// Produce v2, one (topic, partition), acks=all.  Values (and optional keys)
+// arrive as a contiguous blob + n+1 offsets — the encode_batch layout.
+// Returns the broker-assigned base offset of the batch.
+int64_t iotml_kafka_produce(void* h, const char* topic, int32_t partition,
+                            const uint8_t* values, const int64_t* val_offsets,
+                            const uint8_t* keys, const int64_t* key_offsets,
+                            const uint8_t* key_null, const int64_t* timestamps,
+                            int64_t n) {
+  Client* c = static_cast<Client*>(h);
+  Writer ms;
+  encode_message_set(ms, values, val_offsets, keys, key_offsets, key_null,
+                     timestamps, n);
+  Writer body;
+  body.i16(-1);     // acks = all
+  body.i32(10000);  // timeout
+  body.i32(1);
+  body.str(topic);
+  body.i32(1);
+  body.i32(partition);
+  body.bytes(ms.buf.data(), static_cast<int32_t>(ms.buf.size()));
+  std::vector<uint8_t> resp;
+  if (!request(c, API_PRODUCE, 2, body, resp)) return K_EIO;
+  Reader r(resp.data(), resp.size());
+  int32_t n_topics = r.i32();
+  int64_t base = K_EIO;
+  for (int32_t t = 0; t < n_topics; ++t) {
+    r.skip_str();
+    int32_t n_parts = r.i32();
+    for (int32_t p = 0; p < n_parts; ++p) {
+      r.i32();  // partition
+      int16_t err = r.i16();
+      int64_t b = r.i64();
+      r.i64();  // log append time
+      if (err != ERR_NONE) return proto_err(err);
+      base = b;
+    }
+  }
+  r.i32();  // throttle
+  return r.fail ? K_EIO : base;
+}
+
+// Fetch v2 into the handle's staging area.  Returns messages staged (>= 0)
+// or an error.  Staged data is then read out via iotml_kafka_staged_* /
+// iotml_kafka_take, or decoded in place by iotml_kafka_fetch_decode.
+int64_t iotml_kafka_fetch(void* h, const char* topic, int32_t partition,
+                          int64_t offset, int64_t max_messages) {
+  Client* c = static_cast<Client*>(h);
+  c->staged.clear();
+  Writer body;
+  body.i32(-1);       // replica
+  body.i32(0);        // max wait ms
+  body.i32(1);        // min bytes
+  body.i32(1);
+  body.str(topic);
+  body.i32(1);
+  body.i32(partition);
+  body.i64(offset);
+  body.i32(4 << 20);  // max bytes
+  std::vector<uint8_t> resp;
+  if (!request(c, API_FETCH, 2, body, resp)) return K_EIO;
+  Reader r(resp.data(), resp.size());
+  r.i32();  // throttle
+  int32_t n_topics = r.i32();
+  for (int32_t t = 0; t < n_topics; ++t) {
+    r.skip_str();
+    int32_t n_parts = r.i32();
+    for (int32_t p = 0; p < n_parts; ++p) {
+      r.i32();  // partition id
+      int16_t err = r.i16();
+      int64_t hwm = r.i64();
+      const uint8_t* ms;
+      int32_t msn = r.bytes(&ms);
+      if (r.fail) return K_EIO;
+      if (err == 1 /*OFFSET_OUT_OF_RANGE*/) continue;  // empty poll
+      if (err != ERR_NONE) return proto_err(err);
+      c->staged_high_watermark = hwm;
+      if (msn > 0 &&
+          !decode_message_set(ms, msn, offset, max_messages, c->staged))
+        return K_EIO;
+    }
+  }
+  return static_cast<int64_t>(c->staged.size());
+}
+
+int64_t iotml_kafka_staged_bytes(void* h, int64_t* value_bytes,
+                                 int64_t* key_bytes) {
+  Client* c = static_cast<Client*>(h);
+  int64_t vb = 0, kb = 0;
+  for (const Staged& s : c->staged) {
+    vb += static_cast<int64_t>(s.value.size());
+    kb += static_cast<int64_t>(s.key.size());
+  }
+  if (value_bytes) *value_bytes = vb;
+  if (key_bytes) *key_bytes = kb;
+  return static_cast<int64_t>(c->staged.size());
+}
+
+int64_t iotml_kafka_high_watermark(void* h) {
+  return static_cast<Client*>(h)->staged_high_watermark;
+}
+
+// Copy staged messages out as contiguous blobs + n+1 offset arrays.
+// key_offsets[i] == key_offsets[i+1] and key_null marks distinguish empty
+// vs null keys via the out_key_null bitmask (1 byte per message).
+int64_t iotml_kafka_take(void* h, uint8_t* values, int64_t* val_offsets,
+                         uint8_t* keys, int64_t* key_offsets,
+                         uint8_t* key_null, int64_t* msg_offsets,
+                         int64_t* timestamps) {
+  Client* c = static_cast<Client*>(h);
+  int64_t vp = 0, kp = 0;
+  int64_t n = static_cast<int64_t>(c->staged.size());
+  for (int64_t i = 0; i < n; ++i) {
+    const Staged& s = c->staged[i];
+    val_offsets[i] = vp;
+    memcpy(values + vp, s.value.data(), s.value.size());
+    vp += static_cast<int64_t>(s.value.size());
+    key_offsets[i] = kp;
+    if (!s.key.empty()) {
+      memcpy(keys + kp, s.key.data(), s.key.size());
+      kp += static_cast<int64_t>(s.key.size());
+    }
+    key_null[i] = s.key_null ? 1 : 0;
+    msg_offsets[i] = s.offset;
+    timestamps[i] = s.timestamp;
+  }
+  val_offsets[n] = vp;
+  key_offsets[n] = kp;
+  c->staged.clear();
+  return n;
+}
+
+// The fused hot path: fetch + framing strip + columnar Avro decode in one
+// native call (the KafkaDataset-equivalent).  Decodes at most max_rows
+// messages starting at `offset` into out_numeric/out_labels (layouts as in
+// iotml_decode_batch).  *next_offset receives the cursor after the last
+// decoded message.  Returns rows decoded (0 = clean EOF/empty poll), or a
+// negative error (decode failures surface as -(row + 1) - 2000).
+int64_t iotml_kafka_fetch_decode(void* h, const char* topic,
+                                 int32_t partition, int64_t offset,
+                                 const int8_t* types, const uint8_t* nullable,
+                                 int64_t n_fields, int64_t strip,
+                                 double* out_numeric, char* out_labels,
+                                 int64_t label_stride, int64_t max_rows,
+                                 int64_t* next_offset) {
+  Client* c = static_cast<Client*>(h);
+  int64_t n = iotml_kafka_fetch(h, topic, partition, offset, max_rows);
+  if (n <= 0) {
+    *next_offset = offset;
+    return n;
+  }
+  // Flatten staged values into one blob for the batch decoder.
+  int64_t total = 0;
+  for (const Staged& s : c->staged) total += (int64_t)s.value.size();
+  std::vector<uint8_t> blob(total);
+  std::vector<int64_t> offs(n + 1);
+  int64_t pos = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    offs[i] = pos;
+    memcpy(blob.data() + pos, c->staged[i].value.data(),
+           c->staged[i].value.size());
+    pos += (int64_t)c->staged[i].value.size();
+  }
+  offs[n] = pos;
+  int64_t rc = iotml_decode_batch(blob.data(), offs.data(), n, types,
+                                  nullable, n_fields, strip, out_numeric,
+                                  out_labels, label_stride);
+  if (rc < 0) return rc - 2000;
+  *next_offset = c->staged[n - 1].offset + 1;
+  c->staged.clear();
+  return rc;
+}
+
+// OffsetCommit v2, simple-consumer style (generation -1, empty member).
+int64_t iotml_kafka_commit(void* h, const char* group, const char* topic,
+                           int32_t partition, int64_t next_offset) {
+  Client* c = static_cast<Client*>(h);
+  Writer body;
+  body.str(group);
+  body.i32(-1);   // generation
+  body.str("");   // member id
+  body.i64(-1);   // retention: broker default
+  body.i32(1);
+  body.str(topic);
+  body.i32(1);
+  body.i32(partition);
+  body.i64(next_offset);
+  body.null_str();  // metadata
+  std::vector<uint8_t> resp;
+  if (!request(c, API_OFFSET_COMMIT, 2, body, resp)) return K_EIO;
+  Reader r(resp.data(), resp.size());
+  int32_t n_topics = r.i32();
+  for (int32_t t = 0; t < n_topics; ++t) {
+    r.skip_str();
+    int32_t n_parts = r.i32();
+    for (int32_t p = 0; p < n_parts; ++p) {
+      r.i32();
+      int16_t err = r.i16();
+      if (err != ERR_NONE) return proto_err(err);
+    }
+  }
+  return r.fail ? K_EIO : 0;
+}
+
+// OffsetFetch v1 → committed next-offset, or -1 when the group has none.
+int64_t iotml_kafka_committed(void* h, const char* group, const char* topic,
+                              int32_t partition) {
+  Client* c = static_cast<Client*>(h);
+  Writer body;
+  body.str(group);
+  body.i32(1);
+  body.str(topic);
+  body.i32(1);
+  body.i32(partition);
+  std::vector<uint8_t> resp;
+  if (!request(c, API_OFFSET_FETCH, 1, body, resp)) return K_EIO;
+  Reader r(resp.data(), resp.size());
+  int32_t n_topics = r.i32();
+  for (int32_t t = 0; t < n_topics; ++t) {
+    r.skip_str();
+    int32_t n_parts = r.i32();
+    for (int32_t p = 0; p < n_parts; ++p) {
+      r.i32();
+      int64_t off = r.i64();
+      r.skip_str();  // metadata
+      int16_t err = r.i16();
+      if (r.fail) return K_EIO;
+      if (err != ERR_NONE) return proto_err(err);
+      return off;  // -1 = no committed offset
+    }
+  }
+  return K_EIO;
+}
+
+}  // extern "C"

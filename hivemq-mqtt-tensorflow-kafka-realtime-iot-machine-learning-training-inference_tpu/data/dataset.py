@@ -122,9 +122,33 @@ class SensorBatches:
             self._native = None
 
     # ------------------------------------------------------------ core
+    def _native_labels(self, lab: np.ndarray, n: int) -> np.ndarray:
+        """Label column out of the native decoder's fixed-stride bytes."""
+        return (lab[:, self._label_col].astype("U")
+                if self._label_col is not None
+                else np.full((n,), "", object))
+
+    def _emit_chunk(self, num: np.ndarray, labels) -> tuple:
+        """Shared tail of every decode path: normalize + account."""
+        xs = self.normalizer.np(num)
+        self.records_seen += len(xs)
+        obs_metrics.records_consumed.inc(len(xs))
+        return xs, np.asarray(labels)
+
     def _decoded_chunks(self):
         """Yield (xs [n, F] float32 normalized, labels [n] str) per poll."""
         label_f = self.schema.label_field
+        if self._native is not None and \
+                getattr(self.consumer.broker, "fetch_decode", None) is not None:
+            # Fully-native path: broker-side fetch + framing strip + Avro
+            # decode in one C++ call (NativeKafkaBroker.fetch_decode) — no
+            # per-message Python objects.
+            while True:
+                num, lab = self.consumer.poll_decoded(
+                    self._native, strip=5, max_messages=self.poll_chunk)
+                if len(num) == 0:
+                    return
+                yield self._emit_chunk(num, self._native_labels(lab, len(num)))
         while True:
             msgs = self.consumer.poll(self.poll_chunk)
             if not msgs:
@@ -133,20 +157,14 @@ class SensorBatches:
             if self._native is not None:
                 num, lab = self._native.decode_batch(
                     [m.value for m in msgs], strip=5)
-                xs = self.normalizer.np(num)
-                labels = (lab[:, self._label_col].astype("U")
-                          if self._label_col is not None
-                          else np.full((n,), "", object))
+                labels = self._native_labels(lab, n)
             else:
                 raw = [strip_frame(m.value) for m in msgs]
                 cols = self.codec.decode_batch(raw)
-                mat = self.codec.sensor_matrix(cols)  # [n, F] float64
-                xs = self.normalizer.np(mat)  # normalized float32
+                num = self.codec.sensor_matrix(cols)  # [n, F] float64
                 labels = cols[label_f] if label_f \
                     else np.full((n,), "", object)
-            self.records_seen += n
-            obs_metrics.records_consumed.inc(n)
-            yield xs, np.asarray(labels)
+            yield self._emit_chunk(num, labels)
 
     def _filtered_chunks(self):
         for xs, labels in self._decoded_chunks():

@@ -16,6 +16,8 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence
 
+import numpy as np
+
 from .broker import Broker, Message
 
 
@@ -81,6 +83,40 @@ class StreamConsumer:
                 out.extend(batch)
                 attempts = 0  # progress was made; give others another chance
         return out
+
+    def poll_decoded(self, codec, strip: int = 5, max_messages: int = 4096):
+        """Fused native poll: fetch + framing strip + Avro decode in one
+        C++ call per partition (broker `fetch_decode`, the KafkaDataset-
+        equivalent hot path).  Returns (numeric [n, F] float64, labels
+        [n, S] bytes) or None when this broker has no native decode path;
+        n == 0 signals the same end-of-poll as an empty `poll()`."""
+        fd = getattr(self.broker, "fetch_decode", None)
+        if fd is None:
+            return None
+        nums, labs = [], []
+        got = 0
+        n = len(self._cursors)
+        attempts = 0
+        while got < max_messages and attempts < n:
+            cur = self._cursors[self._rr % n]
+            self._rr += 1
+            attempts += 1
+            topic, part, off = cur
+            numeric, labels, next_off = fd(topic, part, off, codec,
+                                           strip=strip,
+                                           max_rows=max_messages - got)
+            if len(numeric):
+                cur[2] = next_off
+                nums.append(numeric)
+                labs.append(labels)
+                got += len(numeric)
+                attempts = 0
+        if not nums:
+            from .native import LABEL_STRIDE
+
+            return (np.zeros((0, codec.n_numeric)),
+                    np.zeros((0, codec.n_strings), f"S{LABEL_STRIDE}"))
+        return np.concatenate(nums), np.concatenate(labs)
 
     def at_end(self) -> bool:
         return all(off >= self.broker.end_offset(t, p)

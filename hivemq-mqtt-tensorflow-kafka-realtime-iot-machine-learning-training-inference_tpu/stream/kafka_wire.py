@@ -175,7 +175,33 @@ def _req_header(api_key: int, api_version: int, corr: int,
 
 
 # ------------------------------------------------------------------ client
-class KafkaWireBroker:
+class ProducePartitionMixin:
+    """Client-side keyed partitioner + produce conveniences shared by the
+    Python and native (C++) wire clients.  One implementation so keyed
+    records land on the same partition no matter which client produced them
+    (per-key ordering is a cross-client invariant).  Subclasses provide
+    `_partition_count_or_default(topic)` and `produce_many`, plus the
+    `_rr` round-robin state dict.
+    """
+
+    def _partition_for(self, topic: str, key: Optional[bytes]) -> int:
+        n = self._partition_count_or_default(topic)
+        if key is None:
+            self._rr[topic] = (self._rr.get(topic, -1) + 1) % n
+            return self._rr[topic]
+        return zlib.crc32(key) % n
+
+    def produce(self, topic: str, value: bytes, key: Optional[bytes] = None,
+                partition: Optional[int] = None, timestamp_ms: int = 0) -> int:
+        return self.produce_many(topic, [(key, value, timestamp_ms)],
+                                 partition=partition)
+
+    def produce_batch(self, topic: str, values, key=None, partition=None) -> int:
+        return self.produce_many(topic, [(key, v, 0) for v in values],
+                                 partition=partition)
+
+
+class KafkaWireBroker(ProducePartitionMixin):
     """Kafka-protocol client with the `Broker` emulator's duck-type.
 
     One socket, one lock: requests are serialized (the reference's data
@@ -295,30 +321,25 @@ class KafkaWireBroker:
         w.i32(10_000)  # timeout ms
         r = self._request(CREATE_TOPICS, 0, bytes(w.buf))
         errs = r.array(lambda rd: (rd.string(), rd.i16()))
+        existed = False
         for _, err in errs:
-            if err not in (ERR_NONE, ERR_TOPIC_EXISTS):
+            if err == ERR_TOPIC_EXISTS:
+                existed = True
+            elif err != ERR_NONE:
                 raise RuntimeError(f"create_topic({name}) failed: error {err}")
-        self._meta[name] = max(self._meta.get(name, 0), partitions)
-        return TopicSpec(name, self._meta[name])
+        if existed:
+            # real partition count may differ from the request; trust metadata
+            self._meta.pop(name, None)
+            return self.topic(name)
+        self._meta[name] = partitions
+        return TopicSpec(name, partitions)
 
     # ------------------------------------------------------------- produce
-    def _partition_for(self, topic: str, key: Optional[bytes]) -> int:
+    def _partition_count_or_default(self, topic: str) -> int:
         n = self._meta.get(topic)
         if n is None:
             n = self._metadata([topic])["topics"].get(topic, 1)
-        if key is None:
-            self._rr[topic] = (self._rr.get(topic, -1) + 1) % n
-            return self._rr[topic]
-        return zlib.crc32(key) % n
-
-    def produce(self, topic: str, value: bytes, key: Optional[bytes] = None,
-                partition: Optional[int] = None, timestamp_ms: int = 0) -> int:
-        return self.produce_many(topic, [(key, value, timestamp_ms)],
-                                 partition=partition)
-
-    def produce_batch(self, topic: str, values, key=None, partition=None) -> int:
-        return self.produce_many(topic, [(key, v, 0) for v in values],
-                                 partition=partition)
+        return n
 
     def produce_many(self, topic: str, entries, partition=None) -> int:
         """entries: [(key, value, timestamp_ms)] → offset of the last one."""

@@ -42,19 +42,42 @@ def _build() -> bool:
         return False
 
 
+ENGINE_VERSION = 2  # must match iotml_engine_version() in avro_engine.cc
+
+
+def _stale() -> bool:
+    """A prebuilt .so from an older checkout must be rebuilt: `make` only
+    triggers on mtime, so also compare against source files explicitly."""
+    try:
+        so_m = os.path.getmtime(_SO_PATH)
+        for name in os.listdir(_CPP_DIR):
+            if name.endswith((".cc", ".h")) or name == "Makefile":
+                if os.path.getmtime(os.path.join(_CPP_DIR, name)) > so_m:
+                    return True
+    except OSError:
+        return True
+    return False
+
+
 def load() -> Optional[ctypes.CDLL]:
     """The engine library, building it on first call; None if unavailable."""
     global _lib, _tried
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if not os.path.exists(_SO_PATH) and not _build():
+    if (not os.path.exists(_SO_PATH) or _stale()) and not _build() \
+            and not os.path.exists(_SO_PATH):
         return None
     try:
         lib = ctypes.CDLL(_SO_PATH)
         lib.iotml_decode_batch.restype = ctypes.c_int64
         lib.iotml_encode_batch.restype = ctypes.c_int64
         lib.iotml_engine_version.restype = ctypes.c_int64
+        if lib.iotml_engine_version() < ENGINE_VERSION:
+            # stale binary and the rebuild failed (or produced an old ABI):
+            # treat as unavailable rather than risk missing symbols
+            _lib = None
+            return None
         _lib = lib
     except OSError:
         _lib = None
