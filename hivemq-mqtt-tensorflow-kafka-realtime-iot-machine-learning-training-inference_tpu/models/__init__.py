@@ -2,3 +2,4 @@ from .autoencoder import DenseAutoencoder, CAR_AUTOENCODER, CREDITCARD_AUTOENCOD
 from .lstm import LSTMSeq2Seq  # noqa: F401
 from .mnist import MNISTClassifier, MNISTBaseline  # noqa: F401
 from .transformer import SensorFormer  # noqa: F401
+from .moe import MoESensorFormer, MoEFFN, MoEBlock  # noqa: F401

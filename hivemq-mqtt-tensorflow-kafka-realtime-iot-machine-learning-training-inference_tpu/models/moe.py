@@ -1,0 +1,144 @@
+"""Mixture-of-experts SensorFormer: sparse FFN capacity for fleet-scale data.
+
+The reference has no MoE (SURVEY §2.7 marks expert parallelism absent); this
+is the TPU-native growth path for heterogeneous fleets — experts specialize
+per operating regime (highway / city / fault states) while FLOPs per token
+stay constant.
+
+TPU-first routing (GShard/Switch style, fully static shapes for XLA):
+- top-1 gating with a fixed per-expert capacity C; overflow tokens fall
+  through the residual (no dynamic shapes, no sorting networks);
+- dispatch/combine are one-hot einsums — they compile to MXU matmuls, not
+  scatters;
+- expert FFNs are a single batched einsum over the leading expert axis;
+- expert parallelism: with `ep_axis` set (inside shard_map), expert weights
+  hold only the local slice and two `lax.all_to_all`s over ICI move token
+  slots expert-major and back (`parallel/expert_parallel.py` builds the
+  mesh plumbing).
+
+Load-balance auxiliary loss follows Shazeer et al.: E * Σ_e f_e · p_e over
+fraction-routed f and mean gate probability p.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .transformer import MultiHeadAttention
+
+
+class MoEFFN(nn.Module):
+    """Top-1 routed expert FFN over tokens. Input/output [N, D] (tokens
+    flattened); returns (out, aux_loss)."""
+
+    d_model: int
+    num_experts: int = 8
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    ep_axis: Optional[str] = None  # set when called inside shard_map
+
+    @nn.compact
+    def __call__(self, x):
+        N, D = x.shape
+        ep = jax.lax.psum(1, self.ep_axis) if self.ep_axis else 1
+        E = self.num_experts
+        if E % ep:
+            raise ValueError(f"num_experts={E} not divisible by ep={ep}")
+        H = self.d_model * self.mlp_ratio
+        # capacity per expert over the *local* token block
+        C = max(1, int(self.capacity_factor * N / E))
+
+        logits = nn.Dense(E, use_bias=False, name="router")(x)  # [N, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert = jnp.argmax(probs, axis=-1)                      # [N]
+        gate = jnp.max(probs, axis=-1)                           # [N]
+        onehot = jax.nn.one_hot(expert, E, dtype=x.dtype)        # [N, E]
+
+        # position of each token within its expert's queue; drop overflow
+        pos = jnp.cumsum(onehot, axis=0) * onehot - onehot       # [N, E]
+        keep = (pos < C) * onehot
+        dispatch = keep[..., None] * jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=x.dtype)
+        combine = dispatch * gate[:, None, None]                 # [N, E, C]
+
+        slots = jnp.einsum("nec,nd->ecd", dispatch, x)           # [E, C, d]
+
+        # expert weights: local slice when expert-parallel
+        w1 = self.param("w1", nn.initializers.lecun_normal(),
+                        (E // ep, D, H))
+        b1 = self.param("b1", nn.initializers.zeros, (E // ep, H))
+        w2 = self.param("w2", nn.initializers.lecun_normal(),
+                        (E // ep, H, D))
+        b2 = self.param("b2", nn.initializers.zeros, (E // ep, D))
+
+        if self.ep_axis and ep > 1:
+            # [E, C, d] -> [E/ep, ep*C, d]: slots for my experts, all shards
+            slots = jax.lax.all_to_all(slots, self.ep_axis, 0, 1, tiled=True)
+        h = jnp.einsum("ecd,edh->ech", slots, w1) + b1[:, None, :]
+        h = nn.gelu(h)
+        h = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+        if self.ep_axis and ep > 1:
+            h = jax.lax.all_to_all(h, self.ep_axis, 1, 0, tiled=True)
+
+        out = jnp.einsum("nec,ecd->nd", combine, h)              # [N, D]
+
+        # load-balance aux: fraction routed × mean prob, summed over experts
+        frac = jnp.mean(onehot, axis=0)
+        mean_p = jnp.mean(probs, axis=0)
+        aux = E * jnp.sum(frac * mean_p)
+        return out, aux
+
+
+class MoEBlock(nn.Module):
+    d_model: int
+    num_heads: int
+    num_experts: int = 8
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    attn_mode: str = "dense"
+    ep_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        B, T, D = x.shape
+        x = x + MultiHeadAttention(self.d_model, self.num_heads,
+                                   self.attn_mode,
+                                   name="attn")(nn.LayerNorm(name="ln1")(x))
+        h = nn.LayerNorm(name="ln2")(x)
+        out, aux = MoEFFN(self.d_model, self.num_experts, self.mlp_ratio,
+                          self.capacity_factor, self.ep_axis,
+                          name="moe")(h.reshape(B * T, D))
+        return x + out.reshape(B, T, D), aux
+
+
+class MoESensorFormer(nn.Module):
+    """SensorFormer with MoE FFN blocks; returns (pred, aux_loss)."""
+
+    features: int = 18
+    d_model: int = 64
+    num_heads: int = 4
+    num_layers: int = 2
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+    max_len: int = 4096
+    attn_mode: str = "dense"
+    ep_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, positions: Optional[jnp.ndarray] = None):
+        B, T, F = x.shape
+        h = nn.Dense(self.d_model, name="embed")(x)
+        pos = jnp.arange(T) if positions is None else positions
+        h = h + nn.Embed(self.max_len, self.d_model, name="pos")(pos)
+        aux_total = 0.0
+        for i in range(self.num_layers):
+            h, aux = MoEBlock(self.d_model, self.num_heads, self.num_experts,
+                              capacity_factor=self.capacity_factor,
+                              attn_mode=self.attn_mode, ep_axis=self.ep_axis,
+                              name=f"block{i}")(h)
+            aux_total = aux_total + aux
+        h = nn.LayerNorm(name="ln_f")(h)
+        return nn.Dense(self.features, name="head")(h), aux_total

@@ -3,3 +3,5 @@ from .data_parallel import ShardedTrainer, shard_params, param_specs, make_shard
 from .ring_attention import make_ring_attention, ring_attention  # noqa: F401
 from .seq_parallel import make_sp_train_step  # noqa: F401
 from . import distributed  # noqa: F401
+from .pipeline import make_pp_train_step, pipeline_apply, pipeline_schedule  # noqa: F401
+from .expert_parallel import make_ep_train_step, shard_expert_params  # noqa: F401

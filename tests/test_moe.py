@@ -1,0 +1,99 @@
+"""MoE SensorFormer + expert parallelism: the expert-sharded all_to_all
+path must match the single-device dense dispatch, and routing must respect
+capacity with static shapes throughout."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from iotml.models.moe import MoEFFN, MoESensorFormer
+from iotml.parallel.expert_parallel import (expert_param_specs,
+                                            make_ep_train_step)
+from iotml.parallel.mesh import make_mesh
+from jax.sharding import PartitionSpec as P
+
+
+def _x(B=4, T=16, F=18, seed=0):
+    return np.random.default_rng(seed).normal(size=(B, T, F)).astype(np.float32)
+
+
+def test_moe_ffn_shapes_and_aux():
+    ffn = MoEFFN(d_model=16, num_experts=4)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(32, 16)),
+                    jnp.float32)
+    params = ffn.init(jax.random.PRNGKey(0), x)["params"]
+    out, aux = ffn.apply({"params": params}, x)
+    assert out.shape == x.shape
+    # perfectly balanced routing gives aux = 1.0; any routing >= 1.0-ish
+    assert 0.5 < float(aux) < 4.0
+
+
+def test_moe_capacity_drops_are_residual_passthrough():
+    # capacity_factor tiny -> most tokens dropped -> their FFN output is 0
+    ffn = MoEFFN(d_model=8, num_experts=2, capacity_factor=0.01)
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(64, 8)), jnp.float32)
+    params = ffn.init(jax.random.PRNGKey(0), x)["params"]
+    out, _ = ffn.apply({"params": params}, x)
+    # C = max(1, 0.01*64/2) = 1 slot per expert -> at most 2 nonzero rows
+    nonzero_rows = int(jnp.sum(jnp.any(jnp.abs(out) > 0, axis=-1)))
+    assert nonzero_rows <= 2
+
+
+def test_moe_sensorformer_forward():
+    m = MoESensorFormer(features=18, d_model=32, num_heads=2, num_layers=2,
+                        num_experts=4)
+    x = jnp.asarray(_x())
+    params = m.init(jax.random.PRNGKey(0), x)["params"]
+    pred, aux = m.apply({"params": params}, x)
+    assert pred.shape == x.shape
+    assert np.isfinite(float(aux))
+
+
+def test_expert_param_specs_target_only_expert_weights():
+    m = MoESensorFormer(features=6, d_model=16, num_heads=2, num_layers=1,
+                        num_experts=4)
+    params = m.init(jax.random.PRNGKey(0),
+                    jnp.zeros((2, 8, 6), jnp.float32))["params"]
+    specs = expert_param_specs(params)
+    assert specs["block0"]["moe"]["w1"] == P("expert")
+    assert specs["block0"]["moe"]["router"]["kernel"] == P()
+    assert specs["embed"]["kernel"] == P()
+
+
+def test_ep_matches_dense_dispatch_when_no_drops():
+    """With capacity >= all tokens, every token is routed; the expert-
+    parallel all_to_all path must reproduce the dense einsum path exactly."""
+    E = 4
+    mesh = make_mesh((2, 2), ("data", "expert"), devices=jax.devices()[:4])
+    # capacity_factor = E guarantees C >= N_local, so no token ever drops
+    model = MoESensorFormer(features=6, d_model=16, num_heads=2, num_layers=1,
+                            num_experts=E, capacity_factor=float(E))
+    x = _x(B=8, T=8, F=6, seed=3)
+    dense_params = model.init(jax.random.PRNGKey(0), jnp.asarray(x))["params"]
+    pred_dense, _ = model.apply({"params": dense_params}, jnp.asarray(x))
+
+    init, step, put_x = make_ep_train_step(model, optax.sgd(0.0), mesh)
+    state = init(jax.random.PRNGKey(0), x)
+
+    # run the sharded forward via the loss's mse output against the oracle
+    _, metrics = step(state, put_x(x))
+    want = float(jnp.mean(jnp.square(pred_dense[:, :-1] - x[:, 1:])))
+    np.testing.assert_allclose(float(metrics["mse"]), want, rtol=1e-4)
+
+
+def test_ep_train_step_learns():
+    mesh = make_mesh((2, 4), ("data", "expert"))
+    model = MoESensorFormer(features=6, d_model=16, num_heads=2, num_layers=1,
+                            num_experts=8, capacity_factor=2.0)
+    init, step, put_x = make_ep_train_step(model, optax.adam(1e-2), mesh)
+    x = _x(B=8, T=8, F=6, seed=4)
+    state = init(jax.random.PRNGKey(1), x)
+    losses = []
+    for _ in range(5):
+        state, m = step(state, put_x(x))
+        losses.append(float(m["mse"]))
+    assert losses[-1] < losses[0]
+    # expert weights actually sharded: local leading dim = E/ep = 8/4 = 2
+    w1 = state.params["block0"]["moe"]["w1"]
+    assert w1.sharding.shard_shape(w1.shape)[0] == 2
