@@ -20,6 +20,7 @@ server.
 from __future__ import annotations
 
 import ctypes
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -109,30 +110,36 @@ class NativeKafkaBroker(ProducePartitionMixin):
                 + (" (SASL)" if sasl_username else ""))
         self._meta: Dict[str, int] = {}
         self._rr: Dict[str, int] = {}
+        # One socket + one C-side staged buffer per handle: serialize every
+        # native call, as the Python twin (kafka_wire.KafkaWireBroker) does.
+        # RLock because create_topic/produce_many re-enter via topic().
+        self._lock = threading.RLock()
 
     # ------------------------------------------------------------ metadata
     def topic(self, name: str) -> TopicSpec:
-        n = self._meta.get(name)
-        if not n:
-            n = _check(self._lib.iotml_kafka_metadata(self._h, name.encode()),
-                       f"metadata({name})")
-            if n == 0:
-                raise KeyError(name)
-            self._meta[name] = n
-        return TopicSpec(name, n)
+        with self._lock:
+            n = self._meta.get(name)
+            if not n:
+                n = _check(self._lib.iotml_kafka_metadata(self._h, name.encode()),
+                           f"metadata({name})")
+                if n == 0:
+                    raise KeyError(name)
+                self._meta[name] = n
+            return TopicSpec(name, n)
 
     def create_topic(self, name: str, partitions: int = 1,
                      retention_messages: Optional[int] = None) -> TopicSpec:
-        existed = _check(self._lib.iotml_kafka_create_topic(
-            self._h, name.encode(), partitions), f"create_topic({name})")
-        if existed:
-            # the topic's real partition count may differ from the request —
-            # refresh from metadata so the partitioner never routes out of
-            # range
-            self._meta.pop(name, None)
-            return self.topic(name)
-        self._meta[name] = partitions
-        return TopicSpec(name, partitions)
+        with self._lock:
+            existed = _check(self._lib.iotml_kafka_create_topic(
+                self._h, name.encode(), partitions), f"create_topic({name})")
+            if existed:
+                # the topic's real partition count may differ from the request —
+                # refresh from metadata so the partitioner never routes out of
+                # range
+                self._meta.pop(name, None)
+                return self.topic(name)
+            self._meta[name] = partitions
+            return TopicSpec(name, partitions)
 
     # ------------------------------------------------------------- produce
     def _partition_count_or_default(self, topic: str) -> int:
@@ -143,68 +150,70 @@ class NativeKafkaBroker(ProducePartitionMixin):
 
     def produce_many(self, topic: str, entries, partition=None) -> int:
         """entries: [(key, value, timestamp_ms)] → offset of the last one."""
-        by_part: Dict[int, list] = {}
-        for key, value, ts in entries:
-            p = self._partition_for(topic, key) if partition is None \
-                else partition
-            by_part.setdefault(p, []).append((key, value, ts))
-        last = -1
-        for p, ents in sorted(by_part.items()):
-            values = b"".join(v for _, v, _ in ents)
-            voff = np.zeros((len(ents) + 1,), np.int64)
-            np.cumsum([len(v) for _, v, _ in ents], out=voff[1:])
-            if any(k is not None for k, _, _ in ents):
-                keys = b"".join(k or b"" for k, _, _ in ents)
-                koff = np.zeros((len(ents) + 1,), np.int64)
-                np.cumsum([len(k or b"") for k, _, _ in ents], out=koff[1:])
-                knull = np.asarray([1 if k is None else 0
-                                    for k, _, _ in ents], np.uint8)
-                kargs = (ctypes.c_char_p(keys), koff.ctypes.data_as(_i64p),
-                         knull.ctypes.data_as(_u8p))
-            else:
-                kargs = (None, None, None)
-            ts = np.asarray([t for _, _, t in ents], np.int64)
-            base = _check(self._lib.iotml_kafka_produce(
-                self._h, topic.encode(), p, ctypes.c_char_p(values),
-                voff.ctypes.data_as(_i64p), *kargs,
-                ts.ctypes.data_as(_i64p), len(ents)),
-                f"produce({topic}:{p})")
-            last = max(last, base + len(ents) - 1)
-        return last
+        with self._lock:
+            by_part: Dict[int, list] = {}
+            for key, value, ts in entries:
+                p = self._partition_for(topic, key) if partition is None \
+                    else partition
+                by_part.setdefault(p, []).append((key, value, ts))
+            last = -1
+            for p, ents in sorted(by_part.items()):
+                values = b"".join(v for _, v, _ in ents)
+                voff = np.zeros((len(ents) + 1,), np.int64)
+                np.cumsum([len(v) for _, v, _ in ents], out=voff[1:])
+                if any(k is not None for k, _, _ in ents):
+                    keys = b"".join(k or b"" for k, _, _ in ents)
+                    koff = np.zeros((len(ents) + 1,), np.int64)
+                    np.cumsum([len(k or b"") for k, _, _ in ents], out=koff[1:])
+                    knull = np.asarray([1 if k is None else 0
+                                        for k, _, _ in ents], np.uint8)
+                    kargs = (ctypes.c_char_p(keys), koff.ctypes.data_as(_i64p),
+                             knull.ctypes.data_as(_u8p))
+                else:
+                    kargs = (None, None, None)
+                ts = np.asarray([t for _, _, t in ents], np.int64)
+                base = _check(self._lib.iotml_kafka_produce(
+                    self._h, topic.encode(), p, ctypes.c_char_p(values),
+                    voff.ctypes.data_as(_i64p), *kargs,
+                    ts.ctypes.data_as(_i64p), len(ents)),
+                    f"produce({topic}:{p})")
+                last = max(last, base + len(ents) - 1)
+            return last
 
     # --------------------------------------------------------------- fetch
     def fetch(self, topic: str, partition: int, offset: int,
               max_messages: int = 1024) -> List[Message]:
-        rc = self._lib.iotml_kafka_fetch(self._h, topic.encode(), partition,
-                                         ctypes.c_int64(offset),
-                                         ctypes.c_int64(max_messages))
-        if rc == -1003:
-            raise KeyError(topic)
-        n = _check(rc, f"fetch({topic}:{partition}@{offset})")
-        if n == 0:
-            return []
-        vb, kb = ctypes.c_int64(), ctypes.c_int64()
-        self._lib.iotml_kafka_staged_bytes(self._h, ctypes.byref(vb),
-                                           ctypes.byref(kb))
-        values = ctypes.create_string_buffer(max(vb.value, 1))
-        keys = ctypes.create_string_buffer(max(kb.value, 1))
-        voff = np.zeros((n + 1,), np.int64)
-        koff = np.zeros((n + 1,), np.int64)
-        knull = np.zeros((n,), np.uint8)
-        moff = np.zeros((n,), np.int64)
-        ts = np.zeros((n,), np.int64)
-        self._lib.iotml_kafka_take(
-            self._h, values, voff.ctypes.data_as(_i64p), keys,
-            koff.ctypes.data_as(_i64p), knull.ctypes.data_as(_u8p),
-            moff.ctypes.data_as(_i64p), ts.ctypes.data_as(_i64p))
-        vraw = values.raw
-        kraw = keys.raw
-        out = []
-        for i in range(n):
-            key = None if knull[i] else kraw[koff[i]:koff[i + 1]]
-            out.append(Message(topic, partition, int(moff[i]),
-                               vraw[voff[i]:voff[i + 1]], key, int(ts[i])))
-        return out
+        with self._lock:
+            rc = self._lib.iotml_kafka_fetch(self._h, topic.encode(), partition,
+                                             ctypes.c_int64(offset),
+                                             ctypes.c_int64(max_messages))
+            if rc == -1003:
+                raise KeyError(topic)
+            n = _check(rc, f"fetch({topic}:{partition}@{offset})")
+            if n == 0:
+                return []
+            vb, kb = ctypes.c_int64(), ctypes.c_int64()
+            self._lib.iotml_kafka_staged_bytes(self._h, ctypes.byref(vb),
+                                               ctypes.byref(kb))
+            values = ctypes.create_string_buffer(max(vb.value, 1))
+            keys = ctypes.create_string_buffer(max(kb.value, 1))
+            voff = np.zeros((n + 1,), np.int64)
+            koff = np.zeros((n + 1,), np.int64)
+            knull = np.zeros((n,), np.uint8)
+            moff = np.zeros((n,), np.int64)
+            ts = np.zeros((n,), np.int64)
+            self._lib.iotml_kafka_take(
+                self._h, values, voff.ctypes.data_as(_i64p), keys,
+                koff.ctypes.data_as(_i64p), knull.ctypes.data_as(_u8p),
+                moff.ctypes.data_as(_i64p), ts.ctypes.data_as(_i64p))
+            vraw = values.raw
+            kraw = keys.raw
+            out = []
+            for i in range(n):
+                key = None if knull[i] else kraw[koff[i]:koff[i + 1]]
+                out.append(Message(topic, partition, int(moff[i]),
+                                   vraw[voff[i]:voff[i + 1]], key, int(ts[i])))
+            return out
 
     def fetch_decode(self, topic: str, partition: int, offset: int,
                      codec: NativeCodec, strip: int = 5,
@@ -212,57 +221,63 @@ class NativeKafkaBroker(ProducePartitionMixin):
                      ) -> Tuple[np.ndarray, np.ndarray, int]:
         """Fused native poll → (numeric [n, F] float64, labels [n, S] bytes,
         next_offset).  n == 0 means no data at `offset`."""
-        numeric = np.empty((max_rows, codec.n_numeric), np.float64)
-        labels = np.zeros((max_rows, max(codec.n_strings, 1)),
-                          f"S{LABEL_STRIDE}")
-        next_off = ctypes.c_int64(offset)
-        rc = self._lib.iotml_kafka_fetch_decode(
-            self._h, topic.encode(), partition, ctypes.c_int64(offset),
-            codec.types.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
-            codec.nullable.ctypes.data_as(_u8p),
-            ctypes.c_int64(codec.n_fields), ctypes.c_int64(strip),
-            numeric.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-            labels.ctypes.data_as(ctypes.c_char_p),
-            ctypes.c_int64(LABEL_STRIDE), ctypes.c_int64(max_rows),
-            ctypes.byref(next_off))
-        if rc <= -2000:
-            raise ValueError(f"malformed Avro message at row {-(rc + 2000) - 1}")
-        if rc == -1003:
-            raise KeyError(topic)
-        n = _check(rc, f"fetch_decode({topic}:{partition}@{offset})")
-        return (numeric[:n], labels[:n, : codec.n_strings],
-                int(next_off.value))
+        with self._lock:
+            numeric = np.empty((max_rows, codec.n_numeric), np.float64)
+            labels = np.zeros((max_rows, max(codec.n_strings, 1)),
+                              f"S{LABEL_STRIDE}")
+            next_off = ctypes.c_int64(offset)
+            rc = self._lib.iotml_kafka_fetch_decode(
+                self._h, topic.encode(), partition, ctypes.c_int64(offset),
+                codec.types.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+                codec.nullable.ctypes.data_as(_u8p),
+                ctypes.c_int64(codec.n_fields), ctypes.c_int64(strip),
+                numeric.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                labels.ctypes.data_as(ctypes.c_char_p),
+                ctypes.c_int64(LABEL_STRIDE), ctypes.c_int64(max_rows),
+                ctypes.byref(next_off))
+            if rc <= -2000:
+                raise ValueError(f"malformed Avro message at row {-(rc + 2000) - 1}")
+            if rc == -1003:
+                raise KeyError(topic)
+            n = _check(rc, f"fetch_decode({topic}:{partition}@{offset})")
+            return (numeric[:n], labels[:n, : codec.n_strings],
+                    int(next_off.value))
 
     # ------------------------------------------------------------- offsets
     def end_offset(self, topic: str, partition: int = 0) -> int:
-        return _check(self._lib.iotml_kafka_list_offset(
-            self._h, topic.encode(), partition, ctypes.c_int64(-1)),
-            f"end_offset({topic}:{partition})")
+        with self._lock:
+            return _check(self._lib.iotml_kafka_list_offset(
+                self._h, topic.encode(), partition, ctypes.c_int64(-1)),
+                f"end_offset({topic}:{partition})")
 
     def begin_offset(self, topic: str, partition: int = 0) -> int:
-        return _check(self._lib.iotml_kafka_list_offset(
-            self._h, topic.encode(), partition, ctypes.c_int64(-2)),
-            f"begin_offset({topic}:{partition})")
+        with self._lock:
+            return _check(self._lib.iotml_kafka_list_offset(
+                self._h, topic.encode(), partition, ctypes.c_int64(-2)),
+                f"begin_offset({topic}:{partition})")
 
     # ------------------------------------------------- consumer-group API
     def commit(self, group: str, topic: str, partition: int,
                next_offset: int) -> None:
-        _check(self._lib.iotml_kafka_commit(
-            self._h, group.encode(), topic.encode(), partition,
-            ctypes.c_int64(next_offset)), f"commit({group},{topic})")
+        with self._lock:
+            _check(self._lib.iotml_kafka_commit(
+                self._h, group.encode(), topic.encode(), partition,
+                ctypes.c_int64(next_offset)), f"commit({group},{topic})")
 
     def committed(self, group: str, topic: str,
                   partition: int) -> Optional[int]:
-        off = self._lib.iotml_kafka_committed(
-            self._h, group.encode(), topic.encode(), partition)
-        if off < -1:  # -1 itself means "no committed offset"
-            raise KafkaProtocolError(off, f"committed({group},{topic})")
-        return None if off == -1 else off
+        with self._lock:
+            off = self._lib.iotml_kafka_committed(
+                self._h, group.encode(), topic.encode(), partition)
+            if off < -1:  # -1 itself means "no committed offset"
+                raise KafkaProtocolError(off, f"committed({group},{topic})")
+            return None if off == -1 else off
 
     def close(self) -> None:
-        if getattr(self, "_h", None):
-            self._lib.iotml_kafka_close(self._h)
-            self._h = None
+        with self._lock:
+            if getattr(self, "_h", None):
+                self._lib.iotml_kafka_close(self._h)
+                self._h = None
 
     def __del__(self):
         try:

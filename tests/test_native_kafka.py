@@ -155,3 +155,42 @@ def test_produce_many_multi_partition(served):
     for key, vals in by_part.items():
         idx = [int(v[1:]) for v in vals]
         assert idx == sorted(idx)
+
+
+def test_concurrent_producer_and_consumer_share_one_client(served):
+    """One socket + one staged buffer per handle: the client must serialize
+    concurrent produce/fetch from different threads (the scorer's
+    write-back-while-polling pattern)."""
+    import threading
+
+    _, client = served
+    client.create_topic("cc", partitions=1)
+    n, errors = 200, []
+
+    def producer():
+        try:
+            for i in range(n):
+                client.produce("cc", f"m{i}".encode(), partition=0)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def consumer():
+        try:
+            seen, off = 0, 0
+            while seen < n:
+                msgs = client.fetch("cc", 0, off)
+                for m in msgs:
+                    assert m.value == f"m{m.offset}".encode()
+                seen += len(msgs)
+                off += len(msgs)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=producer),
+               threading.Thread(target=consumer)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors
+    assert client.end_offset("cc", 0) == n
