@@ -2,3 +2,4 @@ from .broker import Broker, TopicSpec, Message  # noqa: F401
 from .consumer import StreamConsumer, parse_spec  # noqa: F401
 from .producer import OutputSequence  # noqa: F401
 from .csv_source import replay_csv  # noqa: F401
+from .group import GroupCoordinator, GroupConsumer  # noqa: F401

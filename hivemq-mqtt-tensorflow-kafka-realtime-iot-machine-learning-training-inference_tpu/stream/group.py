@@ -1,0 +1,278 @@
+"""Consumer-group coordination: membership, rebalance, elastic recovery.
+
+The reference leans on Kafka's group coordinator for its scale story — 10
+partitions × consumer groups, predict pods as a scalable Deployment that
+K8s restarts freely (SURVEY §2.7, reference `python-scripts/README.md:73`).
+That only works because a crashed consumer's partitions are *reassigned* to
+survivors and resumed from committed offsets.  This module provides those
+semantics for the framework's broker duck-type:
+
+- `GroupCoordinator`: generation-numbered membership with heartbeats and a
+  session timeout; any join/leave/expiry bumps the generation and
+  recomputes assignments (range or round-robin assignor — Kafka's two
+  classic strategies).
+- `GroupConsumer`: a self-healing consumer.  Every `poll()` heartbeats; on
+  a generation change it rejoins, rebuilds per-partition cursors from the
+  group's committed offsets, and carries on.  Crash = stop polling: after
+  the session timeout the coordinator expires the member and survivors pick
+  up its partitions at the last commit (at-least-once, exactly Kafka's
+  contract).
+
+The committed offset is the resume cursor — the same state the reference
+treats as its checkpoint (SURVEY §5: "the Kafka offset is the resume
+cursor").
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .broker import Message
+
+TopicPartition = Tuple[str, int]
+
+
+def range_assign(members: Sequence[str], topic_partitions: Dict[str, int]
+                 ) -> Dict[str, List[TopicPartition]]:
+    """Kafka's RangeAssignor: per topic, contiguous chunks in member order;
+    the first (len % n) members get one extra partition."""
+    out: Dict[str, List[TopicPartition]] = {m: [] for m in members}
+    ms = sorted(members)
+    if not ms:
+        return out
+    for topic in sorted(topic_partitions):
+        n_parts = topic_partitions[topic]
+        per, extra = divmod(n_parts, len(ms))
+        p = 0
+        for i, m in enumerate(ms):
+            take = per + (1 if i < extra else 0)
+            out[m].extend((topic, q) for q in range(p, p + take))
+            p += take
+    return out
+
+
+def roundrobin_assign(members: Sequence[str],
+                      topic_partitions: Dict[str, int]
+                      ) -> Dict[str, List[TopicPartition]]:
+    """Kafka's RoundRobinAssignor: all (topic, partition) pairs dealt out
+    in order across members."""
+    out: Dict[str, List[TopicPartition]] = {m: [] for m in members}
+    ms = sorted(members)
+    if not ms:
+        return out
+    cycle = itertools.cycle(ms)
+    for topic in sorted(topic_partitions):
+        for q in range(topic_partitions[topic]):
+            out[next(cycle)].append((topic, q))
+    return out
+
+
+ASSIGNORS = {"range": range_assign, "roundrobin": roundrobin_assign}
+
+
+class GroupCoordinator:
+    """Generation-numbered group membership over a broker's topics."""
+
+    def __init__(self, broker, group_id: str,
+                 session_timeout_s: float = 10.0, assignor: str = "range",
+                 clock=time.monotonic):
+        if assignor not in ASSIGNORS:
+            raise ValueError(f"unknown assignor {assignor!r}; "
+                             f"choose from {sorted(ASSIGNORS)}")
+        self.broker = broker
+        self.group_id = group_id
+        self.session_timeout_s = session_timeout_s
+        self.assignor = ASSIGNORS[assignor]
+        self._clock = clock
+        self._lock = threading.RLock()
+        self.generation = 0
+        self._heartbeats: Dict[str, float] = {}
+        self._subscriptions: Dict[str, Tuple[str, ...]] = {}
+        self._assignments: Dict[str, List[TopicPartition]] = {}
+
+    # ------------------------------------------------------------ lifecycle
+    def join(self, topics: Sequence[str], member_id: Optional[str] = None
+             ) -> Tuple[str, int, List[TopicPartition]]:
+        """(Re)join the group; returns (member_id, generation, assignment)."""
+        with self._lock:
+            self._expire_dead()
+            member_id = member_id or f"{self.group_id}-{uuid.uuid4().hex[:8]}"
+            self._heartbeats[member_id] = self._clock()
+            self._subscriptions[member_id] = tuple(sorted(topics))
+            self._rebalance()
+            return member_id, self.generation, list(
+                self._assignments.get(member_id, []))
+
+    def leave(self, member_id: str) -> None:
+        with self._lock:
+            if member_id in self._heartbeats:
+                del self._heartbeats[member_id]
+                del self._subscriptions[member_id]
+                self._rebalance()
+
+    def heartbeat(self, member_id: str, generation: int) -> bool:
+        """True iff the member is still current; False demands a rejoin."""
+        with self._lock:
+            self._expire_dead()
+            if member_id not in self._heartbeats or \
+                    generation != self.generation:
+                return False
+            self._heartbeats[member_id] = self._clock()
+            return True
+
+    def assignment(self, member_id: str) -> List[TopicPartition]:
+        with self._lock:
+            return list(self._assignments.get(member_id, []))
+
+    def members(self) -> List[str]:
+        with self._lock:
+            self._expire_dead()
+            return sorted(self._heartbeats)
+
+    # ------------------------------------------------------------ internals
+    def _expire_dead(self) -> None:
+        now = self._clock()
+        dead = [m for m, hb in self._heartbeats.items()
+                if now - hb > self.session_timeout_s]
+        for m in dead:
+            del self._heartbeats[m]
+            del self._subscriptions[m]
+        if dead:
+            self._rebalance()
+
+    def _rebalance(self) -> None:
+        topics: Dict[str, int] = {}
+        for subs in self._subscriptions.values():
+            for t in subs:
+                topics[t] = self.broker.topic(t).partitions
+        members = sorted(self._heartbeats)
+        assignments = self.assignor(members, topics)
+        # only members subscribed to a topic may receive its partitions
+        for m in members:
+            subs = set(self._subscriptions[m])
+            assignments[m] = [tp for tp in assignments[m] if tp[0] in subs]
+        self._assignments = assignments
+        self.generation += 1
+
+
+class GroupConsumer:
+    """Self-healing consumer: rebalance-aware polling with committed-offset
+    resume.  At-least-once: records between the last `commit()` and a crash
+    are redelivered to whichever member inherits the partition."""
+
+    def __init__(self, coordinator: GroupCoordinator, topics: Sequence[str],
+                 member_id: Optional[str] = None,
+                 fallback_offset: int = 0):
+        self.coord = coordinator
+        self.broker = coordinator.broker
+        self.group = coordinator.group_id
+        self.topics = tuple(topics)
+        self.fallback_offset = fallback_offset
+        self._cursors: Dict[TopicPartition, int] = {}
+        self._rr = 0
+        self.rebalances = 0
+        self.member_id, self.generation, assigned = \
+            coordinator.join(self.topics, member_id)
+        self._adopt(assigned)
+
+    # ------------------------------------------------------------- polling
+    def _adopt(self, assigned: List[TopicPartition]) -> None:
+        cursors = {}
+        for tp in assigned:
+            committed = self.broker.committed(self.group, tp[0], tp[1])
+            cursors[tp] = committed if committed is not None \
+                else self.fallback_offset
+        self._cursors = cursors
+
+    def _ensure_membership(self) -> None:
+        if not self.coord.heartbeat(self.member_id, self.generation):
+            self.member_id, self.generation, assigned = \
+                self.coord.join(self.topics, self.member_id)
+            self._adopt(assigned)
+            self.rebalances += 1
+
+    @property
+    def assignment(self) -> List[TopicPartition]:
+        return sorted(self._cursors)
+
+    def poll(self, max_messages: int = 1024) -> List[Message]:
+        """Heartbeat, heal membership if the group moved on, then fetch from
+        assigned partitions round-robin."""
+        self._ensure_membership()
+        tps = sorted(self._cursors)
+        out: List[Message] = []
+        for i in range(len(tps)):
+            if len(out) >= max_messages:
+                break
+            tp = tps[(self._rr + i) % len(tps)]
+            msgs = self.broker.fetch(tp[0], tp[1], self._cursors[tp],
+                                     max_messages - len(out))
+            if msgs:
+                self._cursors[tp] = msgs[-1].offset + 1
+                out.extend(msgs)
+        self._rr += 1
+        return out
+
+    def poll_decoded(self, codec, strip: int = 5, max_messages: int = 4096):
+        """StreamConsumer-compatible fused native poll over the *assigned*
+        partitions (see consumer.StreamConsumer.poll_decoded); lets
+        SensorBatches/StreamScorer run group-elastic without code changes."""
+        import numpy as np
+
+        fd = getattr(self.broker, "fetch_decode", None)
+        if fd is None:
+            return None
+        self._ensure_membership()
+        nums, labs = [], []
+        got = 0
+        tps = sorted(self._cursors)
+        for i in range(len(tps)):
+            if got >= max_messages:
+                break
+            tp = tps[(self._rr + i) % len(tps)]
+            numeric, labels, next_off = fd(tp[0], tp[1], self._cursors[tp],
+                                           codec, strip=strip,
+                                           max_rows=max_messages - got)
+            if len(numeric):
+                self._cursors[tp] = next_off
+                nums.append(numeric)
+                labs.append(labels)
+                got += len(numeric)
+        self._rr += 1
+        if not nums:
+            from .native import LABEL_STRIDE
+
+            return (np.zeros((0, codec.n_numeric)),
+                    np.zeros((0, codec.n_strings), f"S{LABEL_STRIDE}"))
+        return np.concatenate(nums), np.concatenate(labs)
+
+    def at_end(self) -> bool:
+        return all(off >= self.broker.end_offset(t, p)
+                   for (t, p), off in self._cursors.items())
+
+    def __iter__(self):
+        while True:
+            batch = self.poll()
+            if not batch:
+                return
+            yield from batch
+
+    def positions(self) -> List[Tuple[str, int, int]]:
+        return sorted((t, p, off) for (t, p), off in self._cursors.items())
+
+    def seek_to_start(self) -> None:
+        """Group semantics: 'start' is the group's committed position (the
+        resume cursor), not offset 0."""
+        self._adopt(list(self._cursors))
+
+    def commit(self) -> None:
+        for (t, p), off in self._cursors.items():
+            self.broker.commit(self.group, t, p, off)
+
+    def close(self) -> None:
+        self.commit()
+        self.coord.leave(self.member_id)

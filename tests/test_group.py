@@ -1,0 +1,193 @@
+"""Consumer-group rebalance + elastic recovery: the scalable-Deployment
+story the reference delegates to Kafka's coordinator (SURVEY §2.7, §5),
+reproduced against the in-process broker."""
+
+import pytest
+
+from iotml.stream.broker import Broker
+from iotml.stream.group import (GroupConsumer, GroupCoordinator,
+                                range_assign, roundrobin_assign)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def broker():
+    b = Broker()
+    b.create_topic("sensor-data", partitions=10)
+    for i in range(200):
+        b.produce("sensor-data", f"r{i}".encode(), partition=i % 10)
+    return b
+
+
+def test_range_assignor_contiguous_and_balanced():
+    a = range_assign(["m1", "m2", "m3"], {"t": 10})
+    sizes = sorted(len(v) for v in a.values())
+    assert sizes == [3, 3, 4]
+    got = sorted(tp for v in a.values() for tp in v)
+    assert got == [("t", p) for p in range(10)]
+    # contiguity per member
+    for parts in a.values():
+        ps = [p for _, p in parts]
+        assert ps == list(range(ps[0], ps[0] + len(ps)))
+
+
+def test_roundrobin_assignor_interleaves_topics():
+    a = roundrobin_assign(["m1", "m2"], {"t1": 3, "t2": 3})
+    assert sorted(len(v) for v in a.values()) == [3, 3]
+    got = sorted(tp for v in a.values() for tp in v)
+    assert got == [("t1", 0), ("t1", 1), ("t1", 2),
+                   ("t2", 0), ("t2", 1), ("t2", 2)]
+
+
+def test_join_splits_partitions_and_generation_bumps(broker):
+    coord = GroupCoordinator(broker, "g")
+    c1 = GroupConsumer(coord, ["sensor-data"])
+    assert len(c1.assignment) == 10
+    g1 = coord.generation
+
+    c2 = GroupConsumer(coord, ["sensor-data"])
+    assert coord.generation > g1
+    # c1 heals itself on next poll and the split covers all partitions
+    c1.poll()
+    assert len(c1.assignment) == 5 and len(c2.assignment) == 5
+    assert sorted(c1.assignment + c2.assignment) == \
+        [("sensor-data", p) for p in range(10)]
+
+
+def test_all_records_consumed_across_members(broker):
+    coord = GroupCoordinator(broker, "g")
+    c1 = GroupConsumer(coord, ["sensor-data"])
+    c2 = GroupConsumer(coord, ["sensor-data"])
+    seen = set()
+    for c in (c1, c2):
+        while True:
+            msgs = c.poll()
+            if not msgs:
+                break
+            seen.update(m.value for m in msgs)
+    assert len(seen) == 200
+
+
+def test_graceful_leave_hands_partitions_to_survivor(broker):
+    coord = GroupCoordinator(broker, "g")
+    c1 = GroupConsumer(coord, ["sensor-data"])
+    c2 = GroupConsumer(coord, ["sensor-data"])
+    c1.poll()
+
+    # c2 consumes some of its share, commits, leaves
+    got = c2.poll(30)
+    c2.commit()
+    c2.close()
+
+    # c1 inherits everything and resumes c2's partitions at the commit
+    msgs = []
+    while True:
+        chunk = c1.poll()
+        if not chunk:
+            break
+        msgs.extend(chunk)
+    assert len(c1.assignment) == 10
+    values = set(m.value for m in msgs) | set(m.value for m in got)
+    assert len(values) == 200  # no gaps, no redelivery after clean handoff
+
+
+def test_crash_triggers_session_timeout_and_redelivery(broker):
+    clock = FakeClock()
+    coord = GroupCoordinator(broker, "g", session_timeout_s=5.0, clock=clock)
+    c1 = GroupConsumer(coord, ["sensor-data"])
+    c2 = GroupConsumer(coord, ["sensor-data"])
+    c1.poll()
+
+    # c2 consumes 40 records but only commits after the first 20
+    first = c2.poll(20)
+    c2.commit()
+    uncommitted = c2.poll(20)
+    # ...and crashes: no leave(), no more heartbeats
+    clock.t += 6.0
+
+    # survivor's next poll expires the corpse and adopts its partitions
+    msgs = list(c1.poll())
+    assert c1.rebalances >= 1
+    assert len(c1.assignment) == 10
+    while True:
+        chunk = c1.poll()
+        if not chunk:
+            break
+        msgs.extend(chunk)
+    survivor_values = set(m.value for m in msgs)
+    # at-least-once: the 20 uncommitted records ARE redelivered
+    assert set(m.value for m in uncommitted) <= survivor_values
+    # nothing is lost: committed ∪ survivor = everything
+    assert set(m.value for m in first) | survivor_values == \
+        {f"r{i}".encode() for i in range(200)}
+
+
+def test_scale_out_mid_stream_no_duplicates_with_commits(broker):
+    coord = GroupCoordinator(broker, "g")
+    c1 = GroupConsumer(coord, ["sensor-data"])
+    part1 = c1.poll(50)
+    c1.commit()
+
+    c2 = GroupConsumer(coord, ["sensor-data"])  # scale-out
+    rest = []
+    for c in (c1, c2):
+        while True:
+            chunk = c.poll()
+            if not chunk:
+                break
+            rest.extend(chunk)
+    # with a commit before the rebalance, handoff introduces no duplicates
+    all_msgs = part1 + rest
+    assert len(all_msgs) == 200
+    assert len(set(m.value for m in all_msgs)) == 200
+
+
+def test_heartbeat_rejects_stale_generation(broker):
+    coord = GroupCoordinator(broker, "g")
+    m1, gen1, _ = coord.join(["sensor-data"])
+    coord.join(["sensor-data"])  # second member bumps generation
+    assert coord.heartbeat(m1, gen1) is False
+    m1b, gen2, assigned = coord.join(["sensor-data"], m1)
+    assert m1b == m1 and gen2 == coord.generation
+    assert coord.heartbeat(m1, gen2) is True
+
+
+def test_group_elastic_sensorbatches_pipeline():
+    """End-to-end elasticity: two group members run SensorBatches over a
+    partitioned framed-Avro sensor stream; one crashes mid-consume; the
+    survivor adopts its partitions and the fleet's records all get through
+    (at-least-once)."""
+    from iotml.data.dataset import SensorBatches
+    from iotml.gen.simulator import FleetGenerator, FleetScenario
+
+    b = Broker()
+    gen = FleetGenerator(FleetScenario(num_cars=50, failure_rate=0.0))
+    total = gen.publish(b, "SENSOR_DATA_S_AVRO", n_ticks=20, partitions=10)
+    assert total == 1000
+
+    clock = FakeClock()
+    coord = GroupCoordinator(b, "scorers", session_timeout_s=5.0, clock=clock)
+    c1 = GroupConsumer(coord, ["SENSOR_DATA_S_AVRO"])
+    c2 = GroupConsumer(coord, ["SENSOR_DATA_S_AVRO"])
+    c1.poll(1)  # heal after c2's join; drops the fetched record (redelivered)
+
+    b1 = SensorBatches(c1, batch_size=100)
+    b2 = SensorBatches(c2, batch_size=100)
+
+    # c2 consumes one drain pass of its share, commits nothing, crashes
+    crashed_rows = sum(batch.n_valid for batch in b2)
+    assert crashed_rows > 0
+    clock.t += 6.0  # session timeout expires the corpse
+
+    survivor_rows = sum(batch.n_valid for batch in b1)
+    c1.commit()
+    # survivor saw everything c2 never committed
+    assert survivor_rows == 1000
+    assert len(c1.assignment) == 10
