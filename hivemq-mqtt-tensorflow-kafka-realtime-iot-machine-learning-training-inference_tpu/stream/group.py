@@ -8,15 +8,21 @@ survivors and resumed from committed offsets.  This module provides those
 semantics for the framework's broker duck-type:
 
 - `GroupCoordinator`: generation-numbered membership with heartbeats and a
-  session timeout; any join/leave/expiry bumps the generation and
+  session timeout; a membership change (new member, leave, expiry,
+  subscription change, topic metadata change) bumps the generation and
   recomputes assignments (range or round-robin assignor — Kafka's two
-  classic strategies).
+  classic strategies).  A rejoin from a current member with an unchanged
+  subscription does NOT bump the generation — it simply hands back the
+  current assignment, so members converge after a rebalance instead of
+  invalidating each other forever.
 - `GroupConsumer`: a self-healing consumer.  Every `poll()` heartbeats; on
   a generation change it rejoins, rebuilds per-partition cursors from the
   group's committed offsets, and carries on.  Crash = stop polling: after
   the session timeout the coordinator expires the member and survivors pick
   up its partitions at the last commit (at-least-once, exactly Kafka's
-  contract).
+  contract).  Commits are generation-fenced: a member that fell behind a
+  rebalance cannot clobber offsets committed by the partition's current
+  owner (Kafka's ILLEGAL_GENERATION check).
 
 The committed offset is the resume cursor — the same state the reference
 treats as its checkpoint (SURVEY §5: "the Kafka offset is the resume
@@ -32,6 +38,7 @@ import uuid
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .broker import Message
+from .consumer import StreamConsumer
 
 TopicPartition = Tuple[str, int]
 
@@ -79,7 +86,7 @@ class GroupCoordinator:
 
     def __init__(self, broker, group_id: str,
                  session_timeout_s: float = 10.0, assignor: str = "range",
-                 clock=time.monotonic):
+                 clock=time.monotonic, metadata_max_age_s: float = 5.0):
         if assignor not in ASSIGNORS:
             raise ValueError(f"unknown assignor {assignor!r}; "
                              f"choose from {sorted(ASSIGNORS)}")
@@ -93,17 +100,35 @@ class GroupCoordinator:
         self._heartbeats: Dict[str, float] = {}
         self._subscriptions: Dict[str, Tuple[str, ...]] = {}
         self._assignments: Dict[str, List[TopicPartition]] = {}
+        self._last_topics: Dict[str, int] = {}  # metadata at last rebalance
+        # metadata.max.age.ms analogue: heartbeats between sweeps reuse the
+        # cached topic view, so the per-poll cost stays O(1) and a broker
+        # whose metadata lookups are network calls isn't probed per poll
+        self.metadata_max_age_s = metadata_max_age_s
+        self._meta_checked_at: Optional[float] = None
 
     # ------------------------------------------------------------ lifecycle
     def join(self, topics: Sequence[str], member_id: Optional[str] = None
              ) -> Tuple[str, int, List[TopicPartition]]:
-        """(Re)join the group; returns (member_id, generation, assignment)."""
+        """(Re)join the group; returns (member_id, generation, assignment).
+
+        Only a *change* — new member, changed subscription, expired peers,
+        or topic metadata drift — triggers a rebalance.  A current member
+        rejoining identically just receives the standing assignment, which
+        is what lets every member converge onto one generation after a
+        rebalance instead of livelocking on mutual invalidation."""
         with self._lock:
             self._expire_dead()
+            known = member_id is not None and member_id in self._heartbeats
             member_id = member_id or f"{self.group_id}-{uuid.uuid4().hex[:8]}"
+            subs = tuple(sorted(topics))
+            changed = (not known
+                       or self._subscriptions.get(member_id) != subs
+                       or self._topic_metadata(force=True) != self._last_topics)
             self._heartbeats[member_id] = self._clock()
-            self._subscriptions[member_id] = tuple(sorted(topics))
-            self._rebalance()
+            self._subscriptions[member_id] = subs
+            if changed:
+                self._rebalance()
             return member_id, self.generation, list(
                 self._assignments.get(member_id, []))
 
@@ -115,13 +140,40 @@ class GroupCoordinator:
                 self._rebalance()
 
     def heartbeat(self, member_id: str, generation: int) -> bool:
-        """True iff the member is still current; False demands a rejoin."""
+        """True iff the member is still current; False demands a rejoin.
+
+        Also watches topic metadata: a subscribed topic appearing (or
+        growing partitions) triggers a rebalance, so consumers deployed
+        before their producers pick the topic up once it exists — Kafka's
+        metadata-refresh rebalance."""
         with self._lock:
             self._expire_dead()
             if member_id not in self._heartbeats or \
                     generation != self.generation:
                 return False
+            if self._topic_metadata() != self._last_topics:
+                self._rebalance()
+                return False
             self._heartbeats[member_id] = self._clock()
+            return True
+
+    def fenced_commit(self, member_id: str, generation: int,
+                      positions: Sequence[Tuple[str, int, int]]) -> bool:
+        """Commit offsets iff the member is current for this generation.
+
+        Kafka rejects commits from fenced members (ILLEGAL_GENERATION);
+        without this, a consumer that fell behind a rebalance could
+        overwrite newer offsets committed by the partition's new owner.
+        Only partitions in the member's *current* assignment are written.
+        Returns True when the commit was accepted."""
+        with self._lock:
+            if member_id not in self._heartbeats or \
+                    generation != self.generation:
+                return False
+            owned = set(self._assignments.get(member_id, []))
+            for t, p, off in positions:
+                if (t, p) in owned:
+                    self.broker.commit(self.group_id, t, p, off)
             return True
 
     def assignment(self, member_id: str) -> List[TopicPartition]:
@@ -134,6 +186,38 @@ class GroupCoordinator:
             return sorted(self._heartbeats)
 
     # ------------------------------------------------------------ internals
+    def _topic_metadata(self, force: bool = False) -> Dict[str, int]:
+        """Partition counts for subscribed topics that exist right now.
+        A subscribed-but-absent topic simply contributes nothing yet
+        (Kafka consumers may legally subscribe before the topic is
+        created).
+
+        Probes at most once per `metadata_max_age_s` unless forced; each
+        unique topic is queried once per sweep.  Brokers that cache topic
+        metadata (NativeKafkaBroker) are asked to refresh via
+        `refresh_topic`, so partition growth becomes visible."""
+        now = self._clock()
+        if (not force and self._meta_checked_at is not None
+                and now - self._meta_checked_at < self.metadata_max_age_s):
+            return self._last_topics
+        self._meta_checked_at = now
+        subscribed = set()
+        for subs in self._subscriptions.values():
+            subscribed.update(subs)
+        refresh = getattr(self.broker, "refresh_topic", None)
+        topics: Dict[str, int] = {}
+        for t in sorted(subscribed):
+            if refresh is not None:
+                n = refresh(t)
+                if n:
+                    topics[t] = n
+            else:
+                try:
+                    topics[t] = self.broker.topic(t).partitions
+                except KeyError:
+                    continue
+        return topics
+
     def _expire_dead(self) -> None:
         now = self._clock()
         dead = [m for m, hb in self._heartbeats.items()
@@ -145,10 +229,7 @@ class GroupCoordinator:
             self._rebalance()
 
     def _rebalance(self) -> None:
-        topics: Dict[str, int] = {}
-        for subs in self._subscriptions.values():
-            for t in subs:
-                topics[t] = self.broker.topic(t).partitions
+        topics = self._topic_metadata(force=True)
         members = sorted(self._heartbeats)
         assignments = self.assignor(members, topics)
         # only members subscribed to a topic may receive its partitions
@@ -156,13 +237,18 @@ class GroupCoordinator:
             subs = set(self._subscriptions[m])
             assignments[m] = [tp for tp in assignments[m] if tp[0] in subs]
         self._assignments = assignments
+        self._last_topics = topics
         self.generation += 1
 
 
 class GroupConsumer:
     """Self-healing consumer: rebalance-aware polling with committed-offset
     resume.  At-least-once: records between the last `commit()` and a crash
-    are redelivered to whichever member inherits the partition."""
+    are redelivered to whichever member inherits the partition.
+
+    Internally delegates fetching to a `StreamConsumer` rebuilt on every
+    rebalance, so the fused native decode hot path (`poll_decoded`) and the
+    cursor bookkeeping live in exactly one place."""
 
     def __init__(self, coordinator: GroupCoordinator, topics: Sequence[str],
                  member_id: Optional[str] = None,
@@ -172,21 +258,31 @@ class GroupConsumer:
         self.group = coordinator.group_id
         self.topics = tuple(topics)
         self.fallback_offset = fallback_offset
-        self._cursors: Dict[TopicPartition, int] = {}
-        self._rr = 0
         self.rebalances = 0
         self.member_id, self.generation, assigned = \
             coordinator.join(self.topics, member_id)
         self._adopt(assigned)
 
     # ------------------------------------------------------------- polling
-    def _adopt(self, assigned: List[TopicPartition]) -> None:
-        cursors = {}
-        for tp in assigned:
-            committed = self.broker.committed(self.group, tp[0], tp[1])
-            cursors[tp] = committed if committed is not None \
-                else self.fallback_offset
-        self._cursors = cursors
+    def _adopt(self, assigned: List[TopicPartition],
+               sticky: bool = True) -> None:
+        # Cooperative-sticky semantics: partitions this member kept across
+        # the rebalance carry their in-memory position forward (no duplicate
+        # redelivery of uncommitted progress); only newly-inherited
+        # partitions resume from the group's committed offset.
+        held = ({(t, p): off for t, p, off in self._sc.positions()}
+                if sticky and hasattr(self, "_sc") else {})
+        specs = []
+        for t, p in assigned:
+            if (t, p) in held:
+                off = held[(t, p)]
+            else:
+                committed = self.broker.committed(self.group, t, p)
+                off = committed if committed is not None \
+                    else self.fallback_offset
+            specs.append(f"{t}:{p}:{off}")
+        self._sc = StreamConsumer(self.broker, specs, group=self.group,
+                                  eof=False)
 
     def _ensure_membership(self) -> None:
         if not self.coord.heartbeat(self.member_id, self.generation):
@@ -197,62 +293,26 @@ class GroupConsumer:
 
     @property
     def assignment(self) -> List[TopicPartition]:
-        return sorted(self._cursors)
+        return sorted((t, p) for t, p, _ in self._sc.positions())
 
     def poll(self, max_messages: int = 1024) -> List[Message]:
         """Heartbeat, heal membership if the group moved on, then fetch from
         assigned partitions round-robin."""
         self._ensure_membership()
-        tps = sorted(self._cursors)
-        out: List[Message] = []
-        for i in range(len(tps)):
-            if len(out) >= max_messages:
-                break
-            tp = tps[(self._rr + i) % len(tps)]
-            msgs = self.broker.fetch(tp[0], tp[1], self._cursors[tp],
-                                     max_messages - len(out))
-            if msgs:
-                self._cursors[tp] = msgs[-1].offset + 1
-                out.extend(msgs)
-        self._rr += 1
-        return out
+        return self._sc.poll(max_messages)
 
     def poll_decoded(self, codec, strip: int = 5, max_messages: int = 4096):
         """StreamConsumer-compatible fused native poll over the *assigned*
         partitions (see consumer.StreamConsumer.poll_decoded); lets
         SensorBatches/StreamScorer run group-elastic without code changes."""
-        import numpy as np
-
-        fd = getattr(self.broker, "fetch_decode", None)
-        if fd is None:
+        if getattr(self.broker, "fetch_decode", None) is None:
             return None
         self._ensure_membership()
-        nums, labs = [], []
-        got = 0
-        tps = sorted(self._cursors)
-        for i in range(len(tps)):
-            if got >= max_messages:
-                break
-            tp = tps[(self._rr + i) % len(tps)]
-            numeric, labels, next_off = fd(tp[0], tp[1], self._cursors[tp],
-                                           codec, strip=strip,
-                                           max_rows=max_messages - got)
-            if len(numeric):
-                self._cursors[tp] = next_off
-                nums.append(numeric)
-                labs.append(labels)
-                got += len(numeric)
-        self._rr += 1
-        if not nums:
-            from .native import LABEL_STRIDE
-
-            return (np.zeros((0, codec.n_numeric)),
-                    np.zeros((0, codec.n_strings), f"S{LABEL_STRIDE}"))
-        return np.concatenate(nums), np.concatenate(labs)
+        return self._sc.poll_decoded(codec, strip=strip,
+                                     max_messages=max_messages)
 
     def at_end(self) -> bool:
-        return all(off >= self.broker.end_offset(t, p)
-                   for (t, p), off in self._cursors.items())
+        return self._sc.at_end()
 
     def __iter__(self):
         while True:
@@ -262,16 +322,19 @@ class GroupConsumer:
             yield from batch
 
     def positions(self) -> List[Tuple[str, int, int]]:
-        return sorted((t, p, off) for (t, p), off in self._cursors.items())
+        return sorted(self._sc.positions())
 
     def seek_to_start(self) -> None:
         """Group semantics: 'start' is the group's committed position (the
         resume cursor), not offset 0."""
-        self._adopt(list(self._cursors))
+        self._adopt([(t, p) for t, p, _ in self._sc.positions()],
+                    sticky=False)
 
-    def commit(self) -> None:
-        for (t, p), off in self._cursors.items():
-            self.broker.commit(self.group, t, p, off)
+    def commit(self) -> bool:
+        """Generation-fenced commit; returns False (and writes nothing) when
+        this member has been fenced by a rebalance it hasn't seen yet."""
+        return self.coord.fenced_commit(self.member_id, self.generation,
+                                        self._sc.positions())
 
     def close(self) -> None:
         self.commit()

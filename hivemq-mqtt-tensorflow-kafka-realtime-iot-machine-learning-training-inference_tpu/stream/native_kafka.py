@@ -127,6 +127,24 @@ class NativeKafkaBroker(ProducePartitionMixin):
                 self._meta[name] = n
             return TopicSpec(name, n)
 
+    def refresh_topic(self, name: str) -> Optional[int]:
+        """Drop the cached partition count and re-query broker metadata.
+
+        `topic()` caches positive lookups forever (the fused fetch hot path
+        must not pay a metadata round-trip per poll), so partition growth is
+        only visible through an explicit refresh — the group coordinator
+        calls this on its rate-limited metadata sweep (metadata.max.age.ms
+        analogue).  Returns the fresh count, or None while the topic does
+        not exist (yet)."""
+        with self._lock:
+            self._meta.pop(name, None)
+            n = _check(self._lib.iotml_kafka_metadata(self._h, name.encode()),
+                       f"metadata({name})")
+            if n == 0:
+                return None
+            self._meta[name] = n
+            return n
+
     def create_topic(self, name: str, partitions: int = 1,
                      retention_messages: Optional[int] = None) -> TopicSpec:
         with self._lock:

@@ -1,1 +1,4 @@
 from .tasks import JsonToAvro, RekeyByCar, TumblingCounter, StreamTask  # noqa: F401
+from .sql import (SqlEngine, SqlError, REFERENCE_PIPELINE_DDL,  # noqa: F401
+                  install_reference_pipeline)
+from .server import KsqlServer  # noqa: F401

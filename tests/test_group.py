@@ -79,7 +79,7 @@ def test_graceful_leave_hands_partitions_to_survivor(broker):
     coord = GroupCoordinator(broker, "g")
     c1 = GroupConsumer(coord, ["sensor-data"])
     c2 = GroupConsumer(coord, ["sensor-data"])
-    c1.poll()
+    healed = c1.poll()  # absorbs c2's join; sticky positions keep progress
 
     # c2 consumes some of its share, commits, leaves
     got = c2.poll(30)
@@ -87,7 +87,7 @@ def test_graceful_leave_hands_partitions_to_survivor(broker):
     c2.close()
 
     # c1 inherits everything and resumes c2's partitions at the commit
-    msgs = []
+    msgs = list(healed)
     while True:
         chunk = c1.poll()
         if not chunk:
@@ -96,6 +96,7 @@ def test_graceful_leave_hands_partitions_to_survivor(broker):
     assert len(c1.assignment) == 10
     values = set(m.value for m in msgs) | set(m.value for m in got)
     assert len(values) == 200  # no gaps, no redelivery after clean handoff
+    assert len(msgs) + len(got) == 200  # ...and exactly once, in fact
 
 
 def test_crash_triggers_session_timeout_and_redelivery(broker):
@@ -103,7 +104,7 @@ def test_crash_triggers_session_timeout_and_redelivery(broker):
     coord = GroupCoordinator(broker, "g", session_timeout_s=5.0, clock=clock)
     c1 = GroupConsumer(coord, ["sensor-data"])
     c2 = GroupConsumer(coord, ["sensor-data"])
-    c1.poll()
+    healed = list(c1.poll())  # absorbs c2's join; keeps its own progress
 
     # c2 consumes 40 records but only commits after the first 20
     first = c2.poll(20)
@@ -124,9 +125,10 @@ def test_crash_triggers_session_timeout_and_redelivery(broker):
     survivor_values = set(m.value for m in msgs)
     # at-least-once: the 20 uncommitted records ARE redelivered
     assert set(m.value for m in uncommitted) <= survivor_values
-    # nothing is lost: committed ∪ survivor = everything
-    assert set(m.value for m in first) | survivor_values == \
-        {f"r{i}".encode() for i in range(200)}
+    # nothing is lost: committed ∪ everything c1 was delivered = all records
+    # (sticky positions: c1's pre-crash progress is NOT redelivered to it)
+    assert set(m.value for m in first) | set(m.value for m in healed) \
+        | survivor_values == {f"r{i}".encode() for i in range(200)}
 
 
 def test_scale_out_mid_stream_no_duplicates_with_commits(broker):
@@ -176,7 +178,7 @@ def test_group_elastic_sensorbatches_pipeline():
     coord = GroupCoordinator(b, "scorers", session_timeout_s=5.0, clock=clock)
     c1 = GroupConsumer(coord, ["SENSOR_DATA_S_AVRO"])
     c2 = GroupConsumer(coord, ["SENSOR_DATA_S_AVRO"])
-    c1.poll(1)  # heal after c2's join; drops the fetched record (redelivered)
+    pre = len(c1.poll(1))  # heal after c2's join; delivers one record to c1
 
     b1 = SensorBatches(c1, batch_size=100)
     b2 = SensorBatches(c2, batch_size=100)
@@ -188,6 +190,79 @@ def test_group_elastic_sensorbatches_pipeline():
 
     survivor_rows = sum(batch.n_valid for batch in b1)
     c1.commit()
-    # survivor saw everything c2 never committed
-    assert survivor_rows == 1000
+    # survivor saw everything c2 never committed; with sticky positions the
+    # record already delivered to c1 pre-crash is not delivered twice
+    assert survivor_rows + pre == 1000
     assert len(c1.assignment) == 10
+
+
+def test_two_members_alternating_polls_converge(broker):
+    """Regression: a rejoin with an unchanged subscription must not bump the
+    generation, else two alternating pollers livelock in perpetual mutual
+    invalidation and never progress past the last commit."""
+    coord = GroupCoordinator(broker, "g")
+    c1 = GroupConsumer(coord, ["sensor-data"])
+    c2 = GroupConsumer(coord, ["sensor-data"])
+    # c2's join invalidated c1 once; after both have healed, polls alternate
+    # with no further rebalances and every record is delivered exactly once.
+    seen = set()
+    for _ in range(40):
+        for c in (c1, c2):
+            for m in c.poll(16):
+                assert m.value not in seen, "duplicate delivery"
+                seen.add(m.value)
+    assert len(seen) == 200
+    assert c1.rebalances + c2.rebalances <= 2
+    assert coord.generation <= 3
+
+
+def test_subscribe_before_topic_exists(broker):
+    """Kafka allows subscribing to a not-yet-created topic; membership must
+    survive it and pick the topic up (metadata rebalance) once it appears."""
+    coord = GroupCoordinator(broker, "g", metadata_max_age_s=0.0)
+    c = GroupConsumer(coord, ["late-topic"])
+    assert c.assignment == []
+    assert c.poll() == []  # heartbeats fine with nothing assigned
+    broker.create_topic("late-topic", partitions=3)
+    broker.produce("late-topic", b"x", partition=1)
+    got = c.poll() or c.poll()  # first poll absorbs the metadata rebalance
+    assert [m.value for m in got] == [b"x"]
+    assert c.assignment == [("late-topic", p) for p in range(3)]
+
+
+def test_fenced_member_cannot_regress_commits(broker):
+    """Regression: a member that fell behind a rebalance must not clobber
+    offsets committed by the partition's current owner (ILLEGAL_GENERATION)."""
+    clock = FakeClock()
+    coord = GroupCoordinator(broker, "g", session_timeout_s=5.0, clock=clock)
+    c1 = GroupConsumer(coord, ["sensor-data"])
+    for _ in range(3):
+        c1.poll(30)  # advance cursors but do NOT commit
+    clock.t += 10.0  # c1's session expires
+    c2 = GroupConsumer(coord, ["sensor-data"])
+    while not c2.at_end():
+        c2.poll(1000)
+    assert c2.commit() is True
+    end_offsets = {p: broker.committed("g", "sensor-data", p)
+                   for p in range(10)}
+    # stale c1 shutting down must not write its old cursors over c2's
+    assert c1.commit() is False
+    c1.close()
+    assert {p: broker.committed("g", "sensor-data", p)
+            for p in range(10)} == end_offsets
+
+
+def test_metadata_probe_rate_limited(broker):
+    """Heartbeats between metadata sweeps reuse the cached topic view
+    (metadata.max.age.ms analogue); the sweep fires once the age expires."""
+    clock = FakeClock()
+    coord = GroupCoordinator(broker, "g", clock=clock, metadata_max_age_s=5.0)
+    c = GroupConsumer(coord, ["sensor-data", "late-topic"])
+    assert c.assignment == [("sensor-data", p) for p in range(10)]
+    broker.create_topic("late-topic", partitions=2)
+    clock.t += 1.0
+    c.poll()  # within max age: cached view, no rebalance yet
+    assert ("late-topic", 0) not in c.assignment
+    clock.t += 5.0
+    c.poll()  # sweep runs, sees the new topic, rebalances
+    assert ("late-topic", 0) in c.assignment

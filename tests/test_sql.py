@@ -1,0 +1,225 @@
+"""KSQL-equivalent SQL dialect + REST server.
+
+Mirrors the reference's actual KSQL usage: the four-object DDL pipeline
+(`01_installConfluentPlatform.sh:229-258`), `PRINT 'topic' FROM BEGINNING`
+(`infrastructure/confluent/README.md:99`), SHOW/DESCRIBE/TERMINATE/DROP
+lifecycle, and REST POSTs to /ksql + /query."""
+
+import http.client
+import json
+
+import pytest
+
+from iotml.core.schema import KSQL_CAR_SCHEMA
+from iotml.ops.avro import AvroCodec
+from iotml.ops.framing import unframe
+from iotml.stream.broker import Broker
+from iotml.streamproc import (KsqlServer, SqlEngine, SqlError,
+                              install_reference_pipeline)
+
+
+def _json_record(car: int, speed: float = 10.0, failure: str = "false"):
+    rec = {
+        "coolant_temp": 90.0, "intake_air_temp": 25.0,
+        "intake_air_flow_speed": 20.0, "battery_percentage": 70.0,
+        "battery_voltage": 380.0, "current_draw": 20.0, "speed": speed,
+        "engine_vibration_amplitude": speed * 100, "throttle_pos": 0.5,
+        "tire_pressure_1_1": 30, "tire_pressure_1_2": 30,
+        "tire_pressure_2_1": 31, "tire_pressure_2_2": 31,
+        "accelerometer_1_1_value": 2.0, "accelerometer_1_2_value": 2.0,
+        "accelerometer_2_1_value": 2.0, "accelerometer_2_2_value": 2.0,
+        "control_unit_firmware": 1000, "failure_occurred": failure,
+    }
+    return json.dumps(rec).encode()
+
+
+def _produce_fleet(broker, n_cars=4, per_car=6):
+    broker.create_topic("sensor-data", partitions=2)
+    for c in range(n_cars):
+        key = f"car{c}".encode()
+        for i in range(per_car):
+            broker.produce("sensor-data", _json_record(c, speed=float(i)),
+                           key=key, timestamp_ms=i * 60_000)
+
+
+def test_reference_pipeline_ddl_end_to_end():
+    broker = Broker()
+    _produce_fleet(broker)
+    engine = SqlEngine(broker)
+    results = install_reference_pipeline(engine)
+    assert all(r.get("commandStatus", {}).get("status") == "SUCCESS"
+               for r in results)
+    emitted = engine.pump()
+    assert emitted > 0
+
+    # The AVRO output topic must be byte-compatible with what the ML ingest
+    # layer decodes (KSQL_CAR_SCHEMA, Confluent-framed) — the load-bearing
+    # contract of the reference's KSQL stage.
+    codec = AvroCodec(KSQL_CAR_SCHEMA)
+    msgs = broker.fetch("SENSOR_DATA_S_AVRO", 0, 0, max_messages=10)
+    assert msgs, "CSAS produced nothing on partition 0"
+    sid, payload = unframe(msgs[0].value)
+    rec = codec.decode(payload)
+    assert rec["INTAKE_AIR_TEMP"] == 25.0
+    assert rec["FAILURE_OCCURRED"] == "false"
+    assert sid == engine.registry.latest("SENSOR_DATA_S_AVRO-value").schema_id
+
+    # REKEY: messages keyed by car id.
+    spec = broker.topic("SENSOR_DATA_S_AVRO_REKEY")
+    keys = set()
+    for p in range(spec.partitions):
+        for m in broker.fetch("SENSOR_DATA_S_AVRO_REKEY", p, 0, 1000):
+            keys.add(m.key)
+    assert keys == {b"car0", b"car1", b"car2", b"car3"}
+
+    # CTAS tumbling 5-min count: 6 records/car at minutes 0..5 ⇒ windows
+    # [0,5min) holds 5 and [5min,10min) holds 1, per car.
+    table = engine.table("SENSOR_DATA_EVENTS_PER_5MIN_T")
+    assert table[("car0", 0)]["EVENT_COUNT"] == 5
+    assert table[("car0", 300_000)]["EVENT_COUNT"] == 1
+
+
+def test_pump_is_incremental_and_resumable():
+    broker = Broker()
+    _produce_fleet(broker, n_cars=1, per_car=3)
+    engine = SqlEngine(broker)
+    install_reference_pipeline(engine)
+    engine.pump()
+    n0 = broker.end_offset("SENSOR_DATA_S_AVRO", 0) + \
+        broker.end_offset("SENSOR_DATA_S_AVRO", 1)
+    engine.pump()  # no new input ⇒ no duplicate output
+    n1 = broker.end_offset("SENSOR_DATA_S_AVRO", 0) + \
+        broker.end_offset("SENSOR_DATA_S_AVRO", 1)
+    assert n1 == n0 == 3
+    broker.produce("sensor-data", _json_record(0), key=b"car0")
+    engine.pump()
+    n2 = broker.end_offset("SENSOR_DATA_S_AVRO", 0) + \
+        broker.end_offset("SENSOR_DATA_S_AVRO", 1)
+    assert n2 == 4
+
+
+def test_where_filter_and_expressions():
+    broker = Broker()
+    broker.create_topic("t", partitions=1)
+    for i in range(10):
+        broker.produce("t", json.dumps({"v": i, "label": "odd" if i % 2 else
+                                        "even"}).encode(), key=b"k")
+    engine = SqlEngine(broker)
+    engine.execute("CREATE STREAM S (V DOUBLE, LABEL STRING) "
+                   "WITH (KAFKA_TOPIC='t', VALUE_FORMAT='JSON');")
+    engine.execute("CREATE STREAM EVENS AS SELECT V, V * 2 AS DOUBLED "
+                   "FROM S WHERE LABEL = 'even' AND V >= 2;")
+    engine.pump()
+    rows = engine.execute("SELECT V, DOUBLED FROM EVENS;")[0]["rows"]
+    assert [r[0] for r in rows] == [2, 4, 6, 8]
+    assert [r[1] for r in rows] == [4, 8, 12, 16]
+
+
+def test_show_describe_terminate_drop_lifecycle():
+    broker = Broker()
+    broker.create_topic("t", partitions=1)
+    engine = SqlEngine(broker)
+    engine.execute("CREATE STREAM S (V DOUBLE) WITH (KAFKA_TOPIC='t');")
+    engine.execute("CREATE STREAM S2 AS SELECT V FROM S;")
+    assert {s["name"] for s in engine.execute("SHOW STREAMS;")[0]["streams"]} \
+        == {"S", "S2"}
+    queries = engine.execute("SHOW QUERIES;")[0]["queries"]
+    assert len(queries) == 1 and queries[0]["id"].startswith("CSAS_S2")
+    desc = engine.execute("DESCRIBE S2;")[0]["sourceDescription"]
+    assert desc["fields"] == [{"name": "V", "type": "DOUBLE"}]
+
+    # KSQL semantics: can't drop a stream a live query writes into
+    with pytest.raises(SqlError):
+        engine.execute("DROP STREAM S2;")
+    # ... nor one a live query reads from
+    with pytest.raises(SqlError):
+        engine.execute("DROP STREAM S;")
+    engine.execute(f"TERMINATE {queries[0]['id']};")
+    engine.execute("DROP STREAM S2;")
+    engine.execute("DROP STREAM S;")
+    assert engine.execute("SHOW STREAMS;")[0]["streams"] == []
+    # idempotent teardown, as the reference's delete script replays DDL
+    engine.execute("DROP STREAM IF EXISTS S2;")
+
+
+def test_print_topic_from_beginning():
+    broker = Broker()
+    _produce_fleet(broker, n_cars=1, per_car=2)
+    engine = SqlEngine(broker)
+    res = engine.execute("PRINT 'sensor-data' FROM BEGINNING LIMIT 2;")[0]
+    assert res["topic"] == "sensor-data"
+    assert len(res["rows"]) == 2
+    assert json.loads(res["rows"][0]["value"])["speed"] == 0.0
+
+
+def test_bad_statements_raise():
+    engine = SqlEngine(Broker())
+    for bad in ("FROB THE STREAM;", "CREATE STREAM X AS SELECT * FROM NOPE;",
+                "SELECT * FROM MISSING;", "TERMINATE NOPE;"):
+        with pytest.raises(SqlError):
+            engine.execute(bad)
+
+
+def test_rest_server_ksql_and_query():
+    broker = Broker()
+    _produce_fleet(broker, n_cars=2, per_car=3)
+    engine = SqlEngine(broker)
+    server = KsqlServer(engine, pump_interval_s=0.01).start()
+    try:
+        conn = http.client.HTTPConnection(server.host, server.port, timeout=5)
+
+        def post(path, sql):
+            conn.request("POST", path, json.dumps({"ksql": sql}),
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            return r.status, r.read()
+
+        status, body = post("/ksql", "SHOW TOPICS;")
+        assert status == 200
+        assert any(t["name"] == "sensor-data"
+                   for t in json.loads(body)[0]["topics"])
+
+        from iotml.streamproc.sql import REFERENCE_PIPELINE_DDL
+        status, body = post("/ksql", REFERENCE_PIPELINE_DDL)
+        assert status == 200 and len(json.loads(body)) == 4
+        server.pump_now()
+
+        status, body = post("/query",
+                            "SELECT ROWKEY, SPEED FROM SENSOR_DATA_S_AVRO "
+                            "WHERE SPEED >= 1 LIMIT 3;")
+        assert status == 200
+        lines = [json.loads(x) for x in body.decode().splitlines()]
+        assert lines[0]["header"] == ["ROWKEY", "SPEED"]
+        assert len(lines) == 4  # header + 3 rows
+
+        status, body = post("/ksql", "BOGUS;")
+        assert status == 400
+        assert json.loads(body)["@type"] == "statement_error"
+
+        conn.request("GET", "/healthcheck")
+        assert json.loads(conn.getresponse().read())["isHealthy"] is True
+    finally:
+        server.stop()
+
+
+def test_sql_output_feeds_training_batches():
+    """The full L4→L5 contract: KSQL-equivalent output is directly consumable
+    by the ML data layer (SensorBatches), as in the reference where the
+    training pod reads the CSAS topic (`model-training.yaml:15`)."""
+    from iotml.data.dataset import SensorBatches
+    from iotml.stream.consumer import StreamConsumer
+
+    broker = Broker()
+    _produce_fleet(broker, n_cars=3, per_car=40)
+    engine = SqlEngine(broker)
+    install_reference_pipeline(engine)
+    engine.pump()
+
+    spec = broker.topic("SENSOR_DATA_S_AVRO")
+    consumer = StreamConsumer(
+        broker, [f"SENSOR_DATA_S_AVRO:{p}:0" for p in range(spec.partitions)],
+        group="sql-train")
+    batches = SensorBatches(consumer, batch_size=32, only_normal=True)
+    batch = next(iter(batches))
+    assert batch.x.shape == (32, 18)
+    assert batch.n_valid == 32
