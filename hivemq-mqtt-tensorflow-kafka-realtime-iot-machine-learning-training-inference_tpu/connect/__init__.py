@@ -1,7 +1,8 @@
 from .connectors import (DocumentStoreSink, FileStreamSource, HoistFieldKey,
                          ObjectStoreSink)
 from .runtime import ConnectWorker, SinkConnector, SourceConnector, SourceRecord
+from .server import ConnectServer
 
-__all__ = ["ConnectWorker", "SourceConnector", "SinkConnector", "SourceRecord",
+__all__ = ["ConnectWorker", "ConnectServer", "SourceConnector", "SinkConnector", "SourceRecord",
            "FileStreamSource", "DocumentStoreSink", "ObjectStoreSink",
            "HoistFieldKey"]

@@ -106,6 +106,15 @@ class ConnectWorker:
         self._sinks.append(_SinkEntry(name, connector, consumer,
                                       tuple(transforms)))
 
+    def remove(self, name: str) -> bool:
+        """Unregister a connector by name (Connect's DELETE). Sink progress
+        stays committed under `connect-<name>`, so re-adding the connector
+        resumes where it left off."""
+        n0 = len(self._sources) + len(self._sinks)
+        self._sources = [s for s in self._sources if s.name != name]
+        self._sinks = [k for k in self._sinks if k.name != name]
+        return len(self._sources) + len(self._sinks) < n0
+
     # ------------------------------------------------------------- driving
     def run_once(self, max_messages: int = 4096) -> Dict[str, int]:
         """One pass: drain every source, then deliver available messages to

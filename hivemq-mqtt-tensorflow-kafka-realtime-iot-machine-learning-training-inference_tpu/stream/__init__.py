@@ -3,3 +3,5 @@ from .consumer import StreamConsumer, parse_spec  # noqa: F401
 from .producer import OutputSequence  # noqa: F401
 from .csv_source import replay_csv  # noqa: F401
 from .group import GroupCoordinator, GroupConsumer  # noqa: F401
+from .registry import SchemaRegistry, RegisteredSchema, parse_avsc  # noqa: F401
+from .registry_server import SchemaRegistryServer  # noqa: F401

@@ -122,13 +122,15 @@ class GroupCoordinator:
             known = member_id is not None and member_id in self._heartbeats
             member_id = member_id or f"{self.group_id}-{uuid.uuid4().hex[:8]}"
             subs = tuple(sorted(topics))
-            changed = (not known
-                       or self._subscriptions.get(member_id) != subs
-                       or self._topic_metadata(force=True) != self._last_topics)
+            sub_changed = (not known
+                           or self._subscriptions.get(member_id) != subs)
             self._heartbeats[member_id] = self._clock()
             self._subscriptions[member_id] = subs
-            if changed:
-                self._rebalance()
+            # one probe, taken after the subscription update so it covers
+            # this member's topics; _rebalance reuses it (no double probe)
+            meta = self._topic_metadata(force=True)
+            if sub_changed or meta != self._last_topics:
+                self._rebalance(meta)
             return member_id, self.generation, list(
                 self._assignments.get(member_id, []))
 
@@ -151,8 +153,9 @@ class GroupCoordinator:
             if member_id not in self._heartbeats or \
                     generation != self.generation:
                 return False
-            if self._topic_metadata() != self._last_topics:
-                self._rebalance()
+            meta = self._topic_metadata()
+            if meta is not self._last_topics and meta != self._last_topics:
+                self._rebalance(meta)
                 return False
             self._heartbeats[member_id] = self._clock()
             return True
@@ -228,8 +231,9 @@ class GroupCoordinator:
         if dead:
             self._rebalance()
 
-    def _rebalance(self) -> None:
-        topics = self._topic_metadata(force=True)
+    def _rebalance(self, topics: Optional[Dict[str, int]] = None) -> None:
+        if topics is None:
+            topics = self._topic_metadata(force=True)
         members = sorted(self._heartbeats)
         assignments = self.assignor(members, topics)
         # only members subscribed to a topic may receive its partitions

@@ -20,113 +20,75 @@ from __future__ import annotations
 
 import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ..utils.rest import RestError, RestServer
 from .sql import SqlEngine, SqlError
 
 
-class KsqlServer:
-    """Threaded HTTP front-end + continuous-query pump for one SqlEngine."""
+class KsqlServer(RestServer):
+    """REST front-end + continuous-query pump for one SqlEngine."""
 
     def __init__(self, engine: SqlEngine, host: str = "127.0.0.1",
                  port: int = 0, pump_interval_s: float = 0.05):
+        super().__init__(host, port, name="iotml-ksql")
         self.engine = engine
         self._lock = threading.Lock()  # engine is not thread-safe per se
         self.pump_interval_s = pump_interval_s
         self._stop = threading.Event()
         self._pump_thread: Optional[threading.Thread] = None
 
-        server = self
+        self.route("GET", r"/info", lambda m, b: (200, {"KsqlServerInfo": {
+            "version": "iotml-sql-1.0", "kafkaClusterId": "iotml-broker",
+            "ksqlServiceId": "iotml-ksql"}}))
+        self.route("GET", r"/healthcheck",
+                   lambda m, b: (200, {"isHealthy": True}))
+        self.route("POST", r"/ksql", self._ksql)
+        self.route("POST", r"/query", self._query)
 
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
+    @staticmethod
+    def _sql_of(body) -> str:
+        if isinstance(body, dict):
+            return body.get("ksql", body.get("sql", ""))
+        if isinstance(body, str):  # bare SQL string body
+            return body
+        raise RestError(400, "body must be a JSON object with a 'ksql' field")
 
-            def _reply(self, code: int, obj, content_type="application/json"):
-                body = (obj if isinstance(obj, bytes)
-                        else json.dumps(obj, default=str).encode())
-                self.send_response(code)
-                self.send_header("Content-Type", content_type)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+    def _ksql(self, m, body):
+        sql = self._sql_of(body)
+        try:
+            with self._lock:
+                return 200, self.engine.execute(sql)
+        except SqlError as e:
+            return 400, {"@type": "statement_error", "message": str(e),
+                         "statementText": sql}
 
-            def _body(self) -> dict:
-                n = int(self.headers.get("Content-Length", 0))
-                raw = self.rfile.read(n) if n else b"{}"
-                try:
-                    return json.loads(raw or b"{}")
-                except ValueError:
-                    return {}
+    def _query(self, m, body):
+        sql = self._sql_of(body)
+        try:
+            with self._lock:
+                results = self.engine.execute(sql)
+        except SqlError as e:
+            return 400, {"@type": "statement_error", "message": str(e)}
+        lines = []
+        for res in results:
+            if "rows" in res and "header" in res:
+                lines.append(json.dumps({"header": res["header"]},
+                                        default=str))
+                lines.extend(json.dumps({"row": r}, default=str)
+                             for r in res["rows"])
+            elif "rows" in res:  # PRINT
+                lines.extend(json.dumps(r, default=str) for r in res["rows"])
+            else:
+                lines.append(json.dumps(res, default=str))
+        body_bytes = ("\n".join(lines) + "\n").encode()
+        return 200, body_bytes, "application/x-ndjson"
 
-            def do_GET(self):
-                if self.path == "/info":
-                    self._reply(200, {"KsqlServerInfo": {
-                        "version": "iotml-sql-1.0",
-                        "kafkaClusterId": "iotml-broker",
-                        "ksqlServiceId": "iotml-ksql"}})
-                elif self.path == "/healthcheck":
-                    self._reply(200, {"isHealthy": True})
-                else:
-                    self._reply(404, {"message": "not found"})
-
-            def do_POST(self):
-                req = self._body()
-                sql = req.get("ksql", req.get("sql", ""))
-                if self.path == "/ksql":
-                    try:
-                        with server._lock:
-                            results = server.engine.execute(sql)
-                        self._reply(200, results)
-                    except SqlError as e:
-                        self._reply(400, {"@type": "statement_error",
-                                          "message": str(e),
-                                          "statementText": sql})
-                    except Exception as e:  # engine bug: 500, keep serving
-                        self._reply(500, {"@type": "server_error",
-                                          "message": f"{type(e).__name__}: {e}",
-                                          "statementText": sql})
-                elif self.path == "/query":
-                    try:
-                        with server._lock:
-                            results = server.engine.execute(sql)
-                        lines = []
-                        for res in results:
-                            if "rows" in res and "header" in res:
-                                lines.append(json.dumps(
-                                    {"header": res["header"]}, default=str))
-                                lines.extend(json.dumps({"row": r}, default=str)
-                                             for r in res["rows"])
-                            elif "rows" in res:  # PRINT
-                                lines.extend(json.dumps(r, default=str)
-                                             for r in res["rows"])
-                            else:
-                                lines.append(json.dumps(res, default=str))
-                        body = ("\n".join(lines) + "\n").encode()
-                        self._reply(200, body,
-                                    content_type="application/x-ndjson")
-                    except SqlError as e:
-                        self._reply(400, {"@type": "statement_error",
-                                          "message": str(e)})
-                    except Exception as e:  # engine bug: 500, keep serving
-                        self._reply(500, {"@type": "server_error",
-                                          "message": f"{type(e).__name__}: {e}"})
-                else:
-                    self._reply(404, {"message": "not found"})
-
-            def log_message(self, *a):  # quiet
-                pass
-
-        self.httpd = ThreadingHTTPServer((host, port), Handler)
-        self.host, self.port = self.httpd.server_address
-
-    @property
-    def url(self) -> str:
-        return f"http://{self.host}:{self.port}"
-
+    # --------------------------------------------------------- lifecycle
     def start(self):
-        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
-        self._pump_thread = threading.Thread(target=self._pump_loop, daemon=True)
+        super().start()
+        self._pump_thread = threading.Thread(target=self._pump_loop,
+                                             daemon=True)
         self._pump_thread.start()
         return self
 
@@ -152,5 +114,4 @@ class KsqlServer:
         self._stop.set()
         if self._pump_thread is not None:
             self._pump_thread.join(timeout=2)
-        self.httpd.shutdown()
-        self.httpd.server_close()
+        super().stop()

@@ -359,7 +359,10 @@ def _parse_select(t: _Toks) -> SelectStmt:
     st = SelectStmt()
     t.expect("SELECT")
     while True:
-        st.items.append(_parse_select_item(t))
+        it = _parse_select_item(t)
+        if it.alias == "EXPR":  # unaliased expression: KSQL's auto-naming
+            it.alias = f"KSQL_COL_{len(st.items)}"
+        st.items.append(it)
         if not t.accept(","):
             break
     t.expect("FROM")
@@ -549,6 +552,51 @@ class SqlAggTask(StreamTask):
                           if src_meta.value_format == "AVRO" else None)
         # (group_key, window_start) → {alias: accumulator}
         self.acc: Dict[tuple, dict] = {}
+        self._restore_from_changelog()
+
+    def _restore_from_changelog(self) -> None:
+        """Rebuild aggregate state from the output topic.
+
+        The consumer resumes from committed offsets, so without this a
+        restarted CTAS would silently undercount: already-consumed input is
+        skipped but `acc` starts empty.  The output topic *is* the table's
+        changelog (latest row per key wins — KSQL's state-store restore from
+        the changelog topic); AVG additionally persists its running sum and
+        count as `__sum_`/`__n_` fields in each emitted row."""
+        if self.dst not in self.broker.topics():
+            return
+        spec = self.broker.topic(self.dst)
+        for p in range(spec.partitions):
+            off = self.broker.begin_offset(self.dst, p)
+            end = self.broker.end_offset(self.dst, p)
+            while off < end:
+                msgs = self.broker.fetch(self.dst, p, off, max_messages=1024)
+                if not msgs:
+                    break
+                for m in msgs:
+                    off = m.offset + 1
+                    try:
+                        row = json.loads(m.value)
+                    except (ValueError, UnicodeDecodeError):
+                        continue
+                    if not isinstance(row, dict):
+                        continue
+                    gval = (m.key or b"").decode(errors="replace")
+                    win = row.get("WINDOW_START_MS", 0)
+                    slot = self.acc.setdefault((gval, win), {})
+                    for k, v in row.items():
+                        if k == "WINDOW_START_MS":
+                            continue
+                        slot[k] = v  # latest record per key wins
+
+    def _changelog_row(self, slot: dict, row: dict) -> dict:
+        """Add AVG aux state (`__sum_`/`__n_`) so restore is exact."""
+        for it in self.stmt.items:
+            if it.agg == "AVG":
+                for aux in ("__sum_" + it.alias, "__n_" + it.alias):
+                    if aux in slot:
+                        row[aux] = slot[aux]
+        return row
 
     def _update(self, key: tuple, rec: dict):
         slot = self.acc.setdefault(key, {})
@@ -608,6 +656,7 @@ class SqlAggTask(StreamTask):
                     row[it.alias] = gval if it.alias == self.stmt.group_by else None
             if self.stmt.window_ms:
                 row["WINDOW_START_MS"] = win
+            row = self._changelog_row(self.acc[(gval, win)], row)
             out.append((gval.encode(), json.dumps(row, default=str).encode(), win))
         return out
 

@@ -1,0 +1,129 @@
+"""Minimal routed JSON-over-HTTP server scaffold (stdlib only).
+
+Three control-plane services in the reference are REST APIs the rebuild
+must speak: the Schema Registry (`register_schema.py:20-31`), Kafka Connect
+(`mongodb/README.md:139-171`), and KSQL (`01_installConfluentPlatform.sh`).
+This scaffold gives them one tiny routing layer: regex routes, JSON bodies,
+JSON replies, threaded serving — nothing more.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, List, Optional, Tuple
+
+#: A handler takes (match, body_dict) and returns (status_code, json_obj).
+Route = Tuple[str, "re.Pattern", Callable]
+
+
+class RestError(Exception):
+    """Raise from a route handler to produce an error reply."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+class RestServer:
+    """Routed threaded HTTP server; subclass or compose with `route()`."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 name: str = "iotml-rest"):
+        self.name = name
+        self._routes: List[Route] = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            server_version = name
+
+            def _dispatch(self, method: str):
+                body = {}
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                if n:
+                    try:
+                        body = json.loads(self.rfile.read(n))
+                    except ValueError:
+                        self._send(400, {"error_code": 400,
+                                         "message": "malformed JSON body"})
+                        return
+                for m, pat, fn in outer._routes:
+                    if m != method:
+                        continue
+                    match = pat.fullmatch(self.path)
+                    if match:
+                        try:
+                            result = fn(match, body)
+                            if len(result) == 3:  # (code, raw bytes, ctype)
+                                self._send_raw(*result)
+                                return
+                            code, obj = result
+                        except RestError as e:
+                            code, obj = e.code, {"error_code": e.code,
+                                                 "message": e.message}
+                        except Exception as e:  # route bug: 500, keep serving
+                            code, obj = 500, {"error_code": 500, "message":
+                                              f"{type(e).__name__}: {e}"}
+                        self._send(code, obj)
+                        return
+                self._send(404, {"error_code": 404,
+                                 "message": f"no route for {method} {self.path}"})
+
+            def _send(self, code: int, obj):
+                self.send_response(code)
+                if code == 204:  # No Content: a body would corrupt keep-alive
+                    self.end_headers()
+                    return
+                payload = json.dumps(obj, default=str).encode()
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def _send_raw(self, code: int, payload: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_PUT(self):
+                self._dispatch("PUT")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self.httpd.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def route(self, method: str, pattern: str, fn: Callable) -> None:
+        """Register `fn(match, body) -> (code, obj)` for `method pattern`."""
+        self._routes.append((method, re.compile(pattern), fn))
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()

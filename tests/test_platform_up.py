@@ -1,0 +1,117 @@
+"""Full-platform bring-up: every reference service in one process, every
+interaction over its real network surface (MQTT TCP, Kafka wire TCP, three
+REST APIs) — the `terraform apply`-to-first-record path of SURVEY §3.5,
+minus the Kubernetes cluster."""
+
+import http.client
+import json
+import time
+
+import pytest
+
+from iotml.cli.up import Platform
+
+
+@pytest.fixture
+def platform():
+    p = Platform(partitions=4).start()
+    yield p
+    p.stop()
+
+
+def _get(url_host, port, path):
+    conn = http.client.HTTPConnection(url_host, port, timeout=5)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    return r.status, json.loads(r.read())
+
+
+def test_mqtt_to_ksql_to_training_over_real_sockets(platform):
+    """Device → MQTT TCP → bridge → sensor-data → KSQL pipeline → framed
+    Avro → training batches: the reference's L1→L5 ingest path end-to-end."""
+    from iotml.core.schema import KSQL_CAR_SCHEMA
+    from iotml.data.dataset import SensorBatches
+    from iotml.gen.simulator import FleetGenerator, FleetScenario
+    from iotml.mqtt.wire import MqttClient
+    from iotml.stream.consumer import StreamConsumer
+
+    scenario = FleetScenario(num_cars=8, failure_rate=0.0)
+    gen = FleetGenerator(scenario)
+    clients = [MqttClient("127.0.0.1", platform.mqtt.port, scenario.car_id(i))
+               for i in range(8)]
+    for _ in range(40):
+        cols = gen.step_columns()
+        for i, c in enumerate(clients):
+            rec = gen.row_record(cols, i, KSQL_CAR_SCHEMA)
+            c.publish(f"vehicles/sensor/data/{scenario.car_id(i)}",
+                      json.dumps(rec).encode(), qos=1)
+    for c in clients:
+        c.disconnect()
+
+    deadline = time.time() + 10
+    while platform.bridge.forwarded() < 320 and time.time() < deadline:
+        time.sleep(0.05)
+    assert platform.bridge.forwarded() == 320
+
+    platform.pump()  # run the KSQL pipeline over what arrived
+
+    spec = platform.broker.topic("SENSOR_DATA_S_AVRO")
+    consumer = StreamConsumer(
+        platform.broker,
+        [f"SENSOR_DATA_S_AVRO:{p}:0" for p in range(spec.partitions)],
+        group="up-test")
+    batches = SensorBatches(consumer, batch_size=64)
+    batch = next(iter(batches))
+    assert batch.x.shape == (64, 18)
+
+
+def test_all_rest_surfaces_respond(platform):
+    eps = platform.endpoints()
+
+    host, port = eps["schema-registry"].rsplit(":", 1)[0].split("//")[1], \
+        int(eps["schema-registry"].rsplit(":", 1)[1])
+    status, subjects = _get(host, port, "/subjects")
+    assert status == 200
+    assert "sensor-data-value" in subjects
+    assert "SENSOR_DATA_S_AVRO-value" in subjects
+
+    host, port = eps["ksql"].split("//")[1].rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port), timeout=5)
+    conn.request("POST", "/ksql", json.dumps({"ksql": "SHOW QUERIES;"}),
+                 {"Content-Type": "application/json"})
+    queries = json.loads(conn.getresponse().read())[0]["queries"]
+    assert len(queries) == 3  # the reference DDL's persistent queries
+
+    host, port = eps["connect"].split("//")[1].rsplit(":", 1)
+    status, plugins = _get(host, int(port), "/connector-plugins")
+    assert status == 200 and len(plugins) == 3
+
+
+def test_kafka_wire_port_serves_reference_topics(platform):
+    from iotml.stream.kafka_wire import KafkaWireBroker
+
+    client = KafkaWireBroker(f"127.0.0.1:{platform.kafka.port}")
+    topics = client.topics()
+    assert "sensor-data" in topics and "model-predictions" in topics
+    assert client.topic("sensor-data").partitions == 4
+    client.produce("model-predictions", b"[0.1 0.2]", key=b"car0")
+    msgs = client.fetch("model-predictions", 0, 0)
+    end = sum(client.end_offset("model-predictions", p) for p in range(4))
+    assert end == 1
+    client.close()
+
+
+def test_platform_with_live_fleet():
+    p = Platform(partitions=2).start()
+    try:
+        p.start_fleet(num_cars=5, rate_hz=20.0)
+        deadline = time.time() + 10
+        while p.bridge.forwarded() < 10 and time.time() < deadline:
+            time.sleep(0.1)
+        assert p.bridge.forwarded() >= 10
+        p.pump()
+        end = sum(p.broker.end_offset("SENSOR_DATA_S_AVRO", q)
+                  for q in range(p.broker.topic("SENSOR_DATA_S_AVRO").partitions))
+        assert end >= 10
+    finally:
+        p.stop()

@@ -1,0 +1,202 @@
+"""Schema-Registry + Kafka-Connect REST surfaces.
+
+Mirrors the reference's HTTP usage: `register_schema.py:20-31` (POST
+/subjects/{s}/versions), console-consumer id resolution (GET /schemas/ids),
+and the Connect workflows in `kafka-connect/mongodb/README.md:139-175` and
+`gcs/README.md:21-43` (POST /connectors with connector.class configs,
+status, delete)."""
+
+import http.client
+import json
+import os
+
+import pytest
+
+from iotml.connect import ConnectServer, ConnectWorker
+from iotml.core.schema import CAR_SCHEMA, KSQL_CAR_SCHEMA
+from iotml.stream import Broker, SchemaRegistry, SchemaRegistryServer
+
+
+class Client:
+    def __init__(self, server):
+        self.conn = http.client.HTTPConnection(server.host, server.port,
+                                               timeout=5)
+
+    def req(self, method, path, body=None):
+        payload = json.dumps(body) if body is not None else None
+        self.conn.request(method, path, payload,
+                          {"Content-Type": "application/json"})
+        r = self.conn.getresponse()
+        raw = r.read()
+        return r.status, (json.loads(raw) if raw else None)
+
+
+@pytest.fixture
+def registry_api():
+    reg = SchemaRegistry()
+    server = SchemaRegistryServer(reg).start()
+    yield Client(server), reg
+    server.stop()
+
+
+def test_registry_register_and_resolve(registry_api):
+    api, reg = registry_api
+    avsc = CAR_SCHEMA.avro_json()
+    status, body = api.req("POST", "/subjects/sensor-data-value/versions",
+                           {"schema": avsc})
+    assert status == 200 and body["id"] >= 1
+    sid = body["id"]
+
+    # idempotent re-register (same fingerprint → same id)
+    status, body2 = api.req("POST", "/subjects/sensor-data-value/versions",
+                            {"schema": avsc})
+    assert body2["id"] == sid
+
+    status, body = api.req("GET", f"/schemas/ids/{sid}")
+    assert status == 200
+    assert json.loads(body["schema"])["name"] == "CarData"
+
+    status, body = api.req("GET", "/subjects")
+    assert body == ["sensor-data-value"]
+
+    # second version under the subject
+    api.req("POST", "/subjects/sensor-data-value/versions",
+            {"schema": KSQL_CAR_SCHEMA.avro_json()})
+    status, body = api.req("GET", "/subjects/sensor-data-value/versions")
+    assert body == [1, 2]
+    status, body = api.req("GET", "/subjects/sensor-data-value/versions/latest")
+    assert body["version"] == 2
+    status, body = api.req("GET", "/subjects/sensor-data-value/versions/1")
+    assert body["id"] == sid
+
+    # POST /subjects/{s}: is this schema registered here?
+    status, body = api.req("POST", "/subjects/sensor-data-value",
+                           {"schema": avsc})
+    assert status == 200 and body["id"] == sid
+    status, body = api.req("POST", "/subjects/other", {"schema": avsc})
+    assert status == 404
+
+
+def test_registry_error_paths(registry_api):
+    api, _ = registry_api
+    assert api.req("GET", "/schemas/ids/99")[0] == 404
+    assert api.req("GET", "/subjects/nope/versions")[0] == 404
+    assert api.req("POST", "/subjects/s/versions", {})[0] == 422
+    assert api.req("POST", "/subjects/s/versions",
+                   {"schema": "not json"})[0] == 422
+    assert api.req("GET", "/bogus")[0] == 404
+
+
+def test_connect_rest_filestream_to_document_twin(tmp_path):
+    """The reference's two sink workflows driven purely over REST: CSV file →
+    FileStreamSource → topic; topic → DocumentStoreSink (digital twin with
+    HoistField$Key semantics)."""
+    src_file = tmp_path / "feed.txt"
+    src_file.write_text("")
+    twin_path = str(tmp_path / "twin.json")
+
+    broker = Broker()
+    worker = ConnectWorker(broker)
+    server = ConnectServer(worker, poll_interval_s=9999).start()  # manual pump
+    try:
+        api = Client(server)
+        status, plugins = api.req("GET", "/connector-plugins")
+        assert {p["class"] for p in plugins} == {
+            "FileStreamSource", "DocumentStoreSink", "ObjectStoreSink"}
+
+        status, body = api.req("POST", "/connectors", {
+            "name": "csv-source",
+            "config": {"connector.class":
+                       "org.apache.kafka.connect.file.FileStreamSourceConnector",
+                       "file": str(src_file), "topic": "car-data-csv"}})
+        assert status == 201
+
+        # the twin consumes the *keyed* stream (reference: topic sensor-data,
+        # key = MQTT client id, HoistField$Key wraps it as _id)
+        broker.create_topic("sensor-data")
+        broker.produce("sensor-data", b'{"speed": 3.0}', key=b"car1")
+        broker.produce("sensor-data", b'{"speed": 7.0}', key=b"car2")
+        status, body = api.req("POST", "/connectors", {
+            "name": "mongodb-twin",
+            "config": {"connector.class":
+                       "com.mongodb.kafka.connect.MongoSinkConnector",
+                       "topics": "sensor-data", "path": twin_path,
+                       "hoist.key.field": "_id"}})
+        assert status == 201
+
+        # duplicate create → 409, like Connect
+        assert api.req("POST", "/connectors", {
+            "name": "csv-source", "config": {
+                "connector.class": "FileStreamSource",
+                "file": str(src_file), "topic": "t"}})[0] == 409
+
+        src_file.write_text('{"speed": 12.5}\n{"speed": 99.0}\n')
+        server.pump_now()  # source drains the file
+        server.pump_now()  # sink consumes the topic
+
+        status, names = api.req("GET", "/connectors")
+        assert names == ["csv-source", "mongodb-twin"]
+        status, st = api.req("GET", "/connectors/mongodb-twin/status")
+        assert st["connector"]["state"] == "RUNNING"
+        assert st["tasks"][0]["records_processed"] == 2
+
+        # twin materialized on disk, one document per car, key hoisted
+        with open(twin_path) as fh:
+            docs = json.load(fh)
+        assert set(docs) == {"car1", "car2"}
+        assert docs["car1"]["speed"] == 3.0
+        # latest-state-wins upsert (digital-twin contract)
+        broker.produce("sensor-data", b'{"speed": 8.0}', key=b"car1")
+        server.pump_now()
+        with open(twin_path) as fh:
+            assert json.load(fh)["car1"]["speed"] == 8.0
+
+        # delete → connector gone, worker no longer drives it
+        status, _ = api.req("DELETE", "/connectors/csv-source")
+        assert status == 204
+        assert api.req("GET", "/connectors/csv-source")[0] == 404
+        src_file.write_text('{"speed": 1}\n' * 3)
+        counts = server.pump_now()
+        assert "csv-source" not in counts
+    finally:
+        server.stop()
+
+
+def test_connect_rest_object_store_sink(tmp_path):
+    """GCS-style data-lake sink over REST: framed-Avro topic → .avro
+    container files with the connector's object-naming scheme."""
+    from iotml.gen.simulator import FleetGenerator, FleetScenario
+    from iotml.ops.avro_container import read_container
+
+    broker = Broker()
+    gen = FleetGenerator(FleetScenario(num_cars=10, failure_rate=0.0))
+    n = gen.publish(broker, "SENSOR_DATA_S_AVRO", n_ticks=30)
+    lake = str(tmp_path / "lake")
+
+    worker = ConnectWorker(broker)
+    server = ConnectServer(worker, poll_interval_s=9999).start()
+    try:
+        api = Client(server)
+        status, _ = api.req("POST", "/connectors", {
+            "name": "gcs-lake",
+            "config": {"connector.class":
+                       "io.confluent.connect.gcs.GcsSinkConnector",
+                       "topics": "SENSOR_DATA_S_AVRO", "directory": lake,
+                       "flush.size": "100"}})
+        assert status == 201
+        server.pump_now()
+
+        files = sorted(os.listdir(lake))
+        assert files and all(f.startswith("SENSOR_DATA_S_AVRO+0+")
+                             and f.endswith(".avro") for f in files)
+        rows = 0
+        for f in files:
+            _, records = read_container(os.path.join(lake, f))
+            rows += len(records)
+        assert rows == n
+
+        status, err = api.req("POST", "/connectors", {
+            "name": "bad", "config": {"connector.class": "NopeConnector"}})
+        assert status == 400 and "unknown connector.class" in err["message"]
+    finally:
+        server.stop()

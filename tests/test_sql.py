@@ -223,3 +223,63 @@ def test_sql_output_feeds_training_batches():
     batch = next(iter(batches))
     assert batch.x.shape == (32, 18)
     assert batch.n_valid == 32
+
+
+def test_unaliased_expressions_get_unique_auto_names():
+    broker = Broker()
+    broker.create_topic("t", partitions=1)
+    broker.produce("t", json.dumps({"v": 10}).encode(), key=b"k")
+    engine = SqlEngine(broker)
+    engine.execute("CREATE STREAM S (V DOUBLE) "
+                   "WITH (KAFKA_TOPIC='t', VALUE_FORMAT='JSON');")
+    engine.execute("CREATE STREAM D AS SELECT V + 1, V - 1 FROM S;")
+    engine.pump()
+    desc = engine.execute("DESCRIBE D;")[0]["sourceDescription"]
+    names = [f["name"] for f in desc["fields"]]
+    assert len(set(names)) == 2  # no silent column collision
+    row = json.loads(broker.fetch("D", 0, 0)[0].value)
+    assert sorted(row.values()) == [9, 11]
+
+
+def test_ctas_aggregate_state_survives_engine_restart():
+    """The CTAS output topic is the table's changelog: a restarted engine
+    rebuilds aggregate state from it instead of undercounting."""
+    broker = Broker()
+    _produce_fleet(broker, n_cars=2, per_car=4)
+    e1 = SqlEngine(broker)
+    install_reference_pipeline(e1)
+    e1.pump()
+    t1 = e1.table("SENSOR_DATA_EVENTS_PER_5MIN_T")
+    assert t1[("car0", 0)]["EVENT_COUNT"] == 4
+
+    # more records arrive while the "server" is down
+    for i in range(3):
+        broker.produce("sensor-data", _json_record(0), key=b"car0",
+                       timestamp_ms=i * 60_000)
+
+    e2 = SqlEngine(broker)  # fresh process, same broker
+    install_reference_pipeline(e2)
+    e2.pump()
+    t2 = e2.table("SENSOR_DATA_EVENTS_PER_5MIN_T")
+    assert t2[("car0", 0)]["EVENT_COUNT"] == 7  # 4 restored + 3 new
+
+
+def test_rest_rejects_non_object_bodies_gracefully():
+    engine = SqlEngine(Broker())
+    server = KsqlServer(engine, pump_interval_s=9999).start()
+    try:
+        conn = http.client.HTTPConnection(server.host, server.port, timeout=5)
+        for payload in ('[1,2,3]', '42'):
+            conn.request("POST", "/ksql", payload,
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            assert r.status == 400
+            r.read()
+        # a bare SQL string body is accepted as a convenience
+        conn.request("POST", "/ksql", '"SHOW STREAMS;"',
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        assert r.status == 200
+        assert json.loads(r.read())[0]["streams"] == []
+    finally:
+        server.stop()
