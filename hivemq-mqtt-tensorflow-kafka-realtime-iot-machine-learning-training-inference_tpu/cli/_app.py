@@ -66,7 +66,9 @@ def run_streaming_app(argv, *, prog: str, usage: str, make_model: Callable,
     if mode not in ("train", "predict"):
         print(f"Mode is invalid, must be either 'train' or 'predict': {mode}")
         return 1
-    offset = int(offset)
+    offset = offset.strip().lower()
+    if offset != "committed":
+        offset = int(offset)
 
     applied = getattr(cfg, "applied", set())
     if "train.epochs" in applied:
@@ -84,7 +86,27 @@ def run_streaming_app(argv, *, prog: str, usage: str, make_model: Callable,
 
     broker = _broker_for(servers, topic, cfg)
     store = ArtifactStore(artifact_root)
-    consumer = StreamConsumer(broker, [f"{topic}:0:{offset}"], group=group)
+
+    # This host's partition share: on an indexed multi-host Job each pod
+    # consumes a disjoint subset (reference: Kafka partitions × pods,
+    # SURVEY §2.7); single-host consumes every partition.  `committed`
+    # resumes from the group's offset cursor instead of an absolute offset.
+    from ..parallel.distributed import assign_partitions
+
+    try:
+        n_parts = broker.topic(topic).partitions
+    except KeyError:
+        n_parts = 1  # topic not created yet: subscribe partition 0
+    n_hosts = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    host_id = int(os.environ.get("JAX_PROCESS_ID", "0"))
+    parts = assign_partitions(n_parts, n_hosts, host_id) or [0]
+    if offset == "committed":
+        consumer = StreamConsumer.from_committed(broker, topic, parts,
+                                                 group=group)
+    else:
+        consumer = StreamConsumer(broker,
+                                  [f"{topic}:{p}:{offset}" for p in parts],
+                                  group=group)
     model = make_model()
 
     # an explicitly-configured mesh (IOTML_MESH_* / --mesh.*) means the

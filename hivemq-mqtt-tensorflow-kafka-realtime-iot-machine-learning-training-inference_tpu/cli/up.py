@@ -38,7 +38,9 @@ class Platform:
     """All reference services over one in-process broker."""
 
     def __init__(self, sasl: Optional[tuple] = None, partitions: int = 10,
-                 kafka_port: int = 0, mqtt_port: int = 0):
+                 kafka_port: int = 0, mqtt_port: int = 0,
+                 registry_port: int = 0, ksql_port: int = 0,
+                 connect_port: int = 0, host: str = "127.0.0.1"):
         from ..connect import ConnectServer, ConnectWorker
         from ..core.schema import CAR_SCHEMA, KSQL_CAR_SCHEMA
         from ..mqtt.bridge import KafkaBridge
@@ -56,26 +58,29 @@ class Platform:
         self.broker.create_topic("sensor-data", partitions=partitions)
         self.broker.create_topic("model-predictions", partitions=partitions)
 
-        self.kafka = KafkaWireServer(self.broker, port=kafka_port,
+        self.host = host
+        self.kafka = KafkaWireServer(self.broker, host=host, port=kafka_port,
                                      credentials=sasl)
         self.registry = SchemaRegistry()
         self.registry.register(subject_for_topic("sensor-data"),
                                CAR_SCHEMA.avro_json())
         self.registry.register(subject_for_topic("SENSOR_DATA_S_AVRO"),
                                KSQL_CAR_SCHEMA.avro_json())
-        self.registry_server = SchemaRegistryServer(self.registry)
+        self.registry_server = SchemaRegistryServer(self.registry, host=host,
+                                                    port=registry_port)
 
         self.sql = SqlEngine(self.broker, registry=self.registry)
         install_reference_pipeline(self.sql)
-        self.ksql = KsqlServer(self.sql)
+        self.ksql = KsqlServer(self.sql, host=host, port=ksql_port)
 
         self.connect_worker = ConnectWorker(self.broker)
-        self.connect = ConnectServer(self.connect_worker)
+        self.connect = ConnectServer(self.connect_worker, host=host,
+                                     port=connect_port)
 
         self.mqtt_broker = MqttBroker()
         self.bridge = KafkaBridge(self.mqtt_broker, self.broker,
                                   partitions=partitions)
-        self.mqtt = MqttServer(self.mqtt_broker, port=mqtt_port)
+        self.mqtt = MqttServer(self.mqtt_broker, host=host, port=mqtt_port)
 
         self._obs = obs_metrics
         self.metrics_server = None
@@ -96,8 +101,8 @@ class Platform:
 
     def endpoints(self) -> dict:
         out = {
-            "kafka": f"127.0.0.1:{self.kafka.port}",
-            "mqtt": f"127.0.0.1:{self.mqtt.port}",
+            "kafka": f"{self.host}:{self.kafka.port}",
+            "mqtt": f"{self.host}:{self.mqtt.port}",
             "schema-registry": self.registry_server.url,
             "ksql": self.ksql.url,
             "connect": self.connect.url,
@@ -181,15 +186,23 @@ def main(argv=None) -> int:
                     help="start N simulated cars publishing over MQTT")
     ap.add_argument("--rate", type=float, default=1.0,
                     help="fleet publish rate per car (Hz)")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="bind address for every server (0.0.0.0 in a pod)")
     ap.add_argument("--kafka-port", type=int, default=0)
     ap.add_argument("--mqtt-port", type=int, default=0)
+    ap.add_argument("--registry-port", type=int, default=0)
+    ap.add_argument("--ksql-port", type=int, default=0)
+    ap.add_argument("--connect-port", type=int, default=0)
     ap.add_argument("--metrics-port", type=int, default=9100)
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
 
     sasl = tuple(args.sasl.split(":", 1)) if args.sasl else None
-    plat = Platform(sasl=sasl, kafka_port=args.kafka_port,
-                    mqtt_port=args.mqtt_port)
+    plat = Platform(sasl=sasl, host=args.host, kafka_port=args.kafka_port,
+                    mqtt_port=args.mqtt_port,
+                    registry_port=args.registry_port,
+                    ksql_port=args.ksql_port,
+                    connect_port=args.connect_port)
     plat.start(metrics_port=args.metrics_port)
     if args.fleet:
         plat.start_fleet(args.fleet, rate_hz=args.rate)

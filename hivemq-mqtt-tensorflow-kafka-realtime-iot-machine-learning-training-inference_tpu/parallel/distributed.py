@@ -27,7 +27,15 @@ def initialize(coordinator: Optional[str] = None,
                process_id: Optional[int] = None):
     """Join the multi-host process group. No-op single-host (the common
     test/dev case), env-driven on TPU pods where the runtime injects
-    topology (jax.distributed reads it natively)."""
+    topology (jax.distributed reads it natively).
+
+    Env fallbacks (what `deploy/model-training-multihost.yaml` sets per
+    indexed-Job pod): JAX_COORDINATOR, JAX_NUM_PROCESSES, JAX_PROCESS_ID.
+    """
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
     if num_processes in (None, 1) and not coordinator and \
             "JAX_COORDINATOR" not in os.environ:
         return False

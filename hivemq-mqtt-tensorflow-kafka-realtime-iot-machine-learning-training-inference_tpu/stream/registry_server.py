@@ -66,7 +66,10 @@ class SchemaRegistryServer(RestServer):
         avsc = body.get("schema")
         if not avsc:
             raise RestError(422, "missing 'schema' field")
-        sid = self.registry.check(m.group(1), avsc)
+        try:
+            sid = self.registry.check(m.group(1), avsc)
+        except ValueError as e:
+            raise RestError(422, f"invalid schema: {e}")
         if sid is None:
             # Confluent's 40403: schema not found under subject
             raise RestError(404, "schema not found")

@@ -552,7 +552,17 @@ class SqlAggTask(StreamTask):
                           if src_meta.value_format == "AVRO" else None)
         # (group_key, window_start) → {alias: accumulator}
         self.acc: Dict[tuple, dict] = {}
-        self._restore_from_changelog()
+        # Restore changelog state only when this group has committed input
+        # offsets: state + offsets were written together, so either both
+        # exist (resume) or neither does (fresh query over a topic that may
+        # hold another query's retained output — replaying input from 0
+        # with seeded state would double-count).
+        src_topic = src_meta.topic
+        n_src = (broker.topic(src_topic).partitions
+                 if src_topic in broker.topics() else 0)
+        if any(broker.committed(group, src_topic, p) is not None
+               for p in range(n_src)):
+            self._restore_from_changelog()
 
     def _restore_from_changelog(self) -> None:
         """Rebuild aggregate state from the output topic.
@@ -848,7 +858,11 @@ class SqlEngine:
                               windowed=stmt.window_ms is not None)
             self._qseq += 1
             qid = f"CTAS_{name}_{self._qseq}"
-            task = SqlAggTask(self.broker, src, meta, stmt, group=qid)
+            # the consumer group must be stable across restarts/re-creates
+            # (unlike the display id): committed offsets and the changelog
+            # restore are only consistent when they belong together
+            task = SqlAggTask(self.broker, src, meta, stmt,
+                              group=f"CTAS_{name}")
         else:
             columns = self._infer_columns(src, stmt)
             meta = SourceMeta(name, "STREAM", topic, vfmt, columns,
@@ -856,7 +870,7 @@ class SqlEngine:
             self._qseq += 1
             qid = f"CSAS_{name}_{self._qseq}"
             task = SqlSelectTask(self.broker, src, meta, stmt,
-                                 self.registry, group=qid)
+                                 self.registry, group=f"CSAS_{name}")
         meta.query_id = qid
         self.sources[name] = meta
         self.queries[qid] = Query(qid, name, sql, task)

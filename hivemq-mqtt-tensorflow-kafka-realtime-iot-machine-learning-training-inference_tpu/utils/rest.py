@@ -51,10 +51,11 @@ class RestServer:
                         self._send(400, {"error_code": 400,
                                          "message": "malformed JSON body"})
                         return
+                path = self.path.split("?", 1)[0]  # routes ignore the query
                 for m, pat, fn in outer._routes:
                     if m != method:
                         continue
-                    match = pat.fullmatch(self.path)
+                    match = pat.fullmatch(path)
                     if match:
                         try:
                             result = fn(match, body)

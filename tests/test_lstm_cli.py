@@ -47,3 +47,19 @@ def test_cardata_train_sharded_mesh(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "mesh: {'data': 4, 'model': 2}" in out
     assert "Training complete" in out
+
+
+def test_cardata_cli_committed_offset_and_partition_share(monkeypatch, tmp_path):
+    """The multi-host manifest contract: <offset>='committed' resumes from
+    the group cursor, and JAX_NUM_PROCESSES/JAX_PROCESS_ID split the topic's
+    partitions across pods (deploy/model-training-multihost.yaml)."""
+    from iotml.cli import cardata
+
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
+    monkeypatch.setenv("JAX_PROCESS_ID", "0")
+    rc = cardata.main(["emulator:2000", "SENSOR_DATA_S_AVRO", "committed",
+                       "model-predictions", "train", "m1",
+                       str(tmp_path / "artifacts"),
+                       "--train.epochs=1", "--train.take_batches=5"])
+    assert rc == 0
+    assert (tmp_path / "artifacts").exists()

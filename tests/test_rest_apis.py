@@ -200,3 +200,21 @@ def test_connect_rest_object_store_sink(tmp_path):
         assert status == 400 and "unknown connector.class" in err["message"]
     finally:
         server.stop()
+
+
+def test_routes_ignore_query_strings(registry_api):
+    """Confluent clients append query params (?normalize=false etc.);
+    routing must match on the path alone."""
+    api, _ = registry_api
+    avsc = CAR_SCHEMA.avro_json()
+    status, body = api.req(
+        "POST", "/subjects/s-value/versions?normalize=false", {"schema": avsc})
+    assert status == 200 and body["id"] >= 1
+    status, body = api.req("GET", "/subjects?deleted=false")
+    assert status == 200 and body == ["s-value"]
+
+
+def test_check_with_invalid_schema_is_422(registry_api):
+    api, _ = registry_api
+    status, body = api.req("POST", "/subjects/s", {"schema": "not json"})
+    assert status == 422

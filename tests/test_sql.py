@@ -283,3 +283,28 @@ def test_rest_rejects_non_object_bodies_gracefully():
         assert json.loads(r.read())[0]["streams"] == []
     finally:
         server.stop()
+
+
+def test_ctas_recreate_after_drop_does_not_double_count():
+    """TERMINATE + DROP (topic retained) + re-CREATE must not seed restored
+    changelog state AND replay input from offset zero."""
+    broker = Broker()
+    _produce_fleet(broker, n_cars=1, per_car=4)
+    engine = SqlEngine(broker)
+    install_reference_pipeline(engine)
+    engine.pump()
+    assert engine.table("SENSOR_DATA_EVENTS_PER_5MIN_T")[("car0", 0)][
+        "EVENT_COUNT"] == 4
+
+    qid = next(q for q in engine.queries if q.startswith("CTAS"))
+    engine.execute(f"TERMINATE {qid};")
+    engine.execute("DROP TABLE SENSOR_DATA_EVENTS_PER_5MIN_T;")
+    engine.execute(
+        "CREATE TABLE SENSOR_DATA_EVENTS_PER_5MIN_T AS "
+        "SELECT ROWKEY AS CAR, COUNT(*) AS EVENT_COUNT "
+        "FROM SENSOR_DATA_S_AVRO_REKEY "
+        "WINDOW TUMBLING (SIZE 5 MINUTES) GROUP BY ROWKEY;")
+    engine.pump()
+    # stable consumer group ⇒ committed offsets + restored state line up
+    assert engine.table("SENSOR_DATA_EVENTS_PER_5MIN_T")[("car0", 0)][
+        "EVENT_COUNT"] == 4
