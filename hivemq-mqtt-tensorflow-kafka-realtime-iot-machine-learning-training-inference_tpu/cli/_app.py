@@ -99,7 +99,10 @@ def run_streaming_app(argv, *, prog: str, usage: str, make_model: Callable,
         n_parts = 1  # topic not created yet: subscribe partition 0
     n_hosts = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
     host_id = int(os.environ.get("JAX_PROCESS_ID", "0"))
-    parts = assign_partitions(n_parts, n_hosts, host_id) or [0]
+    # an empty share is legitimate (more hosts than partitions): that host
+    # trains on nothing rather than duplicating partition 0 under the same
+    # group (which would make shards overlap and offset commits clobber)
+    parts = assign_partitions(n_parts, n_hosts, host_id)
     if offset == "committed":
         consumer = StreamConsumer.from_committed(broker, topic, parts,
                                                  group=group)
@@ -107,6 +110,9 @@ def run_streaming_app(argv, *, prog: str, usage: str, make_model: Callable,
         consumer = StreamConsumer(broker,
                                   [f"{topic}:{p}:{offset}" for p in parts],
                                   group=group)
+    if not parts:
+        print(f"host {host_id}/{n_hosts}: no partition share of "
+              f"{n_parts}-partition topic {topic}; idle")
     model = make_model()
 
     # an explicitly-configured mesh (IOTML_MESH_* / --mesh.*) means the
@@ -137,12 +143,19 @@ def run_streaming_app(argv, *, prog: str, usage: str, make_model: Callable,
                                 cfg.train.only_normal)
         history = trainer.fit(batches, epochs=epochs) if use_mesh \
             else trainer.fit_compiled(batches, epochs=epochs)
+        if not history["loss"]:
+            print("No records in this host's partition share; nothing "
+                  "trained, nothing stored")
+            return 0
         print(f"Training complete, final loss {history['loss'][-1]:.6f}")
         # unique dir: concurrent jobs on one host must not trample each other
         ckpt_dir = tempfile.mkdtemp(prefix=f"iotml_{prog}_ckpt_")
         mgr = CheckpointManager(ckpt_dir)
         path = mgr.save(trainer.state, cursors=consumer.positions())
         store.upload_tree(path, model_file)
+        # commit AFTER the checkpoint is durable: the group cursor is the
+        # resume point the '<offset>=committed' rerun contract promises
+        consumer.commit()
         print("Model stored successfully", model_file)
         return 0
 

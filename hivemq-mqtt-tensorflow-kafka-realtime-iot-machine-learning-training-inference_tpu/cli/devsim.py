@@ -104,6 +104,8 @@ def cmd_run(args) -> int:
             child_args += ["--tcp", args.tcp]
         if args.cap:
             child_args += ["--cap", str(args.cap)]
+        if args.metrics_port:
+            child_args += ["--metrics-port", str(args.metrics_port)]
         with open(log_path, "ab") as log:
             proc = subprocess.Popen(child_args, stdout=log, stderr=log,
                                     start_new_session=True)
@@ -116,6 +118,12 @@ def cmd_run(args) -> int:
 
     from ..mqtt.broker import MqttBroker
     from ..mqtt.scenario import ScenarioRunner, parse_scenario
+
+    if args.metrics_port:
+        # agent_connect_*/agent_publish_* land in the default registry
+        # (reference devsim.json metric families); expose them for scrapes
+        from ..obs.metrics import start_http_server
+        start_http_server(args.metrics_port)
 
     scenario = parse_scenario(xml_text)
     if args.cap:
@@ -224,6 +232,8 @@ def main(argv=None) -> int:
     p.add_argument("--encoding", choices=("json", "avro"), default="json")
     p.add_argument("--cap", type=int, default=0, metavar="N",
                    help="clamp client/topic counts to N (scale-down mode)")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="serve agent_* metrics in Prometheus format")
     p.add_argument("--detach", action="store_true",
                    help="run as a background job (see jobs/show/log/abort)")
     p.set_defaults(fn=cmd_run)

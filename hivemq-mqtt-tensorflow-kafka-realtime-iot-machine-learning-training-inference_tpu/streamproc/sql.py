@@ -841,6 +841,15 @@ class SqlEngine:
                                    self.broker.topic(src.topic).partitions))
         self.broker.create_topic(topic, partitions=partitions)
 
+        # Consumer-group id: stable across restarts for the SAME statement
+        # (so committed offsets + restored changelog state line up), but
+        # keyed by a fingerprint of the SQL text so a re-created query with
+        # different semantics starts fresh instead of inheriting the old
+        # query's offsets and state.
+        import hashlib
+        fp = hashlib.sha1(" ".join(sql.upper().split()).encode()) \
+            .hexdigest()[:8]
+
         if kind == "TABLE" or stmt.is_aggregate:
             if not stmt.is_aggregate:
                 raise SqlError("CREATE TABLE AS requires an aggregate SELECT")
@@ -858,11 +867,8 @@ class SqlEngine:
                               windowed=stmt.window_ms is not None)
             self._qseq += 1
             qid = f"CTAS_{name}_{self._qseq}"
-            # the consumer group must be stable across restarts/re-creates
-            # (unlike the display id): committed offsets and the changelog
-            # restore are only consistent when they belong together
             task = SqlAggTask(self.broker, src, meta, stmt,
-                              group=f"CTAS_{name}")
+                              group=f"CTAS_{name}_{fp}")
         else:
             columns = self._infer_columns(src, stmt)
             meta = SourceMeta(name, "STREAM", topic, vfmt, columns,
@@ -870,7 +876,7 @@ class SqlEngine:
             self._qseq += 1
             qid = f"CSAS_{name}_{self._qseq}"
             task = SqlSelectTask(self.broker, src, meta, stmt,
-                                 self.registry, group=f"CSAS_{name}")
+                                 self.registry, group=f"CSAS_{name}_{fp}")
         meta.query_id = qid
         self.sources[name] = meta
         self.queries[qid] = Query(qid, name, sql, task)

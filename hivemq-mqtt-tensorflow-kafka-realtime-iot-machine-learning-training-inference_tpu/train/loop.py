@@ -160,6 +160,7 @@ class Trainer:
         # tx_key: hashable descriptor for the jit cache when we built the
         # optimizer ourselves (a user-supplied tx is keyed by identity)
         self._tx_key = ("adam", learning_rate) if tx is None else None
+        self.learning_rate = learning_rate
         self.tx = tx or optax.adam(learning_rate)
         self.supervised = supervised
         self.state: Optional[TrainState] = None
@@ -202,12 +203,19 @@ class Trainer:
                       f"- {records} records - {dt:.2f}s")
         return history
 
-    def fit_compiled(self, batches, epochs: int = 1) -> dict:
+    def fit_compiled(self, batches, epochs: int = 1, fused: str = "auto"
+                     ) -> dict:
         """One-XLA-program fit: decode the epoch's batches once, move them to
         device, and run all epochs × batches inside a single jitted
         `lax.scan` (see `make_scanned_fit`).  Semantically identical to
         `fit` over an immutable log slice; orders of magnitude less dispatch
-        overhead for small step sizes."""
+        overhead for small step sizes.
+
+        fused: "auto" additionally collapses the whole fit into ONE Pallas
+        kernel when the model/optimizer match `ops.fused_train`'s contract
+        (the DenseAutoencoder + Adam hot path — another ~7× on top of the
+        scan by eliminating per-step kernel dispatch); "never" forces the
+        scan; "always" raises if unsupported."""
         import numpy as np
 
         t0 = time.perf_counter()
@@ -219,10 +227,27 @@ class Trainer:
         masks = np.stack([b.mask for b in bs])
         records = sum(b.n_valid for b in bs)
         self._ensure_state(bs[0].x)
-        scanned = scanned_fit_cached(self.model, self.tx, self.supervised,
-                                     tx_key=self._tx_key)
-        xs, ys, masks = jax.device_put((xs, ys, masks))
-        self.state, (losses, accs) = scanned(self.state, xs, ys, masks, epochs)
+
+        from ..ops import fused_train
+
+        activity_l1 = getattr(self.model, "activity_l1", None)
+        use_fused = fused != "never" and \
+            fused_train.supported(self.state, self.supervised) and \
+            self._tx_key is not None and \
+            activity_l1 is not None  # default adam only: lr/l1 are known
+        if fused == "always" and not use_fused:
+            raise ValueError("fused fit unsupported for this model/optimizer")
+        if use_fused:
+            xs, masks = jax.device_put((xs, masks))
+            self.state, losses, accs = fused_train.fused_fit(
+                self.state, xs, masks, epochs,
+                lr=self.learning_rate, l1=activity_l1)
+        else:
+            scanned = scanned_fit_cached(self.model, self.tx, self.supervised,
+                                         tx_key=self._tx_key)
+            xs, ys, masks = jax.device_put((xs, ys, masks))
+            self.state, (losses, accs) = scanned(self.state, xs, ys, masks,
+                                                 epochs)
         obs_metrics.records_trained.inc(records * epochs)
         losses = np.asarray(jax.device_get(losses))
         accs = np.asarray(jax.device_get(accs))

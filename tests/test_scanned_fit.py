@@ -23,7 +23,10 @@ def test_fit_compiled_matches_step_loop():
     t1 = Trainer(CAR_AUTOENCODER)
     h1 = t1.fit(_batches(), epochs=3)
     t2 = Trainer(CAR_AUTOENCODER)
-    h2 = t2.fit_compiled(_batches(), epochs=3)
+    # fused="never": this test pins the *scan* path to the step loop
+    # bitwise; the fused Pallas path has its own tolerance-based parity
+    # tests in test_fused_train.py
+    h2 = t2.fit_compiled(_batches(), epochs=3, fused="never")
     np.testing.assert_allclose(h1["loss"], h2["loss"], rtol=1e-5, atol=1e-7)
     for a, b in zip(jax.tree.leaves(jax.device_get(t1.state.params)),
                     jax.tree.leaves(jax.device_get(t2.state.params))):

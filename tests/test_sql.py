@@ -308,3 +308,29 @@ def test_ctas_recreate_after_drop_does_not_double_count():
     # stable consumer group ⇒ committed offsets + restored state line up
     assert engine.table("SENSOR_DATA_EVENTS_PER_5MIN_T")[("car0", 0)][
         "EVENT_COUNT"] == 4
+
+
+def test_ctas_recreate_with_different_sql_starts_fresh():
+    """A re-created table with DIFFERENT semantics must not inherit the old
+    query's committed offsets or changelog state (group id is fingerprinted
+    by statement text)."""
+    broker = Broker()
+    _produce_fleet(broker, n_cars=1, per_car=4)
+    engine = SqlEngine(broker)
+    install_reference_pipeline(engine)
+    engine.pump()
+    qid = next(q for q in engine.queries if q.startswith("CTAS"))
+    engine.execute(f"TERMINATE {qid};")
+    engine.execute("DROP TABLE SENSOR_DATA_EVENTS_PER_5MIN_T;")
+
+    # same sink name, different aggregation: SUM of SPEED, not COUNT
+    engine.execute(
+        "CREATE TABLE SENSOR_DATA_EVENTS_PER_5MIN_T "
+        "WITH (KAFKA_TOPIC='T2') AS "
+        "SELECT ROWKEY AS CAR, SUM(SPEED) AS TOTAL_SPEED "
+        "FROM SENSOR_DATA_S_AVRO_REKEY "
+        "WINDOW TUMBLING (SIZE 5 MINUTES) GROUP BY ROWKEY;")
+    engine.pump()
+    table = engine.table("SENSOR_DATA_EVENTS_PER_5MIN_T")
+    # speeds were 0,1,2,3 → sum 6; inherited COUNT state would give 4 or 10
+    assert table[("car0", 0)] == {"TOTAL_SPEED": 6.0}
