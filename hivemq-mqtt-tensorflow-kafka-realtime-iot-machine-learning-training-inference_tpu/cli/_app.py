@@ -143,7 +143,10 @@ def run_streaming_app(argv, *, prog: str, usage: str, make_model: Callable,
                                 cfg.train.only_normal)
         history = trainer.fit(batches, epochs=epochs) if use_mesh \
             else trainer.fit_compiled(batches, epochs=epochs)
-        if not history["loss"]:
+        # empty stream: fit_compiled returns an empty history; the step-loop
+        # fits return placeholder losses but never initialize state — either
+        # way there is nothing worth checkpointing
+        if not history["loss"] or trainer.state is None:
             print("No records in this host's partition share; nothing "
                   "trained, nothing stored")
             return 0

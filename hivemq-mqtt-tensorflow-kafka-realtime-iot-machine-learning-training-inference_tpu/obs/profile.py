@@ -1,0 +1,67 @@
+"""Profiler integration — the reference's TensorBoard-profiler story.
+
+The reference commits actual TF profiler traces with its notebooks
+(`python-scripts/autoencoder-anomaly-detection/logs/plugins/profile/...`,
+SURVEY §5 'tracing/profiling') and calls training monitoring a roadmap item
+(reference `README.md:116`).  Here the JAX profiler fills that role: traces
+are written in the same TensorBoard `plugins/profile` layout, viewable with
+`tensorboard --logdir` + the profile plugin, or in Perfetto.
+
+Usage:
+
+    from iotml.obs.profile import trace, annotate
+
+    with trace("./logs"):                  # one captured window
+        trainer.fit_compiled(batches, epochs=20)
+
+    with annotate("decode"):               # named span inside a capture
+        batches = list(iter(sensor_batches))
+
+`bench.py` honors `IOTML_PROFILE=<dir>` to capture its warm measurement
+pass without changing the bench contract.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str = "./logs") -> Iterator[None]:
+    """Capture a profiler trace window into `logdir` (TensorBoard layout)."""
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named span that shows up on the trace timeline (host + device)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def maybe_trace(logdir: Optional[str]) -> Iterator[None]:
+    """`trace` when a directory is given, no-op otherwise — for call sites
+    driven by an env var (e.g. bench.py's IOTML_PROFILE)."""
+    if logdir:
+        with trace(logdir):
+            yield
+    else:
+        yield
+
+
+def trace_files(logdir: str) -> list:
+    """Paths of captured trace artifacts under a log directory."""
+    out = []
+    for root, _dirs, files in os.walk(logdir):
+        for f in files:
+            if ".trace" in f or f.endswith((".pb", ".json.gz", ".xplane.pb")):
+                out.append(os.path.join(root, f))
+    return sorted(out)

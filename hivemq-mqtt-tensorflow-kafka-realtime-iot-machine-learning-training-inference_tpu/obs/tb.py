@@ -54,18 +54,21 @@ class ScalarLogger:
 
 
 class JaxProfiler:
-    """jax.profiler trace session → TensorBoard-loadable trace directory."""
+    """jax.profiler trace session → TensorBoard-loadable trace directory.
+
+    Thin class wrapper over `obs.profile.trace` (which also offers
+    `annotate` spans and `maybe_trace` for env-driven capture)."""
 
     def __init__(self, log_dir: str):
         self.log_dir = log_dir
+        self._cm = None
 
     def __enter__(self):
-        import jax
+        from .profile import trace
 
-        jax.profiler.start_trace(self.log_dir)
+        self._cm = trace(self.log_dir)
+        self._cm.__enter__()
         return self
 
     def __exit__(self, *exc):
-        import jax
-
-        jax.profiler.stop_trace()
+        self._cm.__exit__(*exc)

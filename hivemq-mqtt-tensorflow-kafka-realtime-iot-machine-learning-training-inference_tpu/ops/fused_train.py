@@ -40,6 +40,12 @@ from jax.experimental import pallas as pl
 #: parameter layout: (layer name, activation) in forward order
 _LAYERS = ("encoder0", "encoder1", "decoder0", "decoder1")
 
+#: The kernel maps the whole training slice into VMEM (no grid/BlockSpecs),
+#: so callers must gate on data size: beyond this budget, use the scanned
+#: fit, which streams batches from HBM.  ~16 MB VMEM per v5e core, minus
+#: params/moments/activations headroom.
+VMEM_DATA_BUDGET_BYTES = 8 * 2 ** 20
+
 
 def _flatten_params(params) -> list:
     """params tree → [W1, b1, W2, b2, W3, b3, W4, b4] (forward order)."""

@@ -845,10 +845,11 @@ class SqlEngine:
         # (so committed offsets + restored changelog state line up), but
         # keyed by a fingerprint of the SQL text so a re-created query with
         # different semantics starts fresh instead of inheriting the old
-        # query's offsets and state.
+        # query's offsets and state.  Whitespace-normalized only — case
+        # folding would conflate queries differing in a quoted literal's
+        # case, which ARE semantically different.
         import hashlib
-        fp = hashlib.sha1(" ".join(sql.upper().split()).encode()) \
-            .hexdigest()[:8]
+        fp = hashlib.sha1(" ".join(sql.split()).encode()).hexdigest()[:8]
 
         if kind == "TABLE" or stmt.is_aggregate:
             if not stmt.is_aggregate:

@@ -223,7 +223,6 @@ class Trainer:
         if not bs:
             return {"loss": [], "accuracy": [], "records": [], "seconds": []}
         xs = np.stack([b.x for b in bs])
-        ys = np.stack([b.y if b.y is not None else b.x for b in bs])
         masks = np.stack([b.mask for b in bs])
         records = sum(b.n_valid for b in bs)
         self._ensure_state(bs[0].x)
@@ -234,9 +233,11 @@ class Trainer:
         use_fused = fused != "never" and \
             fused_train.supported(self.state, self.supervised) and \
             self._tx_key is not None and \
-            activity_l1 is not None  # default adam only: lr/l1 are known
+            activity_l1 is not None and \
+            xs.nbytes <= fused_train.VMEM_DATA_BUDGET_BYTES
         if fused == "always" and not use_fused:
-            raise ValueError("fused fit unsupported for this model/optimizer")
+            raise ValueError("fused fit unsupported for this model/optimizer/"
+                             "slice size")
         if use_fused:
             xs, masks = jax.device_put((xs, masks))
             self.state, losses, accs = fused_train.fused_fit(
@@ -245,6 +246,7 @@ class Trainer:
         else:
             scanned = scanned_fit_cached(self.model, self.tx, self.supervised,
                                          tx_key=self._tx_key)
+            ys = np.stack([b.y if b.y is not None else b.x for b in bs])
             xs, ys, masks = jax.device_put((xs, ys, masks))
             self.state, (losses, accs) = scanned(self.state, xs, ys, masks,
                                                  epochs)

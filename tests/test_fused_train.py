@@ -145,3 +145,25 @@ def test_fused_respects_custom_activity_l1():
     h = tr.fit_compiled(bs, epochs=2, fused="always")
     np.testing.assert_allclose(h["loss"], np.asarray(ref_losses),
                                rtol=2e-4, atol=1e-6)
+
+
+def test_auto_falls_back_to_scan_for_large_slices():
+    """The fused kernel is VMEM-resident; auto mode must gate on data size
+    and quietly use the scanned fit for big slices."""
+    from unittest import mock
+
+    from iotml.data.dataset import Batch
+    from iotml.ops import fused_train
+
+    xs, _ = _data(S=4, B=64, ragged=False)
+    bs = [Batch(x=xs[i], n_valid=xs.shape[1], first_index=i)
+          for i in range(xs.shape[0])]
+    tr = Trainer(CAR_AUTOENCODER)
+    with mock.patch.object(fused_train, "fused_fit",
+                           side_effect=AssertionError("fused used")):
+        with mock.patch.object(fused_train, "VMEM_DATA_BUDGET_BYTES", 1):
+            h = tr.fit_compiled(bs, epochs=1)  # falls back, no AssertionError
+    assert len(h["loss"]) == 1
+    with pytest.raises(ValueError):
+        with mock.patch.object(fused_train, "VMEM_DATA_BUDGET_BYTES", 1):
+            tr.fit_compiled(bs, epochs=1, fused="always")

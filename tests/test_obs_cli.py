@@ -66,3 +66,23 @@ def test_cli_train_predict_handoff(tmp_path):
 def test_cli_arg_validation():
     assert cardata_main(["too", "few"]) == 1
     assert cardata_main(["emulator", "t", "0", "r", "badmode", "m", "/tmp/x"]) == 1
+
+
+def test_profiler_trace_capture(tmp_path):
+    """obs.profile writes TensorBoard-layout trace artifacts (the
+    reference commits TF profiler traces; SURVEY §5)."""
+    import jax.numpy as jnp
+
+    from iotml.obs.profile import annotate, maybe_trace, trace, trace_files
+
+    logdir = str(tmp_path / "logs")
+    with trace(logdir):
+        with annotate("tiny-op"):
+            _ = jnp.ones((8, 8)) @ jnp.ones((8, 8))
+    files = trace_files(logdir)
+    assert files, "no trace artifacts captured"
+    assert any("plugins" in f and "profile" in f for f in files)
+
+    # no-op path: nothing written, nothing raised
+    with maybe_trace(None):
+        pass
