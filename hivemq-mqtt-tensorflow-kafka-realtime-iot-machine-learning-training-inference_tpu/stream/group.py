@@ -169,15 +169,40 @@ class GroupCoordinator:
         overwrite newer offsets committed by the partition's new owner.
         Only partitions in the member's *current* assignment are written.
         Returns True when the commit was accepted."""
+        return self.fenced_commit_detailed(member_id, generation,
+                                           positions) is not None
+
+    def fenced_commit_detailed(self, member_id: str, generation: int,
+                               positions: Sequence[Tuple[str, int, int]]
+                               ) -> Optional[set]:
+        """Like `fenced_commit`, with per-partition granularity: None when
+        the member is fenced (nothing written), else the set of (topic,
+        partition) actually committed — so callers can flag positions that
+        named partitions outside the member's assignment."""
         with self._lock:
             if member_id not in self._heartbeats or \
                     generation != self.generation:
-                return False
+                return None
             owned = set(self._assignments.get(member_id, []))
+            done = set()
             for t, p, off in positions:
                 if (t, p) in owned:
                     self.broker.commit(self.group_id, t, p, off)
-            return True
+                    done.add((t, p))
+            return done
+
+    def sync(self, member_id: str, generation: int
+             ) -> Tuple[str, List[TopicPartition]]:
+        """Atomic membership check + assignment fetch (the SyncGroup
+        operation): one lock acquisition, so a concurrent join cannot slip
+        between the validity check and the assignment read.  Returns
+        ("ok"|"unknown_member"|"illegal_generation", assignment)."""
+        with self._lock:
+            if member_id not in self._heartbeats:
+                return "unknown_member", []
+            if generation != self.generation:
+                return "illegal_generation", []
+            return "ok", list(self._assignments.get(member_id, []))
 
     def assignment(self, member_id: str) -> List[TopicPartition]:
         with self._lock:

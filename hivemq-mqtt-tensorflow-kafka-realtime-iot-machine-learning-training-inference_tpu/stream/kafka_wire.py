@@ -40,18 +40,25 @@ from .broker import Broker, Message, TopicSpec
 # api keys
 PRODUCE, FETCH, LIST_OFFSETS, METADATA = 0, 1, 2, 3
 OFFSET_COMMIT, OFFSET_FETCH = 8, 9
+FIND_COORDINATOR, JOIN_GROUP, HEARTBEAT, LEAVE_GROUP, SYNC_GROUP = \
+    10, 11, 12, 13, 14
 SASL_HANDSHAKE, API_VERSIONS, CREATE_TOPICS = 17, 18, 19
 
 # error codes
 ERR_NONE = 0
 ERR_OFFSET_OUT_OF_RANGE = 1
 ERR_UNKNOWN_TOPIC = 3
+ERR_ILLEGAL_GENERATION = 22
+ERR_UNKNOWN_MEMBER_ID = 25
+ERR_REBALANCE_IN_PROGRESS = 27
 ERR_UNSUPPORTED_VERSION = 35
 ERR_TOPIC_EXISTS = 36
 ERR_SASL_AUTH_FAILED = 58
 
 _SUPPORTED = {PRODUCE: (2, 2), FETCH: (2, 2), LIST_OFFSETS: (1, 1),
               METADATA: (1, 1), OFFSET_COMMIT: (2, 2), OFFSET_FETCH: (1, 1),
+              FIND_COORDINATOR: (0, 0), JOIN_GROUP: (0, 0),
+              HEARTBEAT: (0, 0), LEAVE_GROUP: (0, 0), SYNC_GROUP: (0, 0),
               SASL_HANDSHAKE: (0, 0), API_VERSIONS: (0, 0),
               CREATE_TOPICS: (0, 0)}
 
@@ -433,22 +440,11 @@ class KafkaWireBroker(ProducePartitionMixin):
 
     # ------------------------------------------------- consumer-group API
     def commit(self, group: str, topic: str, partition: int, next_offset: int):
-        w = _Writer()
-        w.string(group).i32(-1).string("")  # simple consumer: generation -1
-        w.i64(-1)  # retention: broker default
-
-        def part(wr, _):
-            wr.i32(partition).i64(next_offset).string(None)
-
-        w.array([None], lambda wr, _: (wr.string(topic),
-                                       wr.array([None], part)))
-        r = self._request(OFFSET_COMMIT, 2, bytes(w.buf))
-        tops = r.array(lambda rd: (rd.string(), rd.array(
-            lambda p: (p.i32(), p.i16()))))
-        for _, parts in tops:
-            for pid, err in parts:
-                if err != ERR_NONE:
-                    raise RuntimeError(f"offset commit {topic}:{pid}: {err}")
+        """Simple-consumer commit: the generation=-1, unfenced special case
+        of `commit_fenced`."""
+        if not self.commit_fenced(group, -1, "",
+                                  [(topic, partition, next_offset)]):
+            raise RuntimeError(f"offset commit {topic}:{partition} fenced")
 
     def committed(self, group: str, topic: str, partition: int) -> Optional[int]:
         w = _Writer()
@@ -469,8 +465,136 @@ class KafkaWireBroker(ProducePartitionMixin):
                 return None if off < 0 else off
         return None
 
+    def commit_fenced(self, group: str, generation: int, member_id: str,
+                      positions) -> bool:
+        """Generation-fenced OffsetCommit (v2 carries generation+member).
+        Returns False when the broker fenced this member
+        (ILLEGAL_GENERATION) — nothing was written."""
+        by_topic: dict = {}
+        for t, p, off in positions:
+            by_topic.setdefault(t, []).append((p, off))
+        w = _Writer()
+        w.string(group).i32(generation).string(member_id).i64(-1)
+        w.array(sorted(by_topic.items()), lambda wr, tp: (
+            wr.string(tp[0]),
+            wr.array(tp[1], lambda pw, p: pw.i32(p[0]).i64(p[1])
+                     .string(None))))
+        r = self._request(OFFSET_COMMIT, 2, bytes(w.buf))
+        tops = r.array(lambda rd: (rd.string(), rd.array(
+            lambda p: (p.i32(), p.i16()))))
+        errs = {err for _, parts in tops for _, err in parts}
+        if ERR_ILLEGAL_GENERATION in errs:
+            return False
+        bad = errs - {ERR_NONE}
+        if bad:
+            raise RuntimeError(f"offset commit failed: errors {sorted(bad)}")
+        return True
+
+    # ------------------------------------------- group membership (wire)
+    def join_group(self, group: str, topics, member_id: str = "",
+                   session_timeout_ms: int = 10_000):
+        """JoinGroup v0 with the standard consumer subscription metadata.
+        Returns (generation, member_id)."""
+        meta = _Writer()
+        meta.i16(0)
+        meta.array(list(topics), lambda wr, t: wr.string(t))
+        meta.bytes_(b"")
+        w = _Writer()
+        w.string(group).i32(session_timeout_ms).string(member_id)
+        w.string("consumer")
+        w.array([("range", bytes(meta.buf))],
+                lambda wr, p: (wr.string(p[0]), wr.bytes_(p[1])))
+        r = self._request(JOIN_GROUP, 0, bytes(w.buf))
+        err = r.i16()
+        if err != ERR_NONE:
+            raise RuntimeError(f"join group {group}: error {err}")
+        generation = r.i32()
+        r.string()  # protocol
+        r.string()  # leader
+        mid = r.string()
+        return generation, mid
+
+    def sync_group(self, group: str, generation: int, member_id: str):
+        """SyncGroup v0 → [(topic, partition), ...] assignment."""
+        w = _Writer()
+        w.string(group).i32(generation).string(member_id)
+        w.array([], lambda wr, x: None)
+        r = self._request(SYNC_GROUP, 0, bytes(w.buf))
+        err = r.i16()
+        blob = r.bytes_() or b""
+        if err != ERR_NONE:
+            raise RuntimeError(f"sync group {group}: error {err}")
+        ar = _Reader(blob)
+        ar.i16()  # version
+        pairs = []
+        for topic, parts in ar.array(lambda rd: (rd.string(),
+                                                 rd.array(lambda p: p.i32()))):
+            pairs.extend((topic, p) for p in parts)
+        return pairs
+
+    def heartbeat_group(self, group: str, generation: int,
+                        member_id: str) -> bool:
+        w = _Writer()
+        w.string(group).i32(generation).string(member_id)
+        r = self._request(HEARTBEAT, 0, bytes(w.buf))
+        return r.i16() == ERR_NONE
+
+    def leave_group(self, group: str, member_id: str) -> None:
+        w = _Writer()
+        w.string(group).string(member_id)
+        self._request(LEAVE_GROUP, 0, bytes(w.buf)).i16()
+
     def close(self) -> None:
         self._sock.close()
+
+
+class RemoteGroupCoordinator:
+    """GroupCoordinator-shaped adapter over the wire protocol.
+
+    Gives `stream.group.GroupConsumer` elastic membership against a broker
+    in ANOTHER process: join/heartbeat/leave/fenced_commit ride JoinGroup/
+    SyncGroup/Heartbeat/LeaveGroup/OffsetCommit requests, with membership
+    state living broker-side — the missing piece that makes the reference's
+    scalable-Deployment story (SURVEY §2.7) work across processes, exactly
+    as Kafka's own coordinator does."""
+
+    def __init__(self, client: "KafkaWireBroker", group_id: str,
+                 session_timeout_ms: int = 10_000):
+        self.broker = client
+        self.group_id = group_id
+        self.session_timeout_ms = session_timeout_ms
+
+    def join(self, topics, member_id=None):
+        mid = member_id or ""
+        last_err = None
+        for _ in range(5):  # a peer joining between Join and Sync bumps the
+            generation, mid = self.broker.join_group(  # generation: rejoin
+                self.group_id, topics, mid,
+                session_timeout_ms=self.session_timeout_ms)
+            try:
+                assignment = self.broker.sync_group(self.group_id,
+                                                    generation, mid)
+                return mid, generation, assignment
+            except RuntimeError as e:
+                last_err = e
+        raise last_err
+
+    def heartbeat(self, member_id: str, generation: int) -> bool:
+        return self.broker.heartbeat_group(self.group_id, generation,
+                                           member_id)
+
+    def fenced_commit(self, member_id: str, generation: int,
+                      positions) -> bool:
+        if not positions:
+            # nothing to write, but the fencing signal must still be real:
+            # a heartbeat verifies membership at this generation (the local
+            # coordinator checks the same thing under its lock)
+            return self.heartbeat(member_id, generation)
+        return self.broker.commit_fenced(self.group_id, generation,
+                                         member_id, positions)
+
+    def leave(self, member_id: str) -> None:
+        self.broker.leave_group(self.group_id, member_id)
 
 
 # ------------------------------------------------------------------ server
@@ -639,21 +763,38 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                 .i64(p[3]))))
         elif api_key == OFFSET_COMMIT:
             group = r.string()
-            r.i32()  # generation
-            r.string()  # member
+            generation = r.i32()
+            member = r.string()
             r.i64()  # retention
 
             def part(rd):
                 return (rd.i32(), rd.i64(), rd.string())
 
             tops = r.array(lambda rd: (rd.string(), rd.array(part)))
-            resp = []
-            for tname, parts in tops:
-                presp = []
-                for pid, off, _meta in parts:
-                    broker.commit(group, tname, pid, off)
-                    presp.append((pid, ERR_NONE))
-                resp.append((tname, presp))
+            # generation == -1: simple consumer, no fencing (the classic
+            # path).  A real generation routes through the group coordinator
+            # so a member fenced by a rebalance cannot clobber offsets.
+            if generation >= 0:
+                coord = self.server.group_coordinator(group)
+                positions = [(t, pid, off)
+                             for t, parts in tops for pid, off, _ in parts]
+                done = coord.fenced_commit_detailed(member, generation,
+                                                    positions)
+                if done is None:  # fenced: nothing written
+                    resp = [(t, [(pid, ERR_ILLEGAL_GENERATION)
+                                 for pid, _, _ in parts])
+                            for t, parts in tops]
+                else:  # per-partition: unowned partitions error out loudly
+                    resp = [(t, [(pid, ERR_NONE if (t, pid) in done
+                                  else ERR_ILLEGAL_GENERATION)
+                                 for pid, _, _ in parts])
+                            for t, parts in tops]
+            else:
+                for tname, parts in tops:
+                    for pid, off, _meta in parts:
+                        broker.commit(group, tname, pid, off)
+                resp = [(tname, [(pid, ERR_NONE) for pid, _, _ in parts])
+                        for tname, parts in tops]
             w.array(resp, lambda wr, t: (wr.string(t[0]), wr.array(
                 t[1], lambda pw, p: pw.i32(p[0]).i16(p[1]))))
         elif api_key == OFFSET_FETCH:
@@ -670,6 +811,76 @@ class _KafkaConn(socketserver.BaseRequestHandler):
             w.array(resp, lambda wr, t: (wr.string(t[0]), wr.array(
                 t[1], lambda pw, p: pw.i32(p[0]).i64(p[1]).string(None)
                 .i16(ERR_NONE))))
+        elif api_key == FIND_COORDINATOR:
+            r.string()  # group id — single-broker: we coordinate everything
+            # advertise the address the client actually connected to, not
+            # the bind address (0.0.0.0 would be unconnectable)
+            host = self.request.getsockname()[0]
+            w.i16(ERR_NONE).i32(0).string(host).i32(self.server.port)
+        elif api_key == JOIN_GROUP:
+            group = r.string()
+            session_timeout_ms = r.i32()
+            member = r.string()
+            r.string()  # protocol type ("consumer")
+            protocols = r.array(lambda rd: (rd.string(), rd.bytes_()))
+            # subscription topics from the standard consumer protocol
+            # metadata: version i16, topics array<str>, userdata bytes
+            topics = []
+            if protocols:
+                meta = _Reader(protocols[0][1] or b"")
+                try:
+                    meta.i16()
+                    topics = meta.array(lambda rd: rd.string())
+                except struct.error:
+                    topics = []
+            coord = self.server.group_coordinator(
+                group, session_timeout_ms / 1000.0)
+            mid, gen, _assigned = coord.join(topics, member or None)
+            members = coord.members()
+            leader = members[0] if members else mid
+            # echo a protocol the client actually offered (a client errors
+            # out if told a protocol it never proposed); assignment itself
+            # is computed server-side regardless (see class docstring)
+            proto = protocols[0][0] if protocols else "range"
+            w.i16(ERR_NONE).i32(gen).string(proto).string(leader).string(mid)
+            # assignment is computed server-side; SyncGroup hands it out, so
+            # the leader needs no per-member metadata here
+            w.array([], lambda wr, x: None)
+        elif api_key == SYNC_GROUP:
+            group = r.string()
+            generation = r.i32()
+            member = r.string()
+            r.array(lambda rd: (rd.string(), rd.bytes_()))  # leader's (unused)
+            coord = self.server.group_coordinator(group)
+            # one atomic coordinator call: check + assignment under one lock
+            verdict, assigned = coord.sync(member, generation)
+            if verdict == "unknown_member":
+                w.i16(ERR_UNKNOWN_MEMBER_ID).bytes_(b"")
+            elif verdict == "illegal_generation":
+                w.i16(ERR_ILLEGAL_GENERATION).bytes_(b"")
+            else:
+                by_topic: dict = {}
+                for t, p in assigned:
+                    by_topic.setdefault(t, []).append(p)
+                aw = _Writer()
+                aw.i16(0)  # ConsumerProtocolAssignment version
+                aw.array(sorted(by_topic.items()), lambda wr, tp: (
+                    wr.string(tp[0]),
+                    wr.array(sorted(tp[1]), lambda pw, p: pw.i32(p))))
+                aw.bytes_(b"")  # userdata
+                w.i16(ERR_NONE).bytes_(bytes(aw.buf))
+        elif api_key == HEARTBEAT:
+            group = r.string()
+            generation = r.i32()
+            member = r.string()
+            coord = self.server.group_coordinator(group)
+            ok = coord.heartbeat(member, generation)
+            w.i16(ERR_NONE if ok else ERR_REBALANCE_IN_PROGRESS)
+        elif api_key == LEAVE_GROUP:
+            group = r.string()
+            member = r.string()
+            self.server.group_coordinator(group).leave(member)
+            w.i16(ERR_NONE)
         elif api_key == CREATE_TOPICS:
             def topic(rd):
                 name = rd.string()
@@ -711,6 +922,23 @@ class KafkaWireServer(socketserver.ThreadingTCPServer):
         self.credentials = credentials
         self.port = self.server_address[1]
         self._thread: Optional[threading.Thread] = None
+        self._coordinators: dict = {}
+        self._coord_lock = threading.Lock()
+
+    def group_coordinator(self, group_id: str,
+                          session_timeout_s: Optional[float] = None):
+        """Broker-side GroupCoordinator for a group (created on first use).
+        The session timeout is fixed by the first member that names one."""
+        from .group import GroupCoordinator
+
+        with self._coord_lock:
+            coord = self._coordinators.get(group_id)
+            if coord is None:
+                coord = GroupCoordinator(
+                    self.broker, group_id,
+                    session_timeout_s=session_timeout_s or 10.0)
+                self._coordinators[group_id] = coord
+            return coord
 
     def start(self) -> "KafkaWireServer":
         self._thread = threading.Thread(target=self.serve_forever,

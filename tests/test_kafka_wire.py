@@ -121,3 +121,85 @@ def test_cli_train_predict_against_wire_server(tmp_path):
         assert n == 100 * 100  # PREDICT take(100) × batch(100)
         first = backing.fetch("model-predictions", 0, 0, 1)[0]
         assert first.value.startswith(b"[")
+
+
+def test_cross_process_consumer_groups_over_wire():
+    """Elastic consumer groups across the wire protocol: two independent
+    clients (as if separate pods) join the same group via JoinGroup/
+    SyncGroup, split partitions disjointly, heartbeat, commit fenced, and a
+    leave hands partitions to the survivor — the reference's scalable-
+    Deployment story with membership living broker-side."""
+    from iotml.stream.broker import Broker
+    from iotml.stream.group import GroupConsumer
+    from iotml.stream.kafka_wire import (KafkaWireBroker, KafkaWireServer,
+                                         RemoteGroupCoordinator)
+
+    broker = Broker()
+    broker.create_topic("t", partitions=6)
+    for i in range(120):
+        broker.produce("t", f"r{i}".encode(), partition=i % 6)
+
+    with KafkaWireServer(broker) as server:
+        addr = f"127.0.0.1:{server.port}"
+        client1, client2 = KafkaWireBroker(addr), KafkaWireBroker(addr)
+        c1 = GroupConsumer(RemoteGroupCoordinator(client1, "g"), ["t"])
+        c2 = GroupConsumer(RemoteGroupCoordinator(client2, "g"), ["t"])
+        healed = c1.poll(1)  # heal after c2's join (sticky: delivered once)
+
+        assert len(c1.assignment) == 3 and len(c2.assignment) == 3
+        assert sorted(c1.assignment + c2.assignment) == \
+            [("t", p) for p in range(6)]
+
+        seen = set(m.value for m in healed)
+        for c in (c1, c2):
+            while True:
+                msgs = c.poll()
+                if not msgs:
+                    break
+                seen.update(m.value for m in msgs)
+        assert len(seen) == 120
+
+        # fenced commits over the wire: both succeed at their generation
+        assert c1.commit() is True and c2.commit() is True
+        committed = sum(broker.committed("g", "t", p) or 0 for p in range(6))
+        assert committed == 120
+
+        # graceful leave: survivor inherits everything at the commits
+        c2.close()
+        c1.poll()
+        assert len(c1.assignment) == 6
+
+        # a stale-generation commit from a fenced member writes nothing
+        assert client2.commit_fenced("g", 1, "ghost",
+                                     [("t", 0, 0)]) is False
+        assert broker.committed("g", "t", 0) is not None
+
+        client1.close()
+        client2.close()
+
+
+def test_fenced_commit_flags_unowned_partitions():
+    """A valid-generation commit naming a partition outside the member's
+    assignment must error for that partition, not silently drop it."""
+    from iotml.stream.broker import Broker
+    from iotml.stream.kafka_wire import (KafkaWireBroker, KafkaWireServer,
+                                         RemoteGroupCoordinator)
+
+    broker = Broker()
+    broker.create_topic("t", partitions=4)
+    with KafkaWireServer(broker) as server:
+        c1 = KafkaWireBroker(f"127.0.0.1:{server.port}")
+        c2 = KafkaWireBroker(f"127.0.0.1:{server.port}")
+        r1 = RemoteGroupCoordinator(c1, "g")
+        r2 = RemoteGroupCoordinator(c2, "g")
+        m1, g1, a1 = r1.join(["t"])
+        m2, g2, a2 = r2.join(["t"])
+        m1, g1, a1 = r1.join(["t"], m1)  # heal to the current generation
+        other = a2[0]  # a partition owned by the peer
+        assert c1.commit_fenced("g", g1, m1,
+                                [(other[0], other[1], 5)]) is False
+        assert broker.committed("g", other[0], other[1]) is None
+        # empty-positions commit still reports fencing truthfully
+        assert r1.fenced_commit(m1, g1, []) is True
+        assert r1.fenced_commit(m1, g1 - 1, []) is False
+        c1.close(); c2.close()
