@@ -9,12 +9,15 @@ model once, then poll the stream indefinitely, scoring what arrives and
 writing ordered predictions back, with consumer-group offset commits so a
 crash (or pod reschedule) resumes exactly where it stopped.
 
-    python -m iotml.cli.serve <servers> <topic> <offset|committed>
+    python -m iotml.cli.serve <servers> <topic> <offset|committed|group>
         <result_topic> <model-file> <artifact-root>
 
-`offset` may be `committed` to resume from the consumer group's last
-committed position (fresh start at 0 if none).  `--serve.*` flags / env
-tune polling and the anomaly threshold (see `iotml.config`).
+`offset` may be `committed` to resume every partition from the consumer
+group's last committed position (fresh start at 0 if none), or `group` for
+elastic membership: multiple replicas of this command split the topic's
+partitions through the group coordinator (over the Kafka wire protocol when
+the broker speaks it) and rebalance on scale-out or crash.  `--serve.*`
+flags / env tune polling and the anomaly threshold (see `iotml.config`).
 """
 
 from __future__ import annotations
@@ -23,8 +26,11 @@ import sys
 import tempfile
 
 USAGE = ("usage: python -m iotml.cli.serve <servers> <topic> "
-         "<offset|committed> <result_topic> <model-file> <artifact-root>\n"
-         "  servers: emulator[:n_records] | host:port[,host:port...]")
+         "<offset|committed|group> <result_topic> <model-file> "
+         "<artifact-root>\n"
+         "  servers: emulator[:n_records] | host:port[,host:port...]\n"
+         "  offset:  absolute | committed (resume cursor) | group (elastic "
+         "replica membership)")
 
 GROUP = "iotml-serve"
 
@@ -44,6 +50,7 @@ def main(argv=None, max_rounds=None) -> int:
         print(USAGE)
         return 1
     servers, topic, offset, result_topic, model_file, artifact_root = argv
+    offset = offset.strip().lower()
 
     from ._app import _broker_for
     from ..data.dataset import SensorBatches
@@ -62,12 +69,34 @@ def main(argv=None, max_rounds=None) -> int:
 
     payload = ocp.PyTreeCheckpointer().restore(local)
 
-    if offset == "committed":
+    def all_parts():
+        try:
+            return list(range(broker.topic(topic).partitions))
+        except KeyError:
+            return [0]
+
+    if offset == "group":
+        # elastic membership: replicas of this scorer split the topic's
+        # partitions via the group coordinator and heal on scale/crash —
+        # the reference's scalable predict Deployment (SURVEY §2.7), with
+        # rebalancing instead of fixed shards.  Remote coordination over
+        # the wire protocol when the broker speaks it; in-process otherwise.
+        from ..stream.group import GroupConsumer, GroupCoordinator
+
+        if hasattr(broker, "join_group"):
+            from ..stream.kafka_wire import RemoteGroupCoordinator
+
+            coord = RemoteGroupCoordinator(broker, GROUP)
+        else:
+            coord = GroupCoordinator(broker, GROUP)
+        consumer = GroupConsumer(coord, [topic])
+    elif offset == "committed":
         consumer = StreamConsumer.from_committed(
-            broker, topic, [0], group=GROUP, eof=False)
+            broker, topic, all_parts(), group=GROUP, eof=False)
     else:
-        consumer = StreamConsumer(broker, [f"{topic}:0:{int(offset)}"],
-                                  group=GROUP, eof=False)
+        consumer = StreamConsumer(
+            broker, [f"{topic}:{p}:{int(offset)}" for p in all_parts()],
+            group=GROUP, eof=False)
 
     from ..models.autoencoder import CAR_AUTOENCODER
 

@@ -147,18 +147,9 @@ class GroupCoordinator:
         Also watches topic metadata: a subscribed topic appearing (or
         growing partitions) triggers a rebalance, so consumers deployed
         before their producers pick the topic up once it exists — Kafka's
-        metadata-refresh rebalance."""
-        with self._lock:
-            self._expire_dead()
-            if member_id not in self._heartbeats or \
-                    generation != self.generation:
-                return False
-            meta = self._topic_metadata()
-            if meta is not self._last_topics and meta != self._last_topics:
-                self._rebalance(meta)
-                return False
-            self._heartbeats[member_id] = self._clock()
-            return True
+        metadata-refresh rebalance.  `heartbeat_verdict` gives the
+        protocol-grade distinction between the failure modes."""
+        return self.heartbeat_verdict(member_id, generation) == "ok"
 
     def fenced_commit(self, member_id: str, generation: int,
                       positions: Sequence[Tuple[str, int, int]]) -> bool:
@@ -212,6 +203,30 @@ class GroupCoordinator:
         with self._lock:
             self._expire_dead()
             return sorted(self._heartbeats)
+
+    def subscriptions(self) -> Dict[str, Tuple[str, ...]]:
+        """member_id → subscribed topics (what JoinGroup hands the elected
+        leader so it can compute a client-side assignment)."""
+        with self._lock:
+            return dict(self._subscriptions)
+
+    def heartbeat_verdict(self, member_id: str, generation: int) -> str:
+        """Protocol-grade heartbeat: "ok" | "unknown_member" |
+        "rebalance_in_progress" — external wire clients need the distinction
+        (UNKNOWN_MEMBER_ID means drop your member id and rejoin fresh;
+        REBALANCE_IN_PROGRESS means rejoin with the same id)."""
+        with self._lock:
+            self._expire_dead()
+            if member_id not in self._heartbeats:
+                return "unknown_member"
+            if generation != self.generation:
+                return "rebalance_in_progress"
+            meta = self._topic_metadata()
+            if meta is not self._last_topics and meta != self._last_topics:
+                self._rebalance(meta)
+                return "rebalance_in_progress"
+            self._heartbeats[member_id] = self._clock()
+            return "ok"
 
     # ------------------------------------------------------------ internals
     def _topic_metadata(self, force: bool = False) -> Dict[str, int]:

@@ -468,8 +468,13 @@ class KafkaWireBroker(ProducePartitionMixin):
     def commit_fenced(self, group: str, generation: int, member_id: str,
                       positions) -> bool:
         """Generation-fenced OffsetCommit (v2 carries generation+member).
-        Returns False when the broker fenced this member
-        (ILLEGAL_GENERATION) — nothing was written."""
+
+        Offset commits are per-partition in Kafka, so three outcomes:
+        every partition rejected with ILLEGAL_GENERATION → the member is
+        fenced, nothing written, returns False; every partition accepted →
+        True; a *mix* → the accepted partitions ARE committed but the rest
+        were refused (the member named partitions outside its assignment) —
+        that is a caller bug, surfaced as RuntimeError naming them."""
         by_topic: dict = {}
         for t, p, off in positions:
             by_topic.setdefault(t, []).append((p, off))
@@ -482,19 +487,25 @@ class KafkaWireBroker(ProducePartitionMixin):
         r = self._request(OFFSET_COMMIT, 2, bytes(w.buf))
         tops = r.array(lambda rd: (rd.string(), rd.array(
             lambda p: (p.i32(), p.i16()))))
-        errs = {err for _, parts in tops for _, err in parts}
-        if ERR_ILLEGAL_GENERATION in errs:
-            return False
-        bad = errs - {ERR_NONE}
-        if bad:
-            raise RuntimeError(f"offset commit failed: errors {sorted(bad)}")
-        return True
+        results = [(t, pid, err) for t, parts in tops for pid, err in parts]
+        errs = {err for _, _, err in results}
+        if errs == {ERR_NONE}:
+            return True
+        if errs == {ERR_ILLEGAL_GENERATION}:
+            return False  # fenced: nothing was written
+        bad = [(t, pid) for t, pid, err in results if err != ERR_NONE]
+        raise RuntimeError(
+            f"partial offset commit: partitions {bad} refused (outside this "
+            f"member's assignment?); the rest were committed")
 
     # ------------------------------------------- group membership (wire)
     def join_group(self, group: str, topics, member_id: str = "",
                    session_timeout_ms: int = 10_000):
         """JoinGroup v0 with the standard consumer subscription metadata.
-        Returns (generation, member_id)."""
+        Returns (generation, member_id, leader_id, members) where `members`
+        is [(member_id, [topics])] — non-empty only for the elected leader
+        (real brokers hand the leader everyone's subscriptions so it can
+        compute the assignment client-side)."""
         meta = _Writer()
         meta.i16(0)
         meta.array(list(topics), lambda wr, t: wr.string(t))
@@ -510,20 +521,53 @@ class KafkaWireBroker(ProducePartitionMixin):
             raise RuntimeError(f"join group {group}: error {err}")
         generation = r.i32()
         r.string()  # protocol
-        r.string()  # leader
+        leader = r.string()
         mid = r.string()
-        return generation, mid
+        members = []
+        for other_id, blob in r.array(lambda rd: (rd.string(), rd.bytes_())):
+            sub = []
+            if blob:
+                mr = _Reader(blob)
+                try:
+                    mr.i16()
+                    sub = mr.array(lambda rd: rd.string())
+                except struct.error:
+                    sub = []
+            members.append((other_id, sub))
+        return generation, mid, leader, members
 
-    def sync_group(self, group: str, generation: int, member_id: str):
-        """SyncGroup v0 → [(topic, partition), ...] assignment."""
+    def sync_group(self, group: str, generation: int, member_id: str,
+                   assignments: Optional[dict] = None):
+        """SyncGroup v0 → [(topic, partition), ...] assignment.
+
+        `assignments` (leader only): {member_id: [(topic, [partitions])]}
+        serialized in the standard ConsumerProtocolAssignment format — real
+        brokers store-and-forward it to each member (our server computes
+        assignment itself and ignores it, same response either way)."""
         w = _Writer()
         w.string(group).i32(generation).string(member_id)
-        w.array([], lambda wr, x: None)
+
+        def one(wr, item):
+            other_id, tps = item
+            aw = _Writer()
+            aw.i16(0)
+            aw.array(sorted(tps), lambda xw, tp: (
+                xw.string(tp[0]),
+                aw_array_parts(xw, tp[1])))
+            aw.bytes_(b"")
+            wr.string(other_id).bytes_(bytes(aw.buf))
+
+        def aw_array_parts(xw, parts):
+            xw.array(sorted(parts), lambda pw, p: pw.i32(p))
+
+        w.array(sorted((assignments or {}).items()), one)
         r = self._request(SYNC_GROUP, 0, bytes(w.buf))
         err = r.i16()
         blob = r.bytes_() or b""
         if err != ERR_NONE:
             raise RuntimeError(f"sync group {group}: error {err}")
+        if not blob:
+            return []  # coordinator had nothing for us (yet)
         ar = _Reader(blob)
         ar.i16()  # version
         pairs = []
@@ -568,16 +612,47 @@ class RemoteGroupCoordinator:
         mid = member_id or ""
         last_err = None
         for _ in range(5):  # a peer joining between Join and Sync bumps the
-            generation, mid = self.broker.join_group(  # generation: rejoin
-                self.group_id, topics, mid,
+            generation, mid, leader, members = self.broker.join_group(
+                self.group_id, topics, mid,  # generation: rejoin
                 session_timeout_ms=self.session_timeout_ms)
+            assignments = None
+            if mid == leader and members:
+                # elected leader: compute the range assignment client-side
+                # and submit it in SyncGroup — the standard protocol flow a
+                # real broker requires (ours computes server-side and gets
+                # the same answer)
+                assignments = self._leader_assign(members)
             try:
-                assignment = self.broker.sync_group(self.group_id,
-                                                    generation, mid)
+                assignment = self.broker.sync_group(
+                    self.group_id, generation, mid, assignments)
                 return mid, generation, assignment
             except RuntimeError as e:
                 last_err = e
         raise last_err
+
+    def _leader_assign(self, members):
+        """RangeAssignor over the members' subscriptions, as
+        {member_id: [(topic, [partitions])]}."""
+        from .group import range_assign
+
+        topic_parts: dict = {}
+        for _mid, topics in members:
+            for t in topics:
+                if t not in topic_parts:
+                    try:
+                        topic_parts[t] = self.broker.topic(t).partitions
+                    except KeyError:
+                        continue  # subscribe-before-create: nothing yet
+        flat = range_assign([m for m, _ in members], topic_parts)
+        subscribed = {m: set(ts) for m, ts in members}
+        out = {}
+        for m, tps in flat.items():
+            by_topic: dict = {}
+            for t, p in tps:
+                if t in subscribed.get(m, ()):
+                    by_topic.setdefault(t, []).append(p)
+            out[m] = sorted(by_topic.items())
+        return out
 
     def heartbeat(self, member_id: str, generation: int) -> bool:
         return self.broker.heartbeat_group(self.group_id, generation,
@@ -843,9 +918,19 @@ class _KafkaConn(socketserver.BaseRequestHandler):
             # is computed server-side regardless (see class docstring)
             proto = protocols[0][0] if protocols else "range"
             w.i16(ERR_NONE).i32(gen).string(proto).string(leader).string(mid)
-            # assignment is computed server-side; SyncGroup hands it out, so
-            # the leader needs no per-member metadata here
-            w.array([], lambda wr, x: None)
+            # standard flow: the elected leader receives every member's
+            # subscription metadata so it can compute the assignment
+            # client-side (our SyncGroup computes server-side regardless,
+            # and ignores what the leader submits — same answer)
+            rows = []
+            if mid == leader:
+                for other_id, subs in sorted(coord.subscriptions().items()):
+                    mw = _Writer()
+                    mw.i16(0)
+                    mw.array(list(subs), lambda wr2, t: wr2.string(t))
+                    mw.bytes_(b"")
+                    rows.append((other_id, bytes(mw.buf)))
+            w.array(rows, lambda wr, x: (wr.string(x[0]), wr.bytes_(x[1])))
         elif api_key == SYNC_GROUP:
             group = r.string()
             generation = r.i32()
@@ -874,8 +959,11 @@ class _KafkaConn(socketserver.BaseRequestHandler):
             generation = r.i32()
             member = r.string()
             coord = self.server.group_coordinator(group)
-            ok = coord.heartbeat(member, generation)
-            w.i16(ERR_NONE if ok else ERR_REBALANCE_IN_PROGRESS)
+            verdict = coord.heartbeat_verdict(member, generation)
+            w.i16({"ok": ERR_NONE,
+                   "unknown_member": ERR_UNKNOWN_MEMBER_ID,
+                   "rebalance_in_progress": ERR_REBALANCE_IN_PROGRESS}
+                  [verdict])
         elif api_key == LEAVE_GROUP:
             group = r.string()
             member = r.string()

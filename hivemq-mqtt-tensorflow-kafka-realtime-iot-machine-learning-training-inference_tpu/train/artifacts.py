@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import os
 import shutil
+import tempfile
 
 
 class ArtifactStore:
@@ -57,19 +58,24 @@ class ArtifactStore:
 
     # ------------------------------------------------- checkpoint trees
     def upload_tree(self, local_dir: str, name: str) -> str:
-        """Ship a directory (e.g. an orbax step dir) as a zip blob."""
-        tmp = shutil.make_archive(os.path.join("/tmp", f"iotml_{name}"),
-                                  "zip", local_dir)
+        """Ship a directory (e.g. an orbax step dir) as a zip blob.
+
+        The staging archive gets a unique path: concurrent jobs on one host
+        (scaled scorer replicas, parallel trainers) must not interleave
+        writes into the same /tmp file."""
+        stage = tempfile.mkdtemp(prefix="iotml_up_")
+        tmp = shutil.make_archive(os.path.join(stage, name), "zip", local_dir)
         try:
             return self.upload(tmp, f"{name}.zip")
         finally:
-            os.unlink(tmp)
+            shutil.rmtree(stage, ignore_errors=True)
 
     def download_tree(self, name: str, local_dir: str) -> str:
-        tmp = os.path.join("/tmp", f"iotml_dl_{name}.zip")
+        stage = tempfile.mkdtemp(prefix="iotml_dl_")
+        tmp = os.path.join(stage, f"{name}.zip")
         self.download(f"{name}.zip", tmp)
         try:
             shutil.unpack_archive(tmp, local_dir, "zip")
         finally:
-            os.unlink(tmp)
+            shutil.rmtree(stage, ignore_errors=True)
         return local_dir
