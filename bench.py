@@ -50,14 +50,20 @@ def main():
         return time.perf_counter() - t0, history
 
     # Cold pass pays the one-time XLA compile (10-50s over the TPU tunnel,
-    # high variance); the warm pass is the sustained streaming rate — the
+    # high variance); warm passes are the sustained streaming rate — the
     # steady-state number a long-lived trainer delivers, and the honest
-    # analogue of the reference's repeated 10-minute train jobs.
+    # analogue of the reference's repeated 10-minute train jobs.  The
+    # tunnel's per-dispatch latency is noisy, so report the median of
+    # three warm passes.
     cold_wall, history = run_job()
     from iotml.obs.profile import maybe_trace
     import os
+    warm_walls = []
     with maybe_trace(os.environ.get("IOTML_PROFILE")):
-        warm_wall, history2 = run_job()
+        for _ in range(3):
+            wall, history2 = run_job()
+            warm_walls.append(wall)
+    warm_wall = sorted(warm_walls)[1]
     value = n_records / warm_wall
 
     print(json.dumps({
@@ -66,9 +72,9 @@ def main():
         "unit": "records/s",
         "vs_baseline": round(value / BASELINE_RECORDS_PER_SEC, 2),
     }))
-    print(f"# warm_wall={warm_wall:.2f}s cold_wall={cold_wall:.2f}s "
-          f"(cold includes one-time XLA compile) epochs={epochs} "
-          f"final_loss={history['loss'][-1]:.6f} "
+    print(f"# warm_walls={[round(w, 2) for w in warm_walls]}s (median used) "
+          f"cold_wall={cold_wall:.2f}s (cold includes one-time XLA compile) "
+          f"epochs={epochs} final_loss={history['loss'][-1]:.6f} "
           f"records_per_epoch={history['records'][0]}", file=sys.stderr)
 
 
