@@ -61,7 +61,7 @@ def main():
     warm_walls = []
     with maybe_trace(os.environ.get("IOTML_PROFILE")):
         for _ in range(3):
-            wall, history2 = run_job()
+            wall, _ = run_job()
             warm_walls.append(wall)
     warm_wall = sorted(warm_walls)[1]
     value = n_records / warm_wall

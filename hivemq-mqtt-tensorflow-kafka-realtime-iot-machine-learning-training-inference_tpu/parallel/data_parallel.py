@@ -116,23 +116,56 @@ class ShardedTrainer:
         self.state, metrics = self._step(self.state, xd, yd, md)
         return metrics
 
-    def fit(self, batches, epochs: int = 1) -> dict:
+    def fit(self, batches, epochs: int = 1, prefetch_depth: int = 2) -> dict:
+        """Epochs × steps with host↔device overlap.
+
+        Two things keep the chips fed (SURVEY §7 hard part (b) — host decode
+        must hide under the device step):
+        - a `DevicePrefetcher` stages the next batch's sharded `device_put`
+          on a background thread while the current step executes, and
+        - per-step losses stay on device (no blocking `float()` per step);
+          the sync happens once per epoch.
+        """
         import numpy as np
+
+        from ..data.prefetch import DevicePrefetcher
 
         history = {"loss": [], "records": [], "seconds": []}
         import time as _t
 
+        def to_device(b):
+            y = b.y if b.y is not None else b.x
+            return self.put_batch(b.x, y, b.mask), b
+
         epoch_iter = batches.epochs(epochs) if hasattr(batches, "epochs") \
             else (iter(batches) for _ in range(epochs))
+        import itertools
+
         for it in epoch_iter:
             t0 = _t.perf_counter()
+            it = iter(it)
+            if self.state is None:
+                # init on the main thread (param sharding + jit build must
+                # not ride the prefetch worker); peek the first batch for
+                # shapes and chain it back
+                first = next(it, None)
+                if first is None:
+                    history["loss"].append(float("nan"))
+                    history["records"].append(0)
+                    history["seconds"].append(_t.perf_counter() - t0)
+                    continue
+                self.init(first.x)
+                it = itertools.chain([first], it)
             losses, records = [], 0
-            for b in it:
-                y = b.y if b.y is not None else b.x
-                m = self.step(b.x, y, b.mask)
-                losses.append(float(m["loss"]))
-                records += b.n_valid
-            history["loss"].append(float(np.mean(losses)) if losses else float("nan"))
+            with DevicePrefetcher(it, to_device=to_device,
+                                  depth=prefetch_depth) as pf:
+                for (xd, yd, md), b in pf:
+                    self.state, m = self._step(self.state, xd, yd, md)
+                    losses.append(m["loss"])  # device scalar: no step sync
+                    records += b.n_valid
+            losses = [float(v) for v in jax.device_get(losses)]
+            history["loss"].append(float(np.mean(losses)) if losses
+                                   else float("nan"))
             history["records"].append(records)
             history["seconds"].append(_t.perf_counter() - t0)
         return history
