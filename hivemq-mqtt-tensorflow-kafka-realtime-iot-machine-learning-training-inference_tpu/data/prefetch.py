@@ -34,6 +34,11 @@ class DevicePrefetcher:
 
     def __init__(self, batches: Iterable, to_device: Optional[Callable] = None,
                  depth: int = 2, sharding=None):
+        if depth < 1:
+            # queue.Queue(maxsize=0) means UNBOUNDED — a depth of 0 would
+            # silently stage the entire stream onto the device with no
+            # backpressure instead of disabling prefetch
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self.batches = batches
         self.sharding = sharding
         self.to_device = to_device or self._default_to_device

@@ -48,3 +48,12 @@ def test_prefetch_is_single_use():
     list(pf)
     with pytest.raises(RuntimeError, match="single-use"):
         list(pf)
+
+
+def test_depth_below_one_rejected():
+    import pytest
+
+    from iotml.data.prefetch import DevicePrefetcher
+
+    with pytest.raises(ValueError, match="depth"):
+        DevicePrefetcher([], depth=0)
