@@ -1,0 +1,39 @@
+"""Top-level CLI index: `python -m iotml` lists every entry point.
+
+The reference scatters its runnable surface across shell scripts, kubectl
+plugins, and positional-arg Python files; here one command shows the map.
+"""
+
+from __future__ import annotations
+
+import sys
+
+COMMANDS = [
+    ("iotml.cli.demo", "the whole reference pipeline end-to-end in one "
+                       "command (fleet → KSQL → train → serve → anomalies)"),
+    ("iotml.cli.up", "whole platform in one process (Kafka wire + MQTT + "
+                     "Schema-Registry/KSQL/Connect REST + metrics + fleet)"),
+    ("iotml.cli.cardata", "car-sensor autoencoder: streaming train/predict "
+                          "(reference cardata-v3.py contract)"),
+    ("iotml.cli.lstm", "LSTM seq2seq: streaming train/predict (reference "
+                       "LSTM cardata-v2.py contract)"),
+    ("iotml.cli.serve", "long-lived scorer with ordered write-back "
+                        "(offset|committed|group elastic modes)"),
+    ("iotml.cli.creditcard", "creditcard fraud demo: produce + train + eval"),
+    ("iotml.cli.mnist_smoke", "MNIST ingest smoke test + in-memory control"),
+    ("iotml.cli.devsim", "scenario-driven device fleet "
+                         "(run/jobs/show/log/abort/example)"),
+    ("iotml.obs.dashboards", "generate the Grafana dashboard ConfigMap"),
+]
+
+
+def main() -> int:
+    print("iotml — TPU-native streaming ML framework. Entry points:\n")
+    for mod, desc in COMMANDS:
+        print(f"  python -m {mod:24s} {desc}")
+    print("\nSee README.md, PARITY.md, and deploy/README.md.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

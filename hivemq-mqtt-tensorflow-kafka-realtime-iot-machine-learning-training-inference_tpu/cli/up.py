@@ -153,6 +153,15 @@ class Platform:
         self._fleet_thread = threading.Thread(target=run, daemon=True)
         self._fleet_thread.start()
 
+    def stop_fleet(self) -> None:
+        """Stop the simulated fleet and wait for its last publishes to
+        land (join the thread), so callers can pump once afterwards and
+        see a quiescent stream."""
+        self._fleet_stop.set()
+        if self._fleet_thread is not None:
+            self._fleet_thread.join(timeout=10)
+            self._fleet_thread = None
+
     def pump(self) -> int:
         """Advance continuous queries + connectors once (deterministic)."""
         n = self.ksql.pump_now()

@@ -115,3 +115,21 @@ def test_platform_with_live_fleet():
         assert end >= 10
     finally:
         p.stop()
+
+
+def test_demo_end_to_end(capsys):
+    """The one-command demo: fleet → bridge → KSQL → train → checkpoint →
+    score → anomaly verdicts, all in-process."""
+    import json as _json
+
+    from iotml.cli import demo
+
+    rc = demo.main(["--cars", "6", "--seconds", "2", "--rate", "20",
+                    "--epochs", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    summary = _json.loads(out[out.index("{"):])
+    assert summary["mqtt_messages_bridged"] > 0
+    assert summary["ksql_avro_records"] == summary["mqtt_messages_bridged"]
+    assert summary["scored"] == summary["ksql_avro_records"]
+    assert summary["loss_first_to_last"][1] <= summary["loss_first_to_last"][0]
