@@ -220,12 +220,24 @@ class KafkaWireBroker(ProducePartitionMixin):
                  sasl_username: Optional[str] = None,
                  sasl_password: Optional[str] = None,
                  timeout_s: float = 30.0):
-        host, _, port = servers.split(",")[0].partition(":")
         self.client_id = client_id
         self._lock = threading.Lock()
         self._corr = 0
-        self._sock = socket.create_connection((host, int(port or 9092)),
-                                              timeout=timeout_s)
+        # bootstrap list: try each server in order (a standard client's
+        # bootstrap.servers semantics), keep the first that answers
+        from ..utils.net import parse_bootstrap
+
+        last_err: Optional[Exception] = None
+        self._sock = None
+        for host, port in parse_bootstrap(servers):
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=timeout_s)
+                break
+            except OSError as e:
+                last_err = e
+        if self._sock is None:
+            raise last_err or OSError(f"no reachable broker in {servers!r}")
         self._meta: Dict[str, int] = {}  # topic → partition count
         self._rr: Dict[str, int] = {}
         if sasl_username is not None:

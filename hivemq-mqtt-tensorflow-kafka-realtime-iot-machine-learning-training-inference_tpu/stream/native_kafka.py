@@ -98,12 +98,19 @@ class NativeKafkaBroker(ProducePartitionMixin):
             raise RuntimeError("native stream engine unavailable")
         _sig(lib)
         self._lib = lib
-        host, _, port = servers.split(",")[0].partition(":")
-        self._h = lib.iotml_kafka_connect(
-            host.encode(), int(port or 9092), client_id.encode(),
-            sasl_username.encode() if sasl_username is not None else None,
-            sasl_password.encode() if sasl_password is not None else None,
-            ctypes.c_double(timeout_s))
+        # bootstrap list: first reachable server wins (standard
+        # bootstrap.servers semantics, shared parser with KafkaWireBroker)
+        from ..utils.net import parse_bootstrap
+
+        self._h = None
+        for host, port in parse_bootstrap(servers):
+            self._h = lib.iotml_kafka_connect(
+                host.encode(), port, client_id.encode(),
+                sasl_username.encode() if sasl_username is not None else None,
+                sasl_password.encode() if sasl_password is not None else None,
+                ctypes.c_double(timeout_s))
+            if self._h:
+                break
         if not self._h:
             raise ConnectionError(
                 f"native kafka connect to {servers} failed"

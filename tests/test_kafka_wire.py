@@ -203,3 +203,30 @@ def test_fenced_commit_flags_unowned_partitions():
         assert r1.fenced_commit(m1, g1, []) is True
         assert r1.fenced_commit(m1, g1 - 1, []) is False
         c1.close(); c2.close()
+
+
+def test_bootstrap_server_failover():
+    """bootstrap.servers semantics: unreachable entries are skipped, the
+    first answering broker wins; all-dead lists raise."""
+    from iotml.stream.broker import Broker
+    from iotml.stream.kafka_wire import KafkaWireBroker, KafkaWireServer
+
+    broker = Broker()
+    broker.produce("t", b"x")
+    with KafkaWireServer(broker) as srv:
+        client = KafkaWireBroker(
+            f"127.0.0.1:1, 127.0.0.1:{srv.port}", timeout_s=2.0)
+        assert [m.value for m in client.fetch("t", 0, 0)] == [b"x"]
+        client.close()
+    with pytest.raises(OSError):
+        KafkaWireBroker("127.0.0.1:1,127.0.0.1:2", timeout_s=1.0)
+
+
+def test_parse_bootstrap_handles_malformed_and_ipv6():
+    from iotml.utils.net import parse_bootstrap
+
+    assert parse_bootstrap("a:1, b ,c:9O92,d:2") == \
+        [("a", 1), ("b", 9092), ("d", 2)]
+    assert parse_bootstrap("[::1]:3,[fe80::1]") == \
+        [("::1", 3), ("fe80::1", 9092)]
+    assert parse_bootstrap(",,") == []
