@@ -40,7 +40,8 @@ class Platform:
     def __init__(self, sasl: Optional[tuple] = None, partitions: int = 10,
                  kafka_port: int = 0, mqtt_port: int = 0,
                  registry_port: int = 0, ksql_port: int = 0,
-                 connect_port: int = 0, host: str = "127.0.0.1"):
+                 connect_port: int = 0, host: str = "127.0.0.1",
+                 retention_messages: Optional[int] = None):
         from ..connect import ConnectServer, ConnectWorker
         from ..core.schema import CAR_SCHEMA, KSQL_CAR_SCHEMA
         from ..mqtt.bridge import KafkaBridge
@@ -54,9 +55,15 @@ class Platform:
         from ..streamproc.sql import install_reference_pipeline
 
         self.broker = Broker()
-        # the reference's two topics, its partition count
-        self.broker.create_topic("sensor-data", partitions=partitions)
-        self.broker.create_topic("model-predictions", partitions=partitions)
+        # the reference's two topics, its partition count.  retention
+        # bounds the in-memory log for long-running platforms (the
+        # reference sets retention.ms=100000 — aggressive 100s retention,
+        # 01_installConfluentPlatform.sh:180-183); None keeps everything,
+        # which week-long soak tests will notice.
+        self.broker.create_topic("sensor-data", partitions=partitions,
+                                 retention_messages=retention_messages)
+        self.broker.create_topic("model-predictions", partitions=partitions,
+                                 retention_messages=retention_messages)
 
         self.host = host
         self.kafka = KafkaWireServer(self.broker, host=host, port=kafka_port,
@@ -203,12 +210,22 @@ def main(argv=None) -> int:
     ap.add_argument("--ksql-port", type=int, default=0)
     ap.add_argument("--connect-port", type=int, default=0)
     ap.add_argument("--metrics-port", type=int, default=9100)
+    def _non_negative(v):
+        n = int(v)
+        if n < 0:
+            raise argparse.ArgumentTypeError("retention must be >= 0")
+        return n
+
+    ap.add_argument("--retention", type=_non_negative, default=0, metavar="N",
+                    help="keep at most N messages per partition "
+                         "(0 = unbounded; the reference retains ~100s)")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
 
     sasl = tuple(args.sasl.split(":", 1)) if args.sasl else None
     plat = Platform(sasl=sasl, host=args.host, kafka_port=args.kafka_port,
                     mqtt_port=args.mqtt_port,
+                    retention_messages=args.retention or None,
                     registry_port=args.registry_port,
                     ksql_port=args.ksql_port,
                     connect_port=args.connect_port)

@@ -71,7 +71,8 @@ class KafkaBridge:
                 with self._n_lock:
                     self._n_fwd += 1
 
-            mqtt.connect(cid, deliver, clean_start=True)
+            sess = mqtt.connect(cid, deliver, clean_start=True)
+            mqtt.deliver_pending(sess)  # in-process consumer: ready at once
             for f in m.mqtt_topic_filters:
                 mqtt.subscribe(cid, f)
 

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..obs.metrics import default_registry
@@ -24,7 +25,8 @@ DeliveryFn = Callable[[str, bytes, int, bool], None]
 
 
 class Session:
-    __slots__ = ("client_id", "deliver", "clean_start", "connected_at")
+    __slots__ = ("client_id", "deliver", "clean_start", "connected_at",
+                 "pending")
 
     def __init__(self, client_id: str, deliver: DeliveryFn,
                  clean_start: bool = True):
@@ -32,20 +34,40 @@ class Session:
         self.deliver = deliver
         self.clean_start = clean_start
         self.connected_at = time.time()
+        # messages queued while this persistent session was offline, held
+        # until the transport is ready (CONNACK sent); live publishes
+        # append here until drained so ordering is preserved
+        self.pending: Optional[List[Tuple[str, bytes, int]]] = None
 
 
 class MqttBroker:
     """Session + subscription + retained-message state with synchronous
-    fan-out delivery.  Thread-safe; delivery callbacks run on the
-    publisher's thread (the wire server hands each connection its own
-    writer lock, so concurrent fan-out is safe)."""
+    fan-out delivery.  Thread-safe: routing decisions and queue mutations
+    happen under the broker lock, but delivery callbacks run on the
+    publisher's thread AFTER the lock is released — a stalled subscriber
+    socket can slow its publisher, never the whole broker.  Ordering is
+    per-publisher (as before); the wire server hands each connection its
+    own writer lock, so concurrent fan-out to one socket is safe."""
 
-    def __init__(self, name: str = "iotml-mqtt"):
+    def __init__(self, name: str = "iotml-mqtt",
+                 offline_queue_limit: int = 1000,
+                 offline_session_expiry_s: float = 3600.0):
         self.name = name
         self._sessions: Dict[str, Session] = {}
         self._tree = TopicTree()
         self._retained: Dict[str, Tuple[bytes, int]] = {}
-        self._lock = threading.Lock()
+        # disconnected persistent sessions: cid → (queue, expires_at).
+        # QoS≥1 deliveries queue (oldest dropped past the limit, HiveMQ's
+        # offline buffering); a session that never reconnects expires after
+        # offline_session_expiry_s (HiveMQ's session expiry) so rotating
+        # client ids cannot grow state without bound.
+        self._offline: Dict[str, Tuple[deque, float]] = {}
+        self.offline_queue_limit = offline_queue_limit
+        self.offline_session_expiry_s = offline_session_expiry_s
+        self._next_offline_sweep = 0.0
+        # RLock: delivery callbacks may legally re-enter (a subscriber that
+        # publishes from its handler, e.g. a bridge)
+        self._lock = threading.RLock()
         reg = default_registry
         self._m_in = reg.counter(
             "mqtt_messages_incoming_publish_count",
@@ -57,6 +79,9 @@ class MqttBroker:
         self._m_dropped = reg.counter(
             "mqtt_messages_dropped_count",
             "publishes that matched no subscription")
+        self._m_queued = reg.counter(
+            "mqtt_messages_queued_count",
+            "QoS>=1 publishes buffered for offline persistent sessions")
         self._g_sessions = reg.gauge(
             "mqtt_sessions_overall_current", "live MQTT sessions")
 
@@ -64,14 +89,65 @@ class MqttBroker:
     def connect(self, client_id: str, deliver: DeliveryFn,
                 clean_start: bool = True) -> Session:
         """Register a session.  A reconnect with the same client id takes
-        over (the old delivery path is dropped — MQTT session takeover)."""
+        over (the old delivery path is dropped — MQTT session takeover).
+
+        A persistent session (clean_start=False) that reconnects has the
+        QoS≥1 messages queued while it was offline staged on
+        `session.pending`; the transport calls `deliver_pending(session)`
+        once it is ready (AFTER sending CONNACK — a PUBLISH before CONNACK
+        breaks the handshake).  Until that drain, live publishes for the
+        session append behind the queued ones, preserving order."""
         with self._lock:
+            self._expire_offline()
+            pending: List[Tuple[str, bytes, int]] = []
+            old = self._sessions.get(client_id)
+            if old is not None and old.pending:
+                # session takeover mid-handshake: the superseded connection
+                # must not drain the backlog to its (likely dead) socket —
+                # the new session inherits it
+                pending = old.pending
+                old.pending = []
             if clean_start:
                 self._tree.unsubscribe_all(client_id)
+                self._offline.pop(client_id, None)
+                pending = []
+            else:
+                entry = self._offline.pop(client_id, None)
+                if entry is not None:
+                    pending = list(entry[0]) + pending
             s = Session(client_id, deliver, clean_start)
+            # deliveries are held on `pending` until the transport declares
+            # ready via deliver_pending() — this covers both the offline
+            # backlog AND live publishes racing the CONNECT handshake (a
+            # PUBLISH before CONNACK is a protocol violation)
+            s.pending = pending
             self._sessions[client_id] = s
             self._g_sessions.set(len(self._sessions))
             return s
+
+    def deliver_pending(self, session: Session) -> int:
+        """Drain a freshly-connected session's queued messages and switch
+        it to live delivery.  Call after the transport is ready (CONNACK on
+        the wire path; immediately for in-process clients).
+
+        Chunked: queue entries are taken under the lock but delivered
+        outside it (a slow socket must not wedge the broker); publishes
+        arriving mid-drain keep appending behind the backlog, preserving
+        order.  A session superseded by a takeover stops immediately."""
+        n = 0
+        while True:
+            with self._lock:
+                if self._sessions.get(session.client_id) is not session:
+                    return n  # superseded: the new session owns the backlog
+                chunk = session.pending or []
+                if not chunk:
+                    session.pending = None  # live from here on
+                    return n
+                session.pending = []  # mid-drain arrivals land here
+            for topic, payload, qos in chunk:
+                session.deliver(topic, payload, qos, False)
+                self._m_out.inc()
+                n += 1
 
     def disconnect(self, client_id: str,
                    session: Optional[Session] = None) -> None:
@@ -79,13 +155,30 @@ class MqttBroker:
         stale connection's teardown cannot destroy a session that was
         taken over by a newer connection with the same client id."""
         with self._lock:
+            self._expire_offline()
             cur = self._sessions.get(client_id)
             if cur is None or (session is not None and cur is not session):
                 return
             del self._sessions[client_id]
             if cur.clean_start:
                 self._tree.unsubscribe_all(client_id)
+            else:
+                # persistent session goes offline: queue QoS≥1 deliveries
+                # until it reconnects (bounded, drop-oldest) or expires
+                q = deque(cur.pending or (),
+                          maxlen=self.offline_queue_limit)
+                self._offline[client_id] = (
+                    q, time.time() + self.offline_session_expiry_s)
             self._g_sessions.set(len(self._sessions))
+
+    def _expire_offline(self) -> None:
+        """Drop offline persistent sessions past their expiry (HiveMQ's
+        session-expiry): queue AND subscriptions go. Caller holds _lock."""
+        now = time.time()
+        dead = [cid for cid, (_q, exp) in self._offline.items() if exp < now]
+        for cid in dead:
+            del self._offline[cid]
+            self._tree.unsubscribe_all(cid)
 
     def session_count(self) -> int:
         return len(self._sessions)
@@ -119,22 +212,46 @@ class MqttBroker:
         if "+" in topic or "#" in topic:
             raise ValueError(f"wildcards not allowed in publish topic: {topic!r}")
         self._m_in.inc()
-        if retain:
-            if payload:
-                self._retained[topic] = (payload, qos)
-            else:
-                self._retained.pop(topic, None)  # empty retained = clear
-        receivers = self._tree.receivers(topic)
-        delivered = 0
-        for cid, granted in receivers:
-            sess = self._sessions.get(cid)
-            if sess is None:
-                continue
-            sess.deliver(topic, payload, min(qos, granted), False)
+        delivered = queued = 0
+        live: List[Tuple[Session, int]] = []
+        with self._lock:  # routing + queue mutation atomic; delivery after
+            now = time.time()
+            if now >= self._next_offline_sweep:
+                self._expire_offline()
+                self._next_offline_sweep = now + 5.0
+            if retain:
+                if payload:
+                    self._retained[topic] = (payload, qos)
+                else:
+                    self._retained.pop(topic, None)  # empty retained = clear
+            for cid, granted in self._tree.receivers(topic):
+                eff = min(qos, granted)
+                sess = self._sessions.get(cid)
+                if sess is None:
+                    entry = self._offline.get(cid)
+                    if entry is not None and eff >= 1:
+                        entry[0].append((topic, payload, eff))
+                        queued += 1
+                    continue
+                if sess.pending is not None:
+                    # reconnect in progress: keep order behind the queued
+                    # backlog instead of jumping ahead of it (same bound as
+                    # the offline queue: drop-oldest)
+                    sess.pending.append((topic, payload, eff))
+                    if len(sess.pending) > self.offline_queue_limit:
+                        del sess.pending[0]
+                    else:
+                        queued += 1
+                    continue
+                live.append((sess, eff))
+        for sess, eff in live:  # outside the lock: a slow socket blocks
+            sess.deliver(topic, payload, eff, False)  # only its publisher
             delivered += 1
         if delivered:
             self._m_out.inc(delivered)
-        else:
+        if queued:
+            self._m_queued.inc(queued)
+        if not delivered and not queued:
             self._m_dropped.inc()
         return delivered
 
@@ -152,6 +269,7 @@ class QueueClient:
         self.messages: List[Tuple[str, bytes, int, bool]] = []
         self._lock = threading.Lock()
         self._session = broker.connect(client_id, self._deliver, clean_start)
+        broker.deliver_pending(self._session)  # in-process: ready at once
 
     def _deliver(self, topic: str, payload: bytes, qos: int, retain: bool):
         with self._lock:

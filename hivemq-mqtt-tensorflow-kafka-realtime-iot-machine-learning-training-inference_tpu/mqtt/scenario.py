@@ -260,7 +260,8 @@ class ScenarioRunner:
                 with self._count_lock:
                     self.consumer_counts[_cid] += 1
 
-            self.broker.connect(cid, deliver)
+            sess = self.broker.connect(cid, deliver)
+            self.broker.deliver_pending(sess)  # in-process: ready at once
             for filt in filters:
                 self.broker.subscribe(cid, filt)
             consumers.append(cid)

@@ -165,6 +165,9 @@ class _Conn(socketserver.BaseRequestHandler):
                     session = broker.connect(client_id, self._deliver, clean)
                     ack = b"\x00\x00\x00" if self._level >= 5 else b"\x00\x00"
                     self._send(packet(CONNACK, 0, ack))
+                    # only after CONNACK is on the wire may queued offline
+                    # PUBLISHes flow (a pre-CONNACK PUBLISH breaks clients)
+                    broker.deliver_pending(session)
                 elif ptype == PUBLISH:
                     qos = (flags >> 1) & 0x03
                     retain = bool(flags & 0x01)

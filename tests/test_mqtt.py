@@ -424,3 +424,139 @@ def test_scenario_topic_group_smaller_than_client_group():
     summary = ScenarioRunner(sc, MqttBroker()).run()
     assert summary["published"] == 20
     assert summary["consumer-s2"] == 20  # nothing bypasses the group
+
+
+def test_persistent_session_queues_qos1_while_offline():
+    """HiveMQ semantics: a persistent session's QoS≥1 messages are queued
+    while it is offline and delivered on reconnect; QoS 0 is not queued;
+    a clean reconnect discards the queue."""
+    from iotml.mqtt.broker import MqttBroker, QueueClient
+
+    broker = MqttBroker()
+    c = QueueClient(broker, "car-1", clean_start=False)
+    c.subscribe("vehicles/sensor/data/#", qos=1)
+    broker.publish("vehicles/sensor/data/car-1", b"live", qos=1)
+    assert [m[1] for m in c.messages] == [b"live"]
+
+    broker.disconnect("car-1")
+    broker.publish("vehicles/sensor/data/car-1", b"offline-1", qos=1)
+    broker.publish("vehicles/sensor/data/car-1", b"offline-q0", qos=0)
+    broker.publish("vehicles/sensor/data/car-1", b"offline-2", qos=1)
+
+    c2 = QueueClient(broker, "car-1", clean_start=False)
+    # queued QoS1 messages arrive on reconnect, in order; QoS0 was dropped
+    assert [m[1] for m in c2.messages] == [b"offline-1", b"offline-2"]
+    # subscription survived too: new publishes flow
+    broker.publish("vehicles/sensor/data/car-1", b"after", qos=1)
+    assert c2.messages[-1][1] == b"after"
+
+    # clean reconnect discards both the queue and the subscriptions
+    broker.disconnect("car-1")
+    broker.publish("vehicles/sensor/data/car-1", b"lost", qos=1)
+    c3 = QueueClient(broker, "car-1", clean_start=True)
+    assert c3.messages == []
+    broker.publish("vehicles/sensor/data/car-1", b"unrouted", qos=1)
+    assert c3.messages == []
+
+
+def test_offline_queue_bounded_drop_oldest():
+    from iotml.mqtt.broker import MqttBroker, QueueClient
+
+    broker = MqttBroker(offline_queue_limit=3)
+    c = QueueClient(broker, "c", clean_start=False)
+    c.subscribe("t", qos=1)
+    broker.disconnect("c")
+    for i in range(5):
+        broker.publish("t", f"m{i}".encode(), qos=1)
+    c2 = QueueClient(broker, "c", clean_start=False)
+    assert [m[1] for m in c2.messages] == [b"m2", b"m3", b"m4"]
+
+
+def test_offline_session_expiry_drops_queue_and_subscriptions():
+    import time as _time
+    from unittest import mock
+
+    from iotml.mqtt.broker import MqttBroker, QueueClient
+
+    broker = MqttBroker(offline_session_expiry_s=10.0)
+    c = QueueClient(broker, "gone", clean_start=False)
+    c.subscribe("t", qos=1)
+    broker.disconnect("gone")
+    broker.publish("t", b"queued", qos=1)
+    assert broker._offline  # queued while within expiry
+
+    with mock.patch("iotml.mqtt.broker.time") as m:
+        m.time.return_value = _time.time() + 11.0
+        # any session operation sweeps expired offline state
+        QueueClient(broker, "other", clean_start=True)
+    assert not broker._offline
+    # the expired session's subscription is gone: publish routes nowhere
+    assert broker.publish("t", b"after-expiry", qos=1) == 0
+    c2 = QueueClient(broker, "gone", clean_start=False)
+    assert c2.messages == []
+
+
+def test_queued_publish_not_counted_as_dropped():
+    from iotml.mqtt.broker import MqttBroker, QueueClient
+
+    broker = MqttBroker()
+    dropped0 = broker._m_dropped.value()
+    queued0 = broker._m_queued.value()
+    c = QueueClient(broker, "c", clean_start=False)
+    c.subscribe("t", qos=1)
+    broker.disconnect("c")
+    broker.publish("t", b"x", qos=1)
+    assert broker._m_dropped.value() == dropped0
+    assert broker._m_queued.value() == queued0 + 1
+
+
+def test_wire_reconnect_delivers_queue_after_connack():
+    """Persistent session over real TCP: CONNACK must precede the queued
+    PUBLISHes, or the client's handshake parser rejects the stream."""
+    from iotml.mqtt.broker import MqttBroker
+    from iotml.mqtt.wire import MqttClient, MqttServer
+
+    broker = MqttBroker()
+    with MqttServer(broker) as srv:
+        got = []
+        c = MqttClient("127.0.0.1", srv.port, "car-9", clean=False,
+                       on_message=lambda t, p: got.append(p))
+        c.subscribe("t", qos=1)
+        c.disconnect()
+        broker.publish("t", b"while-away-1", qos=1)
+        broker.publish("t", b"while-away-2", qos=1)
+        c2 = MqttClient("127.0.0.1", srv.port, "car-9", clean=False,
+                        on_message=lambda t, p: got.append(p))
+        deadline = __import__("time").time() + 5
+        while len(got) < 2 and __import__("time").time() < deadline:
+            __import__("time").sleep(0.05)
+        assert got == [b"while-away-1", b"while-away-2"]
+        c2.disconnect()
+
+
+def test_takeover_mid_handshake_moves_backlog_to_new_session():
+    """Reconnect storm: a second CONNECT for the same client id before the
+    first connection drained its backlog must inherit the queue; the
+    superseded connection's drain must deliver nothing."""
+    from iotml.mqtt.broker import MqttBroker
+
+    broker = MqttBroker()
+    got_a, got_b = [], []
+    sa = broker.connect("car", lambda t, p, q, r: got_a.append(p),
+                        clean_start=False)
+    broker.deliver_pending(sa)
+    broker.subscribe("car", "t", qos=1)
+    broker.disconnect("car")
+    broker.publish("t", b"queued-1", qos=1)
+    broker.publish("t", b"queued-2", qos=1)
+
+    sa2 = broker.connect("car", lambda t, p, q, r: got_a.append(p),
+                         clean_start=False)       # connection A (stalls)
+    sb = broker.connect("car", lambda t, p, q, r: got_b.append(p),
+                        clean_start=False)        # takeover: connection B
+    assert broker.deliver_pending(sa2) == 0       # superseded: delivers none
+    assert broker.deliver_pending(sb) == 2
+    assert got_a == [] and got_b == [b"queued-1", b"queued-2"]
+    # B is live now
+    broker.publish("t", b"live", qos=1)
+    assert got_b[-1] == b"live"
