@@ -210,22 +210,17 @@ def main(argv=None) -> int:
     ap.add_argument("--ksql-port", type=int, default=0)
     ap.add_argument("--connect-port", type=int, default=0)
     ap.add_argument("--metrics-port", type=int, default=9100)
-    def _non_negative(v):
-        n = int(v)
-        if n < 0:
-            raise argparse.ArgumentTypeError("retention must be >= 0")
-        return n
-
-    ap.add_argument("--retention", type=_non_negative, default=0, metavar="N",
+    ap.add_argument("--retention", type=int, default=0, metavar="N",
                     help="keep at most N messages per partition "
-                         "(0 = unbounded; the reference retains ~100s)")
+                         "(0 = unbounded; the reference retains ~100s). "
+                         "Validated by the broker (negative rejected).")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
 
     sasl = tuple(args.sasl.split(":", 1)) if args.sasl else None
     plat = Platform(sasl=sasl, host=args.host, kafka_port=args.kafka_port,
                     mqtt_port=args.mqtt_port,
-                    retention_messages=args.retention or None,
+                    retention_messages=args.retention,
                     registry_port=args.registry_port,
                     ksql_port=args.ksql_port,
                     connect_port=args.connect_port)

@@ -26,7 +26,7 @@ DeliveryFn = Callable[[str, bytes, int, bool], None]
 
 class Session:
     __slots__ = ("client_id", "deliver", "clean_start", "connected_at",
-                 "pending")
+                 "pending", "resumed")
 
     def __init__(self, client_id: str, deliver: DeliveryFn,
                  clean_start: bool = True):
@@ -38,6 +38,9 @@ class Session:
         # until the transport is ready (CONNACK sent); live publishes
         # append here until drained so ordering is preserved
         self.pending: Optional[List[Tuple[str, bytes, int]]] = None
+        # True when server-side state (subscriptions/backlog) carried over —
+        # what CONNACK's session-present flag must report
+        self.resumed: bool = False
 
 
 class MqttBroker:
@@ -107,6 +110,7 @@ class MqttBroker:
                 # the new session inherits it
                 pending = old.pending
                 old.pending = []
+            resumed = False
             if clean_start:
                 self._tree.unsubscribe_all(client_id)
                 self._offline.pop(client_id, None)
@@ -115,7 +119,11 @@ class MqttBroker:
                 entry = self._offline.pop(client_id, None)
                 if entry is not None:
                     pending = list(entry[0]) + pending
+                # session-present: any server-side state carried over
+                resumed = (entry is not None or old is not None
+                           or bool(self._tree.filters_of(client_id)))
             s = Session(client_id, deliver, clean_start)
+            s.resumed = resumed
             # deliveries are held on `pending` until the transport declares
             # ready via deliver_pending() — this covers both the offline
             # backlog AND live publishes racing the CONNECT handshake (a
@@ -130,24 +138,37 @@ class MqttBroker:
         it to live delivery.  Call after the transport is ready (CONNACK on
         the wire path; immediately for in-process clients).
 
-        Chunked: queue entries are taken under the lock but delivered
-        outside it (a slow socket must not wedge the broker); publishes
-        arriving mid-drain keep appending behind the backlog, preserving
-        order.  A session superseded by a takeover stops immediately."""
+        Chunked: queue entries are COPIED under the lock, delivered outside
+        it (a slow socket must not wedge the broker), and only removed from
+        the backlog after delivery — so a takeover mid-chunk inherits the
+        in-flight messages (possible duplicates, never loss: QoS 1's
+        at-least-once).  Publishes arriving mid-drain append behind the
+        backlog, preserving order."""
         n = 0
         while True:
             with self._lock:
                 if self._sessions.get(session.client_id) is not session:
                     return n  # superseded: the new session owns the backlog
-                chunk = session.pending or []
+                chunk = list(session.pending or [])
                 if not chunk:
                     session.pending = None  # live from here on
                     return n
-                session.pending = []  # mid-drain arrivals land here
             for topic, payload, qos in chunk:
                 session.deliver(topic, payload, qos, False)
                 self._m_out.inc()
                 n += 1
+            with self._lock:
+                if self._sessions.get(session.client_id) is not session:
+                    return n  # delivered chunk may be redelivered by heir
+                # drop the delivered messages BY IDENTITY: a concurrent
+                # overflow drop-oldest may already have removed a prefix of
+                # the chunk, and positional deletion would then take an
+                # undelivered mid-drain arrival with it (silent loss)
+                ci = 0
+                while session.pending and ci < len(chunk):
+                    if session.pending[0] is chunk[ci]:
+                        session.pending.pop(0)
+                    ci += 1
 
     def disconnect(self, client_id: str,
                    session: Optional[Session] = None) -> None:
@@ -190,15 +211,28 @@ class MqttBroker:
         validate_filter(filter_)
         granted = min(qos, 1)
         self._tree.subscribe(client_id, filter_, granted)
-        # retained delivery on subscribe (spec §3.8.4)
+        # retained delivery on subscribe (spec §3.8.4) — through the same
+        # gate as publish(): routing under the lock, a not-yet-ready
+        # session's messages join its pending backlog (never a PUBLISH
+        # before CONNACK / ahead of the queued backlog), sockets written
+        # only after the lock is released
         from .topic_tree import split_share, topic_matches
         group, real = split_share(filter_)
+        live: List[Tuple[str, bytes, int]] = []
         if group is None:  # retained messages are not sent to shared subs
-            sess = self._sessions.get(client_id)
-            if sess is not None:
-                for topic, (payload, rqos) in list(self._retained.items()):
-                    if topic_matches(real, topic):
-                        sess.deliver(topic, payload, min(granted, rqos), True)
+            with self._lock:
+                sess = self._sessions.get(client_id)
+                if sess is not None:
+                    for topic, (payload, rqos) in list(self._retained.items()):
+                        if not topic_matches(real, topic):
+                            continue
+                        eff = min(granted, rqos)
+                        if sess.pending is not None:
+                            sess.pending.append((topic, payload, eff))
+                        else:
+                            live.append((topic, payload, eff))
+            for topic, payload, eff in live:
+                sess.deliver(topic, payload, eff, True)
         return granted
 
     def unsubscribe(self, client_id: str, filter_: str) -> bool:
@@ -224,7 +258,11 @@ class MqttBroker:
                     self._retained[topic] = (payload, qos)
                 else:
                     self._retained.pop(topic, None)  # empty retained = clear
-            for cid, granted in self._tree.receivers(topic):
+            def is_live(cid: str) -> bool:
+                s = self._sessions.get(cid)
+                return s is not None and s.pending is None
+
+            for cid, granted in self._tree.receivers(topic, is_live=is_live):
                 eff = min(qos, granted)
                 sess = self._sessions.get(cid)
                 if sess is None:

@@ -135,10 +135,17 @@ class TopicTree:
                 self._collect(child, levels, i + 1, skip_wild_root, out,
                               path + [key])
 
-    def receivers(self, topic: str) -> List[Tuple[str, int]]:
+    def receivers(self, topic: str,
+                  is_live=None) -> List[Tuple[str, int]]:
         """All (client_id, granted_qos) that should receive a publish on
         `topic`; each shared group contributes exactly one member, rotated
-        per matching filter."""
+        per matching filter.
+
+        `is_live(cid) -> bool`, when given, steers shared-group selection:
+        the rotation skips to the next LIVE member so an offline persistent
+        member does not swallow its share of the group's traffic (HiveMQ
+        queues for a shared group only when no member is connected).  Falls
+        back to the plain rotation pick when every member is offline."""
         levels = topic.split("/")
         shield = levels[0].startswith("$")
         matched: List[Tuple[_Node, str]] = []
@@ -158,7 +165,16 @@ class TopicTree:
                         groups.setdefault(group, []).append((cid, qos))
                 for group, members in groups.items():
                     cur = self._rr.get((group, filter_str), 0)
-                    cid, qos = members[cur % len(members)]
+                    pick = None
+                    for i in range(len(members)):
+                        cand = members[(cur + i) % len(members)]
+                        if is_live is None or is_live(cand[0]):
+                            pick = cand
+                            cur = cur + i
+                            break
+                    if pick is None:  # nobody live: queue at rotation pick
+                        pick = members[cur % len(members)]
+                    cid, qos = pick
                     self._rr[(group, filter_str)] = cur + 1
                     if cid not in seen:
                         seen.add(cid)

@@ -161,9 +161,19 @@ class _Conn(socketserver.BaseRequestHandler):
                     if self._level >= 5:
                         pos = _skip_props(body, pos)
                     client_id, pos = _read_str(body, pos)
+                    if not client_id and not clean:
+                        # §3.1.3-8: a zero-byte client id REQUIRES a clean
+                        # session — a synthesized persistent id could never
+                        # be resumed, only leak offline queue state
+                        self._send(packet(CONNACK, 0, b"\x00\x02"))
+                        return
                     client_id = client_id or f"anon-{id(self):x}"
                     session = broker.connect(client_id, self._deliver, clean)
-                    ack = b"\x00\x00\x00" if self._level >= 5 else b"\x00\x00"
+                    # byte 1 bit 0 = session-present (MQTT 3.1.1 §3.2.2.2):
+                    # a resumed persistent session must say so, or spec
+                    # clients discard their subscription state
+                    sp = b"\x01" if session.resumed else b"\x00"
+                    ack = sp + (b"\x00\x00" if self._level >= 5 else b"\x00")
                     self._send(packet(CONNACK, 0, ack))
                     # only after CONNACK is on the wire may queued offline
                     # PUBLISHes flow (a pre-CONNACK PUBLISH breaks clients)

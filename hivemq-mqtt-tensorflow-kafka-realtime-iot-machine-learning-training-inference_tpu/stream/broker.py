@@ -69,11 +69,13 @@ class Broker:
     # ------------------------------------------------------------- topics
     def create_topic(self, name: str, partitions: int = 1,
                      retention_messages: Optional[int] = None) -> TopicSpec:
-        if retention_messages is not None and retention_messages < 1:
-            # a negative/zero cap would delete every produced record while
-            # producers believe writes succeed; unbounded is None
-            raise ValueError(f"retention_messages must be >= 1 or None, "
+        if retention_messages is not None and retention_messages < 0:
+            # a negative cap would delete every produced record while
+            # producers believe writes succeed
+            raise ValueError(f"retention_messages must be >= 0 or None, "
                              f"got {retention_messages}")
+        if not retention_messages:
+            retention_messages = None  # 0 = unbounded (BrokerConfig sentinel)
         with self._lock:
             if name in self._topics:
                 return self._topics[name]

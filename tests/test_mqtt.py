@@ -523,6 +523,13 @@ def test_wire_reconnect_delivers_queue_after_connack():
                        on_message=lambda t, p: got.append(p))
         c.subscribe("t", qos=1)
         c.disconnect()
+        # the server handler tears the session down asynchronously; publish
+        # only once the broker has seen the disconnect (else the message
+        # races the closed socket instead of the offline queue)
+        deadline = __import__("time").time() + 5
+        while broker.session_count() and __import__("time").time() < deadline:
+            __import__("time").sleep(0.02)
+        assert broker.session_count() == 0
         broker.publish("t", b"while-away-1", qos=1)
         broker.publish("t", b"while-away-2", qos=1)
         c2 = MqttClient("127.0.0.1", srv.port, "car-9", clean=False,
@@ -560,3 +567,93 @@ def test_takeover_mid_handshake_moves_backlog_to_new_session():
     # B is live now
     broker.publish("t", b"live", qos=1)
     assert got_b[-1] == b"live"
+
+
+def test_shared_subscription_skips_offline_members():
+    """HiveMQ routes a $share group's message to a CONNECTED member; an
+    offline persistent member must not swallow its rotation share."""
+    from iotml.mqtt.broker import MqttBroker, QueueClient
+
+    broker = MqttBroker()
+    live1 = QueueClient(broker, "live1", clean_start=False)
+    live2 = QueueClient(broker, "live2", clean_start=False)
+    gone = QueueClient(broker, "gone", clean_start=False)
+    for c in (live1, live2, gone):
+        c.subscribe("$share/g/t", qos=1)
+    broker.disconnect("gone")
+
+    for i in range(12):
+        broker.publish("t", f"m{i}".encode(), qos=1)
+    # every message went to a live member; nothing piled up for the corpse
+    assert len(live1.messages) + len(live2.messages) == 12
+    assert len(broker._offline["gone"][0]) == 0
+    # ...but with NO live members, the group's traffic queues
+    broker.disconnect("live1")
+    broker.disconnect("live2")
+    broker.publish("t", b"all-offline", qos=1)
+    queued = sum(len(q) for q, _ in broker._offline.values())
+    assert queued == 1
+
+
+def test_connack_reports_session_present_on_resume():
+    from iotml.mqtt.broker import MqttBroker
+    from iotml.mqtt.wire import MqttServer
+    import socket
+    import struct
+
+    from iotml.mqtt.wire import connect_packet
+
+    broker = MqttBroker()
+
+    def raw_connect(clean):
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        s.sendall(connect_packet("dev-1", 4, clean=clean))
+        hdr = s.recv(2)
+        assert hdr[0] >> 4 == 2  # CONNACK
+        body = s.recv(hdr[1])
+        return s, body[0] & 0x01  # session-present bit
+
+    with MqttServer(broker) as srv:
+        s1, present1 = raw_connect(clean=False)
+        assert present1 == 0  # first connect: nothing to resume
+        # subscribe so there is server-side state to resume
+        from iotml.mqtt.wire import MqttClient
+        s1.close()
+        c = MqttClient("127.0.0.1", srv.port, "dev-1", clean=False)
+        c.subscribe("t", qos=1)
+        c.disconnect()
+        import time as _t
+        deadline = _t.time() + 5
+        while broker.session_count() and _t.time() < deadline:
+            _t.sleep(0.02)
+        s2, present2 = raw_connect(clean=False)
+        assert present2 == 1  # resumed persistent session
+        s2.close()
+        s3, present3 = raw_connect(clean=True)
+        assert present3 == 0  # clean start wipes it
+        s3.close()
+
+
+def test_empty_client_id_with_persistent_session_rejected():
+    """§3.1.3-8: zero-byte client id requires a clean session — otherwise
+    CONNACK 0x02 (identifier rejected)."""
+    import socket
+
+    from iotml.mqtt.broker import MqttBroker
+    from iotml.mqtt.wire import MqttServer, connect_packet
+
+    broker = MqttBroker()
+    with MqttServer(broker) as srv:
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        s.sendall(connect_packet("", 4, clean=False))
+        hdr = s.recv(2)
+        body = s.recv(hdr[1])
+        assert hdr[0] >> 4 == 2 and body[1] == 0x02
+        s.close()
+        # clean+empty is fine (anon id synthesized)
+        s2 = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        s2.sendall(connect_packet("", 4, clean=True))
+        hdr = s2.recv(2)
+        body = s2.recv(hdr[1])
+        assert hdr[0] >> 4 == 2 and body[1] == 0x00
+        s2.close()

@@ -205,3 +205,42 @@ def test_remote_group_churn_over_wire():
 
     assert not errors
     assert len(seen) == 600
+
+
+def test_wire_servers_survive_garbage_bytes():
+    """Malformed frames on the TCP ports must drop that connection, never
+    kill the server: subsequent well-formed clients keep working."""
+    import random
+    import socket
+
+    from iotml.mqtt.broker import MqttBroker
+    from iotml.mqtt.wire import MqttClient, MqttServer
+
+    rng = random.Random(11)
+    broker = Broker()
+    broker.produce("t", b"v")
+    with KafkaWireServer(broker) as ksrv:
+        for _ in range(10):
+            s = socket.create_connection(("127.0.0.1", ksrv.port), timeout=2)
+            s.sendall(rng.randbytes(rng.randint(1, 64)))
+            s.close()
+        client = KafkaWireBroker(f"127.0.0.1:{ksrv.port}")
+        assert [m.value for m in client.fetch("t", 0, 0)] == [b"v"]
+        client.close()
+
+    mbroker = MqttBroker()
+    with MqttServer(mbroker) as msrv:
+        for _ in range(10):
+            s = socket.create_connection(("127.0.0.1", msrv.port), timeout=2)
+            s.sendall(rng.randbytes(rng.randint(1, 64)))
+            s.close()
+        got = []
+        c = MqttClient("127.0.0.1", msrv.port, "ok",
+                       on_message=lambda t, p: got.append(p))
+        c.subscribe("x", qos=0)
+        mbroker.publish("x", b"alive", qos=0)
+        deadline = __import__("time").time() + 5
+        while not got and __import__("time").time() < deadline:
+            __import__("time").sleep(0.05)
+        assert got == [b"alive"]
+        c.disconnect()
