@@ -20,6 +20,8 @@ brings up and prints endpoints for
   - the KSQL-equivalent REST API, reference DDL pipeline pre-installed
   - the Kafka-Connect REST API
   - a Prometheus /metrics exporter
+  - a control-center UI (live topics/queries/sessions/metrics — the
+    Confluent C3 / HiveMQ Control Center stand-in)
 
 With `--fleet N`, N simulated cars publish continuously over real MQTT —
 the whole reference demo, minus the Kubernetes cluster.  Ctrl-C stops
@@ -41,7 +43,7 @@ class Platform:
                  kafka_port: int = 0, mqtt_port: int = 0,
                  registry_port: int = 0, ksql_port: int = 0,
                  connect_port: int = 0, host: str = "127.0.0.1",
-                 retention_messages: Optional[int] = None):
+                 retention_messages: Optional[int] = None, cc_port: int = 0):
         from ..connect import ConnectServer, ConnectWorker
         from ..core.schema import CAR_SCHEMA, KSQL_CAR_SCHEMA
         from ..mqtt.bridge import KafkaBridge
@@ -89,6 +91,9 @@ class Platform:
                                   partitions=partitions)
         self.mqtt = MqttServer(self.mqtt_broker, host=host, port=mqtt_port)
 
+        from ..obs.control_center import ControlCenter
+
+        self.control_center = ControlCenter(self, host=host, port=cc_port)
         self._obs = obs_metrics
         self.metrics_server = None
         self._fleet_stop = threading.Event()
@@ -103,6 +108,7 @@ class Platform:
         self.mqtt.start()
         if metrics_port is not None:
             self.metrics_server = self._obs.start_http_server(metrics_port)
+        self.control_center.start()
         self.started = True
         return self
 
@@ -113,6 +119,7 @@ class Platform:
             "schema-registry": self.registry_server.url,
             "ksql": self.ksql.url,
             "connect": self.connect.url,
+            "control-center": self.control_center.url,
         }
         if self.metrics_server is not None:
             out["metrics"] = "http://127.0.0.1:" + \
@@ -179,7 +186,8 @@ class Platform:
         self._fleet_stop.set()
         if self._fleet_thread is not None:
             self._fleet_thread.join(timeout=3)
-        for s in (self.connect, self.ksql, self.registry_server):
+        for s in (self.connect, self.ksql, self.registry_server,
+                  self.control_center):
             s.stop()
         self.kafka.shutdown()
         self.kafka.server_close()
@@ -209,6 +217,8 @@ def main(argv=None) -> int:
     ap.add_argument("--registry-port", type=int, default=0)
     ap.add_argument("--ksql-port", type=int, default=0)
     ap.add_argument("--connect-port", type=int, default=0)
+    ap.add_argument("--cc-port", type=int, default=0,
+                    help="control-center UI port (topics/queries/metrics)")
     ap.add_argument("--metrics-port", type=int, default=9100)
     ap.add_argument("--retention", type=int, default=0, metavar="N",
                     help="keep at most N messages per partition "
@@ -218,12 +228,17 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     sasl = tuple(args.sasl.split(":", 1)) if args.sasl else None
-    plat = Platform(sasl=sasl, host=args.host, kafka_port=args.kafka_port,
-                    mqtt_port=args.mqtt_port,
-                    retention_messages=args.retention,
-                    registry_port=args.registry_port,
-                    ksql_port=args.ksql_port,
-                    connect_port=args.connect_port)
+    try:
+        plat = Platform(sasl=sasl, host=args.host,
+                        kafka_port=args.kafka_port,
+                        mqtt_port=args.mqtt_port,
+                        retention_messages=args.retention,
+                        cc_port=args.cc_port,
+                        registry_port=args.registry_port,
+                        ksql_port=args.ksql_port,
+                        connect_port=args.connect_port)
+    except ValueError as e:  # e.g. negative retention: clean usage error
+        ap.error(str(e))
     plat.start(metrics_port=args.metrics_port)
     if args.fleet:
         plat.start_fleet(args.fleet, rate_hz=args.rate)

@@ -34,10 +34,11 @@ class Session:
         self.deliver = deliver
         self.clean_start = clean_start
         self.connected_at = time.time()
-        # messages queued while this persistent session was offline, held
-        # until the transport is ready (CONNACK sent); live publishes
-        # append here until drained so ordering is preserved
-        self.pending: Optional[List[Tuple[str, bytes, int]]] = None
+        # (topic, payload, qos, retain) queued while this persistent
+        # session was offline, held until the transport is ready (CONNACK
+        # sent); live publishes append here until drained so ordering is
+        # preserved
+        self.pending: Optional[List[Tuple[str, bytes, int, bool]]] = None
         # True when server-side state (subscriptions/backlog) carried over —
         # what CONNACK's session-present flag must report
         self.resumed: bool = False
@@ -102,7 +103,7 @@ class MqttBroker:
         session append behind the queued ones, preserving order."""
         with self._lock:
             self._expire_offline()
-            pending: List[Tuple[str, bytes, int]] = []
+            pending: List[Tuple[str, bytes, int, bool]] = []
             old = self._sessions.get(client_id)
             if old is not None and old.pending:
                 # session takeover mid-handshake: the superseded connection
@@ -153,8 +154,8 @@ class MqttBroker:
                 if not chunk:
                     session.pending = None  # live from here on
                     return n
-            for topic, payload, qos in chunk:
-                session.deliver(topic, payload, qos, False)
+            for topic, payload, qos, retain in chunk:
+                session.deliver(topic, payload, qos, retain)
                 self._m_out.inc()
                 n += 1
             with self._lock:
@@ -227,8 +228,10 @@ class MqttBroker:
                         if not topic_matches(real, topic):
                             continue
                         eff = min(granted, rqos)
+                        # retain=True rides along: spec 3.3.1.3 requires the
+                        # flag on messages sent due to a new subscription
                         if sess.pending is not None:
-                            sess.pending.append((topic, payload, eff))
+                            sess.pending.append((topic, payload, eff, True))
                         else:
                             live.append((topic, payload, eff))
             for topic, payload, eff in live:
@@ -268,14 +271,14 @@ class MqttBroker:
                 if sess is None:
                     entry = self._offline.get(cid)
                     if entry is not None and eff >= 1:
-                        entry[0].append((topic, payload, eff))
+                        entry[0].append((topic, payload, eff, False))
                         queued += 1
                     continue
                 if sess.pending is not None:
                     # reconnect in progress: keep order behind the queued
                     # backlog instead of jumping ahead of it (same bound as
                     # the offline queue: drop-oldest)
-                    sess.pending.append((topic, payload, eff))
+                    sess.pending.append((topic, payload, eff, False))
                     if len(sess.pending) > self.offline_queue_limit:
                         del sess.pending[0]
                     else:

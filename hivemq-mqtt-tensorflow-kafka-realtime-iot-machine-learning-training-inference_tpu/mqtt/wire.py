@@ -164,8 +164,12 @@ class _Conn(socketserver.BaseRequestHandler):
                     if not client_id and not clean:
                         # §3.1.3-8: a zero-byte client id REQUIRES a clean
                         # session — a synthesized persistent id could never
-                        # be resumed, only leak offline queue state
-                        self._send(packet(CONNACK, 0, b"\x00\x02"))
+                        # be resumed, only leak offline queue state.
+                        # v5: reason 0x85 (client id not valid) + empty
+                        # properties; v4: return code 0x02
+                        reject = (b"\x00\x85\x00" if self._level >= 5
+                                  else b"\x00\x02")
+                        self._send(packet(CONNACK, 0, reject))
                         return
                     client_id = client_id or f"anon-{id(self):x}"
                     session = broker.connect(client_id, self._deliver, clean)

@@ -141,6 +141,25 @@ class Registry:
     def render(self) -> str:
         return "\n".join(m.render() for _, m in sorted(self._metrics.items())) + "\n"
 
+    def collect(self) -> Dict[str, float]:
+        """Structured snapshot: metric name (with label suffix for labeled
+        series; `_sum`/`_count` for histograms) → value.  The typed
+        counterpart of `render()` for programmatic consumers."""
+        out: Dict[str, float] = {}
+        for name, m in sorted(self._metrics.items()):
+            if isinstance(m, Histogram):
+                with m._lock:
+                    out[f"{name}_sum"] = m._sum
+                    out[f"{name}_count"] = float(m._n)
+                continue
+            with m._lock:
+                vals = dict(m._vals)
+            for key, v in sorted(vals.items()):
+                out[name + _fmt_labels(dict(key))] = v
+            if not vals:
+                out[name] = 0.0
+        return out
+
 
 default_registry = Registry()
 records_consumed = default_registry.counter(

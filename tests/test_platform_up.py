@@ -133,3 +133,20 @@ def test_demo_end_to_end(capsys):
     assert summary["ksql_avro_records"] == summary["mqtt_messages_bridged"]
     assert summary["scored"] == summary["ksql_avro_records"]
     assert summary["loss_first_to_last"][1] <= summary["loss_first_to_last"][0]
+
+
+def test_control_center_ui_and_status(platform):
+    eps = platform.endpoints()
+    host, port = eps["control-center"].split("//")[1].rsplit(":", 1)
+    status, snap = _get(host, int(port), "/api/status")
+    assert status == 200
+    assert any(t["name"] == "sensor-data" for t in snap["topics"])
+    assert len(snap["ksql"]["queries"]) == 3
+    assert "mqtt_sessions" in snap and "metrics" in snap
+
+    conn = http.client.HTTPConnection(host, int(port), timeout=5)
+    conn.request("GET", "/")
+    r = conn.getresponse()
+    page = r.read().decode()
+    assert r.status == 200 and "iotml control center" in page
+    assert "sensor-data" in page
