@@ -48,13 +48,30 @@ def _expr_for(metric) -> tuple:
     return f"rate({metric.name}[1m])", "ops"
 
 
+#: metric-name prefixes → dashboard family, mirroring the reference's split
+#: into `hivemq.json` (broker-side) and `devsim.json` (load-generator)
+#: plus the ML family the reference never charted.
+FAMILIES = {
+    "broker": ("mqtt_", "kafka_extension_"),
+    "devsim": ("agent_",),
+    "ml": ("iotml_",),
+}
+
+
 def generate_dashboard(title: str = "iotml",
                        registry: Optional[m.Registry] = None,
-                       uid: Optional[str] = None) -> dict:
-    """One dashboard with a panel per registered metric (2 per row)."""
+                       uid: Optional[str] = None,
+                       family: Optional[str] = None) -> dict:
+    """One dashboard with a panel per registered metric (2 per row).
+
+    `family` restricts to one of FAMILIES' prefix groups — the reference's
+    per-concern dashboards; None charts everything."""
     registry = registry or m.default_registry
     panels: List[dict] = []
     names = sorted(registry._metrics) if hasattr(registry, "_metrics") else []
+    if family is not None:
+        prefixes = FAMILIES[family]
+        names = [n for n in names if n.startswith(prefixes)]
     for i, name in enumerate(names):
         metric = registry._metrics[name]
         expr, unit = _expr_for(metric)
@@ -81,19 +98,35 @@ def generate_dashboard(title: str = "iotml",
 def dashboard_configmap(name: str = "iotml-dashboard",
                         title: str = "iotml",
                         registry: Optional[m.Registry] = None) -> str:
-    """The reference's deployment shape: dashboard JSON wrapped in a
-    grafana_dashboard-labeled ConfigMap (setup.sh:18-19)."""
-    dash = generate_dashboard(title, registry)
+    """The reference's deployment shape: dashboard JSONs wrapped in a
+    grafana_dashboard-labeled ConfigMap (setup.sh:18-19) — one JSON per
+    family (the reference ships hivemq.json + devsim.json; the ml family
+    is the training/serving view it never had) plus the everything view."""
+    data = {f"{title}.json": json.dumps(generate_dashboard(title, registry))}
+    for fam in FAMILIES:
+        dash = generate_dashboard(f"{title}-{fam}", registry, family=fam)
+        if dash["panels"]:
+            data[f"{title}-{fam}.json"] = json.dumps(dash)
     doc = {
         "apiVersion": "v1",
         "kind": "ConfigMap",
         "metadata": {"name": name,
                      "labels": {"grafana_dashboard": "1"}},
-        "data": {f"{title}.json": json.dumps(dash)},
+        "data": data,
     }
     return json.dumps(doc, indent=2)
 
 
 if __name__ == "__main__":
-    # emit the dashboard ConfigMap for `kubectl apply -f -` (deploy/README.md)
+    # emit the dashboard ConfigMap for `kubectl apply -f -` (deploy/README.md).
+    # Metric families register on component construction; build one of each
+    # so the emitted dashboards cover every family the platform can export.
+    from ..mqtt.bridge import KafkaBridge
+    from ..mqtt.broker import MqttBroker
+    from ..mqtt.scenario import EVALUATION_SCENARIO, ScenarioRunner
+    from ..stream.broker import Broker
+
+    _mqtt = MqttBroker()
+    KafkaBridge(_mqtt, Broker(), partitions=1)
+    ScenarioRunner(EVALUATION_SCENARIO, _mqtt)
     print(dashboard_configmap())

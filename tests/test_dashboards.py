@@ -36,3 +36,22 @@ def test_configmap_shape():
     assert doc["metadata"]["labels"]["grafana_dashboard"] == "1"
     inner = json.loads(doc["data"]["iotml.json"])
     assert inner["schemaVersion"] == 16 and inner["panels"]
+
+
+def test_family_dashboards_mirror_reference_split():
+    """The reference ships hivemq.json (broker) + devsim.json (agents); the
+    generated ConfigMap carries those families plus the ml view."""
+    from iotml.mqtt.broker import MqttBroker
+
+    MqttBroker()  # registers the mqtt_* family in the default registry
+    doc = json.loads(dashboard_configmap())
+    assert "iotml.json" in doc["data"]
+    assert "iotml-broker.json" in doc["data"]
+    assert "iotml-ml.json" in doc["data"]
+    broker_dash = json.loads(doc["data"]["iotml-broker.json"])
+    titles = {p["targets"][0]["expr"] for p in broker_dash["panels"]}
+    assert any("mqtt_" in t for t in titles)
+    assert not any("iotml_records" in t for t in titles)  # families disjoint
+
+    ml = generate_dashboard(family="ml")
+    assert all("iotml_" in p["targets"][0]["expr"] for p in ml["panels"])
