@@ -145,8 +145,11 @@ class Platform:
             # multiplex round-robin over the open connections (every car
             # still publishes on its own MQTT topic every tick)
             n_conns = min(num_cars, 64)
+            # connect to the address the platform actually listens on; a
+            # wildcard bind is reachable via loopback
+            connect_host = "127.0.0.1" if self.host in ("0.0.0.0", "::") else self.host
             clients = [
-                MqttClient("127.0.0.1", self.mqtt.port, scenario.car_id(i))
+                MqttClient(connect_host, self.mqtt.port, scenario.car_id(i))
                 for i in range(n_conns)
             ]
             try:

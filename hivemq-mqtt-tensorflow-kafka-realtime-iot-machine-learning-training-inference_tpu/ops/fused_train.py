@@ -109,13 +109,16 @@ def _fit_kernel(xs_ref, mask_ref, t0_ref, *refs, n_tensors: int,
         out = jnp.maximum(z4, 0.0)
 
         denom = jnp.maximum(jnp.sum(msk) * feat, 1.0)
-        diff = (out - x) * msk
+        # mask enters the loss LINEARLY (a per-row sample weight), matching
+        # train.loop._masked_mse — for 0/1 masks this is indistinguishable
+        # from masking diff, but fractional weights must not get squared
+        diff = out - x
         penalty = l1 * jnp.sum(jnp.abs(h1)) / batch
-        loss = jnp.sum(diff * diff) / denom + penalty
+        loss = jnp.sum(diff * diff * msk) / denom + penalty
         acc = jnp.sum((out == x).astype(jnp.float32) * msk) / denom
 
         # ---- backward (hand-derived; matches jax.grad of the above)
-        dz4 = (2.0 / denom) * diff * (z4 > 0.0)
+        dz4 = (2.0 / denom) * diff * msk * (z4 > 0.0)
         dW4 = dot(h3.T, dz4)
         db4 = jnp.sum(dz4, axis=0)
         dh3 = dot(dz4, w4.T)

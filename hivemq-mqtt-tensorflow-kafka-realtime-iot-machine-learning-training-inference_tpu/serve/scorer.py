@@ -15,6 +15,7 @@ downstream consumers of the predictions topic see identical payloads.
 
 from __future__ import annotations
 
+import itertools
 import time
 from typing import Optional
 
@@ -45,6 +46,13 @@ class StreamScorer:
         protocol, threshold 5).
     """
 
+    #: Upper bound on batches stacked into one device dispatch.  A drain of
+    #: an arbitrarily deep backlog (e.g. scoring a retained topic from offset
+    #: 0) proceeds in fixed-size super-batches so host+device memory stays
+    #: bounded, while a typical drain (≤ this many batches) keeps the
+    #: single-dispatch win.
+    max_super_batches = 64
+
     def __init__(self, model, params, batches: SensorBatches,
                  out: OutputSequence, threshold: Optional[float] = None):
         self.model = model
@@ -58,17 +66,33 @@ class StreamScorer:
     def score_available(self) -> int:
         """Drain whatever is currently in the stream; returns rows scored.
 
-        The whole drain is ONE device dispatch: batches are stacked and
-        scored as a single [S*B, F] eval instead of a dispatch per 100-row
-        batch — per-dispatch link latency dominates a model this small, so
-        a drain of 100 batches costs one round trip instead of 100."""
-        n0 = self.scored
+        Each super-batch is ONE device dispatch: up to max_super_batches
+        batches are stacked and scored as a single [S*B, F] eval instead of
+        a dispatch per 100-row batch — per-dispatch link latency dominates a
+        model this small, so a typical drain costs one round trip instead of
+        one per batch, and a deep backlog costs ceil(S/cap) round trips with
+        bounded memory."""
         base = self.scored  # batch.first_index restarts per drain; rebase globally
-        bs = list(self.batches)
-        if not bs:
+        it = iter(self.batches)
+        while True:
+            bs = list(itertools.islice(it, self.max_super_batches))
+            if not bs:
+                break
+            self._score_super_batch(bs, base)
+            # flush per super-batch: indices are monotone so the ordered
+            # flush is preserved and host memory stays bounded by one
+            # super-batch of formatted predictions
             self.out.flush()
-            self.batches.consumer.commit()
-            return 0
+        # offsets commit once per drain, AFTER every polled row was scored:
+        # the consumer cursor runs ahead of the scored rows inside the
+        # batcher's poll/filter buffers, so a mid-drain commit would record
+        # offsets for rows not yet scored and lose them on crash-resume.
+        # A crash mid-drain therefore redoes the drain from the previous
+        # commit (at-least-once), never skips data.
+        self.batches.consumer.commit()
+        return self.scored - base
+
+    def _score_super_batch(self, bs, base: int) -> None:
         xs = np.stack([b.x for b in bs])   # [S, B, ...] (F, or T×F windowed)
         S, B = xs.shape[:2]
         row_shape = xs.shape[2:]
@@ -99,9 +123,6 @@ class StreamScorer:
             obs_metrics.records_scored.inc(b.n_valid)
             if b.n_valid:
                 obs_metrics.reconstruction_mse.set(float(np.mean(err[: b.n_valid])))
-        self.out.flush()
-        self.batches.consumer.commit()
-        return self.scored - n0
 
     def run_forever(self, poll_interval_s: float = 0.2,
                     max_rounds: Optional[int] = None):

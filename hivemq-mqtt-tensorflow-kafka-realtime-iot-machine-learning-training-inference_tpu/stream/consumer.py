@@ -65,6 +65,17 @@ class StreamConsumer:
             specs.append(f"{topic}:{p}:{off if off is not None else fallback_offset}")
         return cls(broker, specs, group=group, **kw)
 
+    def rewind_to_committed(self) -> None:
+        """Reset in-memory cursors to the last committed offsets (or the
+        original start offsets when nothing was committed).  Used when a
+        processing round aborts mid-chunk: `poll` has already advanced the
+        cursors, so without a rewind the failed records would be silently
+        skipped; rewinding retries them next round (at-least-once)."""
+        for i, cur in enumerate(self._cursors):
+            topic, part, _ = cur
+            off = self.broker.committed(self.group, topic, part)
+            cur[2] = off if off is not None else self._start[i]
+
     # --------------------------------------------------------------- read
     def poll(self, max_messages: int = 1024) -> List[Message]:
         """Fetch up to max_messages across cursors (round-robin between

@@ -49,6 +49,10 @@ _TOKEN_RE = re.compile(
     r")"
 )
 
+# value formats the engine can encode/decode; enforced for base-stream DDL
+# and CSAS/CTAS alike so an unsupported format 4xxes at CREATE time
+_SUPPORTED_VALUE_FORMATS = ("JSON", "AVRO", "DELIMITED")
+
 _KSQL_TO_AVRO = {
     "STRING": "string", "VARCHAR": "string",
     "DOUBLE": "double", "FLOAT": "double",
@@ -636,6 +640,23 @@ class SqlAggTask(StreamTask):
                 slot[it.alias] = s / n
 
     def process(self, messages):
+        """Fold a chunk into the aggregate state, transactionally: if
+        anything in the chunk raises, every slot this chunk touched is
+        rolled back before the exception propagates — the engine's
+        rewind-and-retry would otherwise fold the same records into the
+        accumulators again on every retry."""
+        undo: Dict[tuple, Optional[dict]] = {}
+        try:
+            return self._process_chunk(messages, undo)
+        except Exception:
+            for key, prev in undo.items():
+                if prev is None:
+                    self.acc.pop(key, None)
+                else:
+                    self.acc[key] = prev
+            raise
+
+    def _process_chunk(self, messages, undo):
         touched = set()
         for m in messages:
             rec = _decode_record(self.src_meta, self.src_codec, m)
@@ -651,6 +672,8 @@ class SqlAggTask(StreamTask):
             win = ((m.timestamp_ms // self.stmt.window_ms) * self.stmt.window_ms
                    if self.stmt.window_ms else 0)
             key = (str(gval), win)
+            if key not in undo:  # shallow copy: slot values are scalars
+                undo[key] = dict(self.acc[key]) if key in self.acc else None
             self._update(key, rec)
             touched.add(key)
         out = []
@@ -684,9 +707,14 @@ class Query:
         self.sink = sink
         self.sql = sql
         self.task = task
+        self.error: Optional[str] = None  # last pump failure, surfaced in SHOW QUERIES
 
     def describe(self) -> dict:
-        return {"id": self.query_id, "sink": self.sink, "queryString": self.sql}
+        d = {"id": self.query_id, "sink": self.sink, "queryString": self.sql,
+             "state": "ERROR" if self.error else "RUNNING"}
+        if self.error:
+            d["error"] = self.error
+        return d
 
 
 # ------------------------------------------------------------------ engine
@@ -716,10 +744,27 @@ class SqlEngine:
         return results
 
     def pump(self, chunk: int = 4096) -> int:
-        """Advance all persistent queries; returns records emitted."""
+        """Advance all persistent queries; returns records emitted.
+
+        Each query is isolated: one task raising (e.g. an Avro encode type
+        mismatch) marks THAT query errored — surfaced via SHOW QUERIES —
+        and the rest keep pumping, instead of one poisoned query silently
+        starving everything after it in dict order.
+
+        Failure handling is at-least-once: poll() advances the in-memory
+        cursor before process() runs, so on error the cursor is rewound to
+        the committed offsets and the chunk is retried next pump (records
+        emitted before the failure within the round may be re-emitted —
+        KSQL's default delivery guarantee).  The error therefore stays
+        visible in SHOW QUERIES until the chunk actually reprocesses."""
         n = 0
         for q in list(self.queries.values()):
-            n += q.task.process_available(chunk)
+            try:
+                n += q.task.process_available(chunk)
+                q.error = None
+            except Exception as e:  # noqa: BLE001 - per-query fault isolation
+                q.error = f"{type(e).__name__}: {e}"
+                q.task.consumer.rewind_to_committed()
         return n
 
     def table(self, name: str) -> Dict[tuple, dict]:
@@ -815,7 +860,7 @@ class SqlEngine:
             props = self._parse_with(t)
             topic = props.get("KAFKA_TOPIC", name.lower())
             vfmt = props.get("VALUE_FORMAT", "JSON").upper()
-            if vfmt not in ("JSON", "AVRO", "DELIMITED"):
+            if vfmt not in _SUPPORTED_VALUE_FORMATS:
                 raise SqlError(f"unsupported VALUE_FORMAT {vfmt}")
             partitions = int(props.get("PARTITIONS", 1))
             self.broker.create_topic(topic, partitions=partitions)
@@ -837,6 +882,8 @@ class SqlEngine:
             raise SqlError(f"unknown source: {stmt.source}")
         topic = props.get("KAFKA_TOPIC", name)
         vfmt = props.get("VALUE_FORMAT", src.value_format).upper()
+        if vfmt not in _SUPPORTED_VALUE_FORMATS:
+            raise SqlError(f"unsupported VALUE_FORMAT {vfmt}")
         partitions = int(props.get("PARTITIONS",
                                    self.broker.topic(src.topic).partitions))
         self.broker.create_topic(topic, partitions=partitions)

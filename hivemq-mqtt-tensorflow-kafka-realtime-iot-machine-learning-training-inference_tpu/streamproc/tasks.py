@@ -51,7 +51,12 @@ class StreamTask:
         raise NotImplementedError
 
     def process_available(self, chunk: int = 4096) -> int:
-        """Consume and transform everything currently available."""
+        """Consume and transform everything currently available.
+
+        Offsets are committed after EACH successfully processed chunk (not
+        only at end-of-stream), so a failure in a later chunk — with the
+        engine's rewind-to-committed retry — re-emits at most the failed
+        chunk, never the whole backlog."""
         n = 0
         while True:
             msgs = self.consumer.poll(chunk)
@@ -61,6 +66,7 @@ class StreamTask:
             for key, value, ts in self.process(msgs):
                 self.broker.produce(self.dst, value, key=key, timestamp_ms=ts)
                 n += 1
+            self.consumer.commit()
 
 
 class JsonToAvro(StreamTask):

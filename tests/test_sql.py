@@ -365,3 +365,134 @@ def test_parser_fuzz_never_crashes():
     # the engine still works afterwards
     engine.pump()
     assert engine.execute("SHOW STREAMS;")[0]["streams"]
+
+
+def test_csas_rejects_unknown_value_format():
+    """ADVICE r1: an unsupported CSAS/CTAS VALUE_FORMAT must 4xx at CREATE
+    time, not silently write JSON and decode to nothing downstream."""
+    broker = Broker()
+    _produce_fleet(broker, n_cars=1, per_car=1)
+    engine = SqlEngine(broker)
+    engine.execute(
+        "CREATE STREAM S (SPEED DOUBLE, FAILURE_OCCURRED VARCHAR) "
+        "WITH (KAFKA_TOPIC='sensor-data', VALUE_FORMAT='JSON');")
+    with pytest.raises(SqlError, match="VALUE_FORMAT"):
+        engine.execute(
+            "CREATE STREAM S2 WITH (VALUE_FORMAT='PROTOBUF') "
+            "AS SELECT SPEED FROM S;")
+
+
+def test_pump_isolates_poisoned_query():
+    """ADVICE r1: one query whose task raises must not starve the queries
+    after it.  The error is surfaced via SHOW QUERIES, the consumer cursor
+    is rewound so the failed chunk is RETRIED (not silently skipped), and
+    recovery reprocesses every record."""
+    broker = Broker()
+    _produce_fleet(broker, n_cars=2, per_car=3)  # 6 records
+    engine = SqlEngine(broker)
+    engine.execute(
+        "CREATE STREAM S (SPEED DOUBLE, FAILURE_OCCURRED VARCHAR) "
+        "WITH (KAFKA_TOPIC='sensor-data', VALUE_FORMAT='JSON');")
+    engine.execute("CREATE STREAM A AS SELECT SPEED FROM S;")
+    engine.execute("CREATE STREAM B AS SELECT SPEED FROM S;")
+    qa, qb = list(engine.queries.values())
+
+    # poison process() AFTER the poll: the cursor has already advanced when
+    # the failure hits — exactly the lost-chunk scenario
+    real_process = qa.task.process
+
+    def poisoned(messages):
+        raise RuntimeError("avro encode type mismatch")
+
+    qa.task.process = poisoned
+    n = engine.pump()
+    assert n > 0, "healthy query B must still emit"
+    shown = engine.execute("SHOW QUERIES;")[0]["queries"]
+    states = {q["id"]: q for q in shown}
+    assert states[qa.query_id]["state"] == "ERROR"
+    assert "avro encode type mismatch" in states[qa.query_id]["error"]
+    assert states[qb.query_id]["state"] == "RUNNING"
+
+    # the error stays visible across pumps while the chunk keeps failing
+    # (an empty successful poll must NOT clear it, because the cursor was
+    # rewound and the same records keep being retried)
+    engine.pump()
+    shown = engine.execute("SHOW QUERIES;")[0]["queries"]
+    assert {q["id"]: q for q in shown}[qa.query_id]["state"] == "ERROR"
+
+    # recovery: the task stops raising -> the rewound chunk reprocesses,
+    # nothing was lost, and the error clears
+    qa.task.process = real_process
+    engine.pump()
+    shown = engine.execute("SHOW QUERIES;")[0]["queries"]
+    assert all(q["state"] == "RUNNING" for q in shown)
+    a_out = []
+    for part in range(broker.topic("A").partitions):
+        a_out.extend(broker.fetch("A", part, 0, 100))
+    assert len(a_out) == 6, "all records recovered after the poisoned rounds"
+
+
+def test_ctas_aggregate_state_rolls_back_on_poisoned_chunk():
+    """Rewind-and-retry must not double-count: a CTAS chunk that fails
+    after folding records into the accumulators rolls its state back, so
+    retries are idempotent and the final COUNT is exact."""
+    broker = Broker()
+    _produce_fleet(broker, n_cars=2, per_car=3)  # 6 records
+    engine = SqlEngine(broker)
+    engine.execute(
+        "CREATE STREAM S (CAR VARCHAR, SPEED DOUBLE) "
+        "WITH (KAFKA_TOPIC='sensor-data', VALUE_FORMAT='JSON', KEY='CAR');")
+    engine.execute(
+        "CREATE TABLE T AS SELECT ROWKEY AS CAR, COUNT(*) AS N "
+        "FROM S GROUP BY ROWKEY;")
+    (q,) = engine.queries.values()
+
+    # raise while BUILDING output rows — after _update mutated the slots
+    real = q.task._changelog_row
+    q.task._changelog_row = lambda slot, row: (_ for _ in ()).throw(
+        RuntimeError("encode failure"))
+    engine.pump()
+    engine.pump()  # retry fails again; state must not accumulate
+    assert q.error and "encode failure" in q.error
+
+    q.task._changelog_row = real
+    engine.pump()
+    table = engine.table("T")
+    counts = {k[0]: v["N"] for k, v in table.items()}
+    assert counts == {"car0": 3, "car1": 3}, \
+        f"retries must not double-count, got {counts}"
+
+
+def test_poisoned_later_chunk_does_not_reemit_earlier_chunks():
+    """Per-chunk offset commits bound retry re-emission to the failed
+    chunk: a healthy first chunk is emitted once, not once per pump."""
+    broker = Broker()
+    broker.create_topic("t", partitions=1)
+    for i in range(8):
+        broker.produce("t", json.dumps({"V": float(i)}).encode(), key=b"k")
+    engine = SqlEngine(broker)
+    engine.execute("CREATE STREAM S (V DOUBLE) "
+                   "WITH (KAFKA_TOPIC='t', VALUE_FORMAT='JSON');")
+    engine.execute("CREATE STREAM OUT AS SELECT V FROM S;")
+    (q,) = engine.queries.values()
+
+    real_process = q.task.process
+
+    def poison_high(messages):
+        rows = real_process(messages)
+        if any(json.loads(v)["V"] >= 4.0 for _, v, _ in rows):
+            raise RuntimeError("poison in chunk 2")
+        return rows
+
+    q.task.process = poison_high
+    engine.pump(chunk=4)   # chunk 1 (V 0-3) emits + commits; chunk 2 raises
+    n_after_first = broker.end_offset("OUT", 0)
+    assert n_after_first == 4
+    engine.pump(chunk=4)   # retries ONLY chunk 2; chunk 1 must not re-emit
+    engine.pump(chunk=4)
+    assert broker.end_offset("OUT", 0) == 4, "earlier chunk re-emitted"
+
+    q.task.process = real_process
+    engine.pump(chunk=4)
+    assert broker.end_offset("OUT", 0) == 8
+    assert q.error is None

@@ -100,3 +100,23 @@ def test_lstm_supervised_training_runs():
     hist = trainer.fit(batches, epochs=2)
     assert len(hist["loss"]) == 2
     assert np.isfinite(hist["loss"]).all()
+
+
+def test_scorer_deep_backlog_bounded_super_batches():
+    """ADVICE r1: a drain deeper than max_super_batches proceeds in bounded
+    chunks — every row still scored exactly once, ordering preserved."""
+    broker, _ = build_world(num_cars=40, ticks=10)
+    consumer = StreamConsumer(broker, ["SENSOR_DATA_S_AVRO:0:0"])
+    trainer = Trainer(CAR_AUTOENCODER)
+    trainer.fit(SensorBatches(consumer, batch_size=50, only_normal=True), epochs=1)
+
+    consumer2 = StreamConsumer(broker, ["SENSOR_DATA_S_AVRO:0:0"])
+    pred_batches = SensorBatches(consumer2, batch_size=50)
+    out = OutputSequence(broker, "model-predictions", partition=0)
+    scorer = StreamScorer(CAR_AUTOENCODER, trainer.state.params, pred_batches, out)
+    scorer.max_super_batches = 2  # 400 rows / 50 per batch = 8 batches -> 4 chunks
+    n = scorer.score_available()
+    assert n == 400
+    msgs = broker.fetch("model-predictions", 0, 0, 1000)
+    assert len(msgs) == 400
+    assert all(m.value.startswith(b"[") for m in msgs)
