@@ -6,4 +6,5 @@ from .bridge import KafkaBridge, TopicMapping  # noqa: F401
 from .scenario import (EVALUATION_SCENARIO, Scenario, ScenarioRunner,  # noqa: F401
                        parse_scenario)
 from .topic_tree import TopicTree, topic_matches  # noqa: F401
-from .wire import MqttClient, MqttServer  # noqa: F401
+from .wire import MqttClient, MqttProtocol, MqttServer  # noqa: F401
+from .eventserver import MqttEventServer  # noqa: F401

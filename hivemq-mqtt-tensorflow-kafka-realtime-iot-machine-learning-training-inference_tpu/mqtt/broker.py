@@ -205,6 +205,10 @@ class MqttBroker:
     def session_count(self) -> int:
         return len(self._sessions)
 
+    def session_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._sessions)
+
     # ----------------------------------------------------- subscriptions
     def subscribe(self, client_id: str, filter_: str, qos: int = 0) -> int:
         """Returns granted qos (0/1 supported; 2 downgraded to 1 — the

@@ -1,0 +1,334 @@
+"""Fleet-scale MQTT listener: one epoll loop, thousands of connections.
+
+The reference fronts its 100,000-car fleet with a 5-node HiveMQ cluster
+(reference `infrastructure/hivemq/hivemq-crd.yaml:10-18`: 5 nodes × 4 CPU ×
+10G heap; reference `infrastructure/test-generator/scenario.xml:13-14`:
+100k clients at ~10k msgs/s fleet-wide).  A thread-per-connection front
+(`wire.MqttServer`) cannot hold that many sockets in Python; this listener
+is the scale path: non-blocking sockets multiplexed by one
+`selectors.DefaultSelector` (epoll on Linux) event loop, per-connection
+input/output buffers, and the same `MqttProtocol` state machine the
+threaded front drives — so both transports stay protocol-identical by
+construction.
+
+Flow-control stance (HiveMQ's "overload protection" analogue, charted by
+its Grafana credit-system panels): a consumer connection whose output
+buffer exceeds `max_outbuf` is disconnected (slow-consumer eviction —
+the broker must never buffer unboundedly for one stalled socket), and
+publishers are throttled by aggregate output pressure: when the total
+bytes buffered for delivery exceed `high_watermark`, the listener stops
+READING from the connections that are feeding it, pushing backpressure
+into the publishers' TCP windows — the same stop-reading mechanism
+HiveMQ's credit system uses — and resumes them once the backlog drains
+below `low_watermark`.
+
+Delivery threading: broker fan-out calls `MqttProtocol.deliver` on the
+*publisher's* thread.  For wire-to-wire traffic that is the event-loop
+thread itself; for in-process publishers (e.g. platform components) it is
+a foreign thread.  `_send_to` is therefore thread-safe: it appends to the
+connection's locked output buffer, marks the connection write-pending, and
+wakes the loop through a socketpair.  Only the loop thread touches the
+selector, so no cross-thread selector mutation ever happens.
+"""
+
+from __future__ import annotations
+
+import selectors
+import socket
+import struct
+import threading
+from typing import Dict, Optional
+
+from .broker import MqttBroker
+from .wire import MqttProtocol, parse_frame
+
+
+class _EConn:
+    """Per-socket state owned by the event loop."""
+
+    __slots__ = ("sock", "proto", "inbuf", "outbuf", "lock", "closing",
+                 "paused")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.proto: Optional[MqttProtocol] = None
+        self.inbuf = bytearray()
+        self.outbuf = bytearray()
+        self.lock = threading.Lock()
+        self.closing = False
+        self.paused = False  # reads suspended (publisher backpressure)
+
+
+class MqttEventServer:
+    """Selector-based TCP front for MqttBroker (the 10k-connection path).
+
+    Same context-manager surface as `wire.MqttServer`:
+    `with MqttEventServer(broker) as s:` serves on `s.port` until exit.
+
+    Args:
+      max_outbuf: slow-consumer eviction threshold (bytes buffered for one
+        connection before it is dropped).
+      high_watermark / low_watermark: aggregate delivery-backlog bounds for
+        publisher backpressure (reads suspended above high, resumed below
+        low).
+    """
+
+    def __init__(self, broker: MqttBroker, host: str = "127.0.0.1",
+                 port: int = 0, max_outbuf: int = 4 << 20,
+                 high_watermark: int = 16 << 20,
+                 low_watermark: int = 4 << 20):
+        self.broker = broker
+        self.max_outbuf = max_outbuf
+        self.high_watermark = high_watermark
+        self.low_watermark = low_watermark
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, port))
+        self._lsock.listen(1024)
+        self._lsock.setblocking(False)
+        self.host, self.port = self._lsock.getsockname()[:2]
+        self._sel = selectors.DefaultSelector()
+        self._conns: Dict[socket.socket, _EConn] = {}
+        # cross-thread write wake-up: foreign threads add to _pending and
+        # poke the socketpair; the loop drains both
+        self._pending: set = set()
+        self._pending_lock = threading.Lock()
+        # aggregate bytes queued for delivery across all connections — the
+        # quantity the publisher-backpressure watermarks act on
+        self._total_out = 0
+        self._out_lock = threading.Lock()
+        self._paused_conns: set = set()  # loop-thread only
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+    # --------------------------------------------------------- lifecycle
+    def start(self) -> "MqttEventServer":
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"mqtt-evloop-{self.port}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        self._wake()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        for conn in list(self._conns.values()):
+            self._close(conn)
+        try:
+            self._lsock.close()
+        finally:
+            self._sel.close()
+            self._wake_r.close()
+            self._wake_w.close()
+
+    def __enter__(self) -> "MqttEventServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    @property
+    def connection_count(self) -> int:
+        return len(self._conns)
+
+    # --------------------------------------------------------- internals
+    def _wake(self) -> None:
+        try:
+            self._wake_w.send(b"\x00")
+        except OSError:
+            pass
+
+    def _send_to(self, conn: _EConn, data: bytes) -> None:
+        """Thread-safe outbound enqueue (MqttProtocol's send)."""
+        with conn.lock:
+            if conn.closing:
+                raise OSError("connection closing")
+            conn.outbuf += data
+            over = len(conn.outbuf) > self.max_outbuf
+        with self._out_lock:
+            self._total_out += len(data)
+        with self._pending_lock:
+            self._pending.add(conn)
+        if over:
+            # slow-consumer eviction: mark and let the loop tear it down
+            conn.closing = True
+        if threading.current_thread() is not self._thread:
+            self._wake()
+
+    def _loop(self) -> None:
+        self._sel.register(self._lsock, selectors.EVENT_READ, "accept")
+        self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        while self._running:
+            events = self._sel.select(timeout=0.1)
+            for key, mask in events:
+                tag = key.data
+                if tag == "accept":
+                    self._accept()
+                elif tag == "wake":
+                    try:
+                        while self._wake_r.recv(4096):
+                            pass
+                    except (BlockingIOError, OSError):
+                        pass
+                else:
+                    conn = tag
+                    if mask & selectors.EVENT_READ:
+                        self._readable(conn)
+                    if mask & selectors.EVENT_WRITE:
+                        self._flush(conn)
+            # drain cross-thread sends
+            with self._pending_lock:
+                pending, self._pending = self._pending, set()
+            for conn in pending:
+                if conn.sock in self._conns:
+                    self._flush(conn)
+            # backpressure release: resume paused publishers once the
+            # aggregate delivery backlog has drained below the low mark
+            if self._paused_conns:
+                with self._out_lock:
+                    below = self._total_out < self.low_watermark
+                if below:
+                    for conn in list(self._paused_conns):
+                        conn.paused = False
+                        if conn.sock in self._conns:
+                            self._rearm(conn)
+                    self._paused_conns.clear()
+
+    def _accept(self) -> None:
+        while True:
+            try:
+                sock, _addr = self._lsock.accept()
+            except (BlockingIOError, OSError):
+                return
+            sock.setblocking(False)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = _EConn(sock)
+            conn.proto = MqttProtocol(
+                self.broker, lambda data, c=conn: self._send_to(c, data))
+            self._conns[sock] = conn
+            self._sel.register(sock, selectors.EVENT_READ, conn)
+
+    def _events_for(self, conn: _EConn) -> int:
+        ev = 0
+        if not conn.paused:
+            ev |= selectors.EVENT_READ
+        with conn.lock:
+            if conn.outbuf:
+                ev |= selectors.EVENT_WRITE
+        return ev
+
+    def _rearm(self, conn: _EConn) -> None:
+        ev = self._events_for(conn)
+        try:
+            if ev:
+                self._sel.modify(conn.sock, ev, conn)
+            else:
+                # nothing to do right now; keep registered for reads so the
+                # socket's close is still observed
+                self._sel.modify(conn.sock, selectors.EVENT_READ, conn)
+        except (KeyError, ValueError, OSError):
+            pass
+
+    def _readable(self, conn: _EConn) -> None:
+        try:
+            data = conn.sock.recv(1 << 16)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._close(conn)
+            return
+        if not data:
+            self._close(conn)
+            return
+        conn.inbuf += data
+        pos = 0
+        try:
+            while True:
+                frame = parse_frame(conn.inbuf, pos)
+                if frame is None:
+                    break
+                ptype, flags, body, pos = frame
+                if not conn.proto.handle_packet(ptype, flags, body):
+                    self._close(conn)
+                    return
+        except (ValueError, struct.error, IndexError, OSError):
+            # protocol violation (malformed varint, truncated body →
+            # IndexError from short reads) → drop the connection (MQTT
+            # semantics, same stance as the threaded front).  Only THIS
+            # connection dies; the loop serves everyone else on.
+            self._close(conn)
+            return
+        if pos:
+            del conn.inbuf[:pos]
+        if conn.closing:
+            self._close(conn)
+            return
+        # publisher backpressure: this connection just fed us input; if the
+        # aggregate delivery backlog is over the high mark, stop reading it
+        # (its TCP window fills → the client blocks) until the drain below
+        # the low mark resumes it
+        with self._out_lock:
+            over = self._total_out > self.high_watermark
+        if over:
+            conn.paused = True
+            self._paused_conns.add(conn)
+        self._rearm(conn)
+
+    def _flush(self, conn: _EConn) -> None:
+        if conn.closing:
+            # eviction (outbuf cap exceeded): the peer is not draining, so
+            # waiting for the buffer to empty would keep it alive forever —
+            # drop the connection and its buffered output now
+            self._close(conn)
+            return
+        try:
+            with conn.lock:
+                if conn.outbuf:
+                    n = conn.sock.send(conn.outbuf)
+                    del conn.outbuf[:n]
+                else:
+                    n = 0
+            if n:
+                with self._out_lock:
+                    self._total_out -= n
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            self._close(conn)
+            return
+        self._rearm(conn)
+
+    def _close(self, conn: _EConn, evicted: bool = False) -> None:
+        closing_was = conn.closing
+        conn.closing = True
+        self._paused_conns.discard(conn)
+        with conn.lock:
+            leftover = bytes(conn.outbuf)
+            conn.outbuf.clear()
+        if leftover:
+            with self._out_lock:
+                self._total_out -= len(leftover)
+            if not (evicted or closing_was):
+                # graceful close (protocol reject / DISCONNECT): give the
+                # final packets — e.g. the spec-mandated CONNACK rejection
+                # code — one best-effort non-blocking send before the FIN,
+                # matching the threaded front's synchronous send
+                try:
+                    conn.sock.send(leftover)
+                except OSError:
+                    pass
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        self._conns.pop(conn.sock, None)
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        if conn.proto is not None:
+            conn.proto.teardown()

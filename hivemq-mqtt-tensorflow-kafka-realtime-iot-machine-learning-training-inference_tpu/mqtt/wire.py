@@ -115,11 +115,164 @@ def subscribe_packet(packet_id: int, filters: List[Tuple[str, int]],
 from ..utils.net import recv_exact as _recv_exact
 
 
+def parse_frame(buf, pos: int):
+    """Parse one MQTT frame out of buf[pos:].
+
+    Returns (ptype, flags, body, next_pos), or None while the frame is
+    still incomplete.  Raises ValueError on a malformed remaining-length.
+    This is the zero-copy-ish framing step both transports share: the
+    blocking server reads exact counts, the event server feeds recv()
+    chunks through this."""
+    n = len(buf)
+    if n - pos < 2:
+        return None
+    h = buf[pos]
+    mult, length, i = 1, 0, pos + 1
+    for _ in range(4):
+        if i >= n:
+            return None
+        b = buf[i]
+        i += 1
+        length += (b & 0x7F) * mult
+        if not b & 0x80:
+            break
+        mult *= 128
+    else:
+        raise ValueError("malformed remaining-length")
+    if n - i < length:
+        return None
+    return h >> 4, h & 0x0F, bytes(buf[i:i + length]), i + length
+
+
+class MqttProtocol:
+    """Transport-independent per-connection MQTT state machine.
+
+    Both TCP fronts (`MqttServer`, thread-per-connection, and
+    `MqttEventServer`, epoll loop) drive this one class: they feed it
+    decoded frames via `handle_packet` and give it a `send(bytes)` to
+    answer on.  Broker fan-out arrives through `deliver` (registered as
+    the session's delivery callback), which may run on any thread — the
+    supplied `send` must be thread-safe."""
+
+    def __init__(self, broker: MqttBroker, send: Callable[[bytes], None]):
+        self.broker = broker
+        self._send = send
+        self.level = 4
+        self.client_id: Optional[str] = None
+        self.session = None
+        self._next_pid = 0
+        self._pid_lock = threading.Lock()
+
+    # ------------------------------------------------------ broker fan-out
+    def deliver(self, topic: str, payload: bytes, qos: int, retain: bool):
+        pid = 0
+        if qos > 0:
+            with self._pid_lock:
+                self._next_pid = self._next_pid % 65535 + 1
+                pid = self._next_pid
+        try:
+            self._send(publish_packet(topic, payload, qos, retain, pid,
+                                      protocol_level=self.level))
+        except OSError:
+            pass  # connection torn down mid-fanout; session cleanup follows
+
+    # ------------------------------------------------------ inbound frames
+    def handle_packet(self, ptype: int, flags: int, body: bytes) -> bool:
+        """Process one frame; returns False when the connection must close.
+
+        Raises ValueError/struct.error on protocol violations (wildcard
+        PUBLISH topic, short body) — MQTT says drop the connection."""
+        broker = self.broker
+        if ptype == CONNECT:
+            _name, pos = _read_str(body, 0)
+            self.level = body[pos]
+            clean = bool(body[pos + 1] & 0x02)
+            pos += 4  # level + flags + keepalive
+            if self.level >= 5:
+                pos = _skip_props(body, pos)
+            client_id, pos = _read_str(body, pos)
+            if not client_id and not clean:
+                # §3.1.3-8: a zero-byte client id REQUIRES a clean
+                # session — a synthesized persistent id could never
+                # be resumed, only leak offline queue state.
+                # v5: reason 0x85 (client id not valid) + empty
+                # properties; v4: return code 0x02
+                reject = (b"\x00\x85\x00" if self.level >= 5
+                          else b"\x00\x02")
+                self._send(packet(CONNACK, 0, reject))
+                return False
+            self.client_id = client_id or f"anon-{id(self):x}"
+            self.session = broker.connect(self.client_id, self.deliver, clean)
+            # byte 1 bit 0 = session-present (MQTT 3.1.1 §3.2.2.2):
+            # a resumed persistent session must say so, or spec
+            # clients discard their subscription state
+            sp = b"\x01" if self.session.resumed else b"\x00"
+            ack = sp + (b"\x00\x00" if self.level >= 5 else b"\x00")
+            self._send(packet(CONNACK, 0, ack))
+            # only after CONNACK is on the wire may queued offline
+            # PUBLISHes flow (a pre-CONNACK PUBLISH breaks clients)
+            broker.deliver_pending(self.session)
+        elif ptype == PUBLISH:
+            qos = (flags >> 1) & 0x03
+            retain = bool(flags & 0x01)
+            topic, pos = _read_str(body, 0)
+            pid = 0
+            if qos > 0:
+                (pid,) = struct.unpack_from(">H", body, pos)
+                pos += 2
+            if self.level >= 5:
+                pos = _skip_props(body, pos)
+            broker.publish(topic, body[pos:], qos, retain)
+            if qos == 1:
+                self._send(packet(PUBACK, 0, struct.pack(">H", pid)))
+        elif ptype == SUBSCRIBE:
+            (pid,) = struct.unpack_from(">H", body, 0)
+            pos = 2
+            if self.level >= 5:
+                pos = _skip_props(body, pos)
+            codes = bytearray()
+            while pos < len(body):
+                f, pos = _read_str(body, pos)
+                qos = body[pos] & 0x03
+                pos += 1
+                try:
+                    codes.append(broker.subscribe(self.client_id, f, qos))
+                except ValueError:
+                    codes.append(0x80)  # per-filter failure code
+            self._send(packet(SUBACK, 0,
+                              struct.pack(">H", pid) +
+                              (b"\x00" if self.level >= 5 else b"") +
+                              bytes(codes)))
+        elif ptype == UNSUBSCRIBE:
+            (pid,) = struct.unpack_from(">H", body, 0)
+            pos = 2
+            if self.level >= 5:
+                pos = _skip_props(body, pos)
+            while pos < len(body):
+                f, pos = _read_str(body, pos)
+                broker.unsubscribe(self.client_id, f)
+            self._send(packet(UNSUBACK, 0, struct.pack(">H", pid)))
+        elif ptype == PINGREQ:
+            self._send(packet(PINGRESP, 0, b""))
+        elif ptype == PUBACK:
+            pass  # client acks for our qos1 deliveries
+        elif ptype == DISCONNECT:
+            return False
+        return True
+
+    def teardown(self):
+        if self.client_id is not None:
+            # identity-checked: a session taken over by a newer
+            # connection with this client id survives our teardown
+            self.broker.disconnect(self.client_id, self.session)
+
+
 # ------------------------------------------------------------------ server
 class _Conn(socketserver.BaseRequestHandler):
-    """One MQTT connection.  The handler loop reads packets and mutates the
-    shared MqttBroker; outbound publishes are serialized by a per-connection
-    write lock (broker fan-out may run on other publishers' threads)."""
+    """One MQTT connection on the thread-per-connection front.  The handler
+    loop reads packets and drives the shared MqttProtocol; outbound
+    publishes are serialized by a per-connection write lock (broker fan-out
+    may run on other publishers' threads)."""
 
     def _read_exact(self, n: int) -> bytes:
         return _recv_exact(self.request, n)
@@ -128,118 +281,28 @@ class _Conn(socketserver.BaseRequestHandler):
         with self._wlock:
             self.request.sendall(data)
 
-    def _deliver(self, topic: str, payload: bytes, qos: int, retain: bool):
-        pid = 0
-        if qos > 0:
-            with self._wlock:
-                self._next_pid = self._next_pid % 65535 + 1
-                pid = self._next_pid
-        try:
-            self._send(publish_packet(topic, payload, qos, retain, pid,
-                                      protocol_level=self._level))
-        except OSError:
-            pass  # connection torn down mid-fanout; session cleanup follows
-
     def handle(self):
         broker: MqttBroker = self.server.broker  # type: ignore[attr-defined]
         self._wlock = threading.Lock()
-        self._next_pid = 0
-        self._level = 4
-        client_id = None
-        session = None
+        proto = MqttProtocol(broker, self._send)
         try:
             while True:
                 (h,) = self._read_exact(1)
                 ptype, flags = h >> 4, h & 0x0F
                 length = decode_varlen(self._read_exact)
                 body = self._read_exact(length) if length else b""
-                if ptype == CONNECT:
-                    _name, pos = _read_str(body, 0)
-                    self._level = body[pos]
-                    clean = bool(body[pos + 1] & 0x02)
-                    pos += 4  # level + flags + keepalive
-                    if self._level >= 5:
-                        pos = _skip_props(body, pos)
-                    client_id, pos = _read_str(body, pos)
-                    if not client_id and not clean:
-                        # §3.1.3-8: a zero-byte client id REQUIRES a clean
-                        # session — a synthesized persistent id could never
-                        # be resumed, only leak offline queue state.
-                        # v5: reason 0x85 (client id not valid) + empty
-                        # properties; v4: return code 0x02
-                        reject = (b"\x00\x85\x00" if self._level >= 5
-                                  else b"\x00\x02")
-                        self._send(packet(CONNACK, 0, reject))
-                        return
-                    client_id = client_id or f"anon-{id(self):x}"
-                    session = broker.connect(client_id, self._deliver, clean)
-                    # byte 1 bit 0 = session-present (MQTT 3.1.1 §3.2.2.2):
-                    # a resumed persistent session must say so, or spec
-                    # clients discard their subscription state
-                    sp = b"\x01" if session.resumed else b"\x00"
-                    ack = sp + (b"\x00\x00" if self._level >= 5 else b"\x00")
-                    self._send(packet(CONNACK, 0, ack))
-                    # only after CONNACK is on the wire may queued offline
-                    # PUBLISHes flow (a pre-CONNACK PUBLISH breaks clients)
-                    broker.deliver_pending(session)
-                elif ptype == PUBLISH:
-                    qos = (flags >> 1) & 0x03
-                    retain = bool(flags & 0x01)
-                    topic, pos = _read_str(body, 0)
-                    pid = 0
-                    if qos > 0:
-                        (pid,) = struct.unpack_from(">H", body, pos)
-                        pos += 2
-                    if self._level >= 5:
-                        pos = _skip_props(body, pos)
-                    broker.publish(topic, body[pos:], qos, retain)
-                    if qos == 1:
-                        self._send(packet(PUBACK, 0, struct.pack(">H", pid)))
-                elif ptype == SUBSCRIBE:
-                    (pid,) = struct.unpack_from(">H", body, 0)
-                    pos = 2
-                    if self._level >= 5:
-                        pos = _skip_props(body, pos)
-                    codes = bytearray()
-                    while pos < len(body):
-                        f, pos = _read_str(body, pos)
-                        qos = body[pos] & 0x03
-                        pos += 1
-                        try:
-                            codes.append(broker.subscribe(client_id, f, qos))
-                        except ValueError:
-                            codes.append(0x80)  # per-filter failure code
-                    self._send(packet(SUBACK, 0,
-                                      struct.pack(">H", pid) +
-                                      (b"\x00" if self._level >= 5 else b"") +
-                                      bytes(codes)))
-                elif ptype == UNSUBSCRIBE:
-                    (pid,) = struct.unpack_from(">H", body, 0)
-                    pos = 2
-                    if self._level >= 5:
-                        pos = _skip_props(body, pos)
-                    while pos < len(body):
-                        f, pos = _read_str(body, pos)
-                        broker.unsubscribe(client_id, f)
-                    self._send(packet(UNSUBACK, 0, struct.pack(">H", pid)))
-                elif ptype == PINGREQ:
-                    self._send(packet(PINGRESP, 0, b""))
-                elif ptype == PUBACK:
-                    pass  # client acks for our qos1 deliveries
-                elif ptype == DISCONNECT:
+                if not proto.handle_packet(ptype, flags, body):
                     break
         except (ConnectionError, OSError):
             pass
-        except (ValueError, struct.error):
+        except (ValueError, struct.error, IndexError):
             # protocol violation (wildcard PUBLISH topic, malformed
-            # varint/short body): MQTT says drop the connection — without
-            # letting socketserver dump a traceback per bad client
+            # varint/short body — truncated bodies surface as IndexError):
+            # MQTT says drop the connection — without letting socketserver
+            # dump a traceback per bad client
             pass
         finally:
-            if client_id is not None:
-                # identity-checked: a session taken over by a newer
-                # connection with this client id survives our teardown
-                broker.disconnect(client_id, session)
+            proto.teardown()
 
 
 class MqttServer(socketserver.ThreadingTCPServer):

@@ -1,0 +1,222 @@
+"""Epoll MQTT listener (`MqttEventServer`) — protocol parity with the
+threaded front, fleet-scale connection counts, and slow-consumer eviction.
+
+The reference holds 100k MQTT clients on a 5-node HiveMQ cluster
+(hivemq-crd.yaml:10-18, scenario.xml:13-14); this is the single-process
+scale path standing in for that cluster."""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from iotml.mqtt.broker import MqttBroker
+from iotml.mqtt.bridge import KafkaBridge
+from iotml.mqtt.eventserver import MqttEventServer
+from iotml.mqtt.wire import (CONNACK, MqttClient, connect_packet,
+                             publish_packet)
+from iotml.stream.broker import Broker
+
+
+def test_connect_pub_sub_roundtrip():
+    broker = MqttBroker()
+    with MqttEventServer(broker) as srv:
+        got = []
+        ev = threading.Event()
+
+        def on_msg(topic, payload):
+            got.append((topic, payload))
+            ev.set()
+
+        sub = MqttClient("127.0.0.1", srv.port, "sub-1", on_message=on_msg)
+        sub.subscribe("vehicles/#", qos=0)
+        pub = MqttClient("127.0.0.1", srv.port, "pub-1")
+        pub.publish("vehicles/sensor/data/car-1", b"hello", qos=0)
+        assert ev.wait(5)
+        assert got == [("vehicles/sensor/data/car-1", b"hello")]
+        pub.disconnect()
+        sub.disconnect()
+
+
+def test_qos1_puback_over_event_loop():
+    broker = MqttBroker()
+    with MqttEventServer(broker) as srv:
+        c = MqttClient("127.0.0.1", srv.port, "q1")
+        # publish() blocks on PUBACK; returning proves the ack round-trip
+        c.publish("t/a", b"x", qos=1)
+        c.disconnect()
+
+
+def test_bridge_to_kafka_over_event_server():
+    mqtt_broker = MqttBroker()
+    stream = Broker()
+    bridge = KafkaBridge(mqtt_broker, stream, partitions=2)
+    with MqttEventServer(mqtt_broker) as srv:
+        c = MqttClient("127.0.0.1", srv.port, "car-7")
+        for i in range(10):
+            c.publish(f"vehicles/sensor/data/car-7", json.dumps(
+                {"seq": i}).encode(), qos=1)
+        c.disconnect()
+    assert bridge.forwarded() == 10
+    total = sum(stream.end_offset("sensor-data", p) for p in range(2))
+    assert total == 10
+
+
+def _raw_publisher(port, client_id, n_msgs, topic, payload, barrier):
+    """Minimal raw-socket qos0 publisher (no reader thread — the shape a
+    10k-client fleet bench uses)."""
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    s.sendall(connect_packet(client_id))
+    # read CONNACK (4 bytes: header, len=2, body)
+    buf = b""
+    while len(buf) < 4:
+        buf += s.recv(4 - len(buf))
+    assert buf[0] >> 4 == CONNACK
+    barrier.wait()
+    pkt = publish_packet(topic, payload, qos=0)
+    for _ in range(n_msgs):
+        s.sendall(pkt)
+    return s
+
+
+def test_many_connections_fanin():
+    """Hundreds of concurrent sockets on one event loop, all bridged."""
+    n_conns, per_conn = 200, 20
+    mqtt_broker = MqttBroker()
+    stream = Broker()
+    bridge = KafkaBridge(mqtt_broker, stream, partitions=4)
+    with MqttEventServer(mqtt_broker) as srv:
+        barrier = threading.Barrier(n_conns)
+        socks, threads, errors = [], [], []
+
+        def run(i):
+            try:
+                socks.append(_raw_publisher(
+                    srv.port, f"car-{i:05d}", per_conn,
+                    f"vehicles/sensor/data/car-{i:05d}", b"{}", barrier))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        for i in range(n_conns):
+            t = threading.Thread(target=run, args=(i,))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors
+        deadline = time.time() + 30
+        want = n_conns * per_conn
+        while bridge.forwarded() < want and time.time() < deadline:
+            time.sleep(0.05)
+        assert bridge.forwarded() == want
+        assert srv.connection_count == n_conns
+        for s in socks:
+            s.close()
+
+
+def test_slow_consumer_evicted():
+    """A subscriber that never reads gets its outbuf capped: the broker
+    drops it instead of buffering unboundedly (HiveMQ overload-protection
+    stance)."""
+    mqtt_broker = MqttBroker()
+    with MqttEventServer(mqtt_broker, max_outbuf=64 * 1024) as srv:
+        # raw subscriber that never reads after SUBACK
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+        s.sendall(connect_packet("sleepy"))
+        buf = b""
+        while len(buf) < 4:
+            buf += s.recv(4 - len(buf))
+        from iotml.mqtt.wire import subscribe_packet
+        s.sendall(subscribe_packet(1, [("flood/#", 0)]))
+        time.sleep(0.2)  # allow SUBACK processing
+        # tiny kernel buffers so the 64 KiB cap is reachable quickly
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+
+        pub = MqttClient("127.0.0.1", srv.port, "firehose")
+        payload = b"z" * 8192
+        for i in range(200):  # ~1.6 MB >> 64 KiB cap
+            pub.publish("flood/x", payload, qos=0)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if "sleepy" not in mqtt_broker.session_ids():
+                break
+            time.sleep(0.05)
+        assert "sleepy" not in mqtt_broker.session_ids(), \
+            "stalled subscriber should have been evicted"
+        # the broker itself is still healthy for other clients
+        pub.ping()
+        pub.disconnect()
+        s.close()
+
+
+def test_malformed_packet_kills_only_that_connection():
+    """A truncated CONNECT body (IndexError territory) must drop that one
+    client, not the event loop serving everyone else."""
+    from iotml.mqtt.wire import packet as mk_packet
+
+    broker = MqttBroker()
+    with MqttEventServer(broker) as srv:
+        bad = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        # CONNECT with body ending right after the protocol name
+        bad.sendall(mk_packet(1, 0, b"\x00\x04MQTT"))
+        # server should close us
+        bad.settimeout(5)
+        assert bad.recv(16) == b""
+        bad.close()
+        # the loop is still alive: a healthy client works end-to-end
+        c = MqttClient("127.0.0.1", srv.port, "healthy")
+        c.publish("t/x", b"ok", qos=1)
+        c.ping()
+        c.disconnect()
+
+
+def test_rejected_connect_gets_connack_before_close():
+    """Zero-byte client id with clean-session=0 must receive the CONNACK
+    return code 0x02 before the FIN (spec §3.1.3-8), matching the threaded
+    front."""
+    s = socket.create_connection
+    broker = MqttBroker()
+    with MqttEventServer(broker) as srv:
+        sock = s(("127.0.0.1", srv.port), timeout=5)
+        sock.sendall(connect_packet("", clean=False))
+        sock.settimeout(5)
+        buf = b""
+        while len(buf) < 4:
+            chunk = sock.recv(4 - len(buf))
+            if not chunk:
+                break
+            buf += chunk
+        assert len(buf) == 4, "no CONNACK before close"
+        assert buf[0] >> 4 == CONNACK
+        assert buf[3] == 0x02  # v4 'identifier rejected'
+        sock.close()
+
+
+def test_publisher_backpressure_pause_resume():
+    """Aggregate delivery backlog over the high watermark suspends reads
+    from the feeding publisher (TCP backpressure); draining below the low
+    watermark resumes it and every message still arrives exactly once."""
+    broker = MqttBroker()
+    received = []
+    done = threading.Event()
+    N, payload = 300, b"z" * 4096
+    with MqttEventServer(broker, max_outbuf=64 << 20,
+                         high_watermark=128 * 1024,
+                         low_watermark=32 * 1024) as srv:
+        def on_msg(topic, data):
+            received.append(data)
+            time.sleep(0.002)  # slow-ish consumer to build server backlog
+            if len(received) >= N:
+                done.set()
+
+        sub = MqttClient("127.0.0.1", srv.port, "sub", on_message=on_msg)
+        sub.subscribe("flood/#", qos=0)
+        pub = MqttClient("127.0.0.1", srv.port, "pub")
+        for _ in range(N):
+            pub.publish("flood/x", payload, qos=0)
+        assert done.wait(60), f"only {len(received)}/{N} delivered"
+        assert len(received) == N
+        pub.disconnect()
+        sub.disconnect()
