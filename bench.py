@@ -235,9 +235,10 @@ def _fleet_worker(port, conn_ids, payload, stop, counts, idx, barrier):
 
 def bench_fleet_ingest():
     """The 100k-car scenario shape at reduced scale: N real TCP
-    connections (default 10,000) publishing Avro-sized qos-0 payloads into
-    the epoll MQTT listener, bridged to the Kafka topic — counting only
-    messages that arrived in the stream broker (L1→L2→L3 complete)."""
+    connections (default 9,000 — both socket ends share one process's fd
+    limit) publishing car-record qos-0 payloads into the epoll MQTT
+    listener, bridged to the Kafka topic — counting only messages that
+    arrived in the stream broker (L1→L2→L3 complete)."""
     from iotml.gen.simulator import FleetGenerator, FleetScenario
     from iotml.mqtt.bridge import KafkaBridge
     from iotml.mqtt.broker import MqttBroker

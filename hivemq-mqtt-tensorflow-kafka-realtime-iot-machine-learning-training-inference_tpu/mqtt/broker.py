@@ -2,7 +2,7 @@
 
 The reference fronts its pipeline with a 5-node HiveMQ cluster (reference
 `infrastructure/hivemq/hivemq-crd.yaml:10-26`): MQTT sessions, wildcard and
-shared subscriptions, QoS 0/1, and extension hooks (the Kafka extension
+shared subscriptions, QoS 0/1/2, and extension hooks (the Kafka extension
 registers for a topic filter and forwards publishes).  This core implements
 those broker semantics in-process; `iotml.mqtt.wire` puts a real TCP/MQTT
 protocol front on it, and `iotml.mqtt.bridge.KafkaBridge` is the extension
@@ -26,7 +26,7 @@ DeliveryFn = Callable[[str, bytes, int, bool], None]
 
 class Session:
     __slots__ = ("client_id", "deliver", "clean_start", "connected_at",
-                 "pending", "resumed")
+                 "pending", "resumed", "qos2_inbound")
 
     def __init__(self, client_id: str, deliver: DeliveryFn,
                  clean_start: bool = True):
@@ -42,6 +42,13 @@ class Session:
         # True when server-side state (subscriptions/backlog) carried over —
         # what CONNACK's session-present flag must report
         self.resumed: bool = False
+        # QoS 2 exactly-once receiver state: packet ids of inbound PUBLISHes
+        # whose payload was already forwarded but whose PUBREL has not yet
+        # arrived.  A retried PUBLISH with one of these ids is a duplicate
+        # and must NOT be forwarded again (spec §4.3.3).  Carried across
+        # reconnects for persistent sessions — the dedup guarantee is the
+        # whole point of the handshake surviving a dropped connection.
+        self.qos2_inbound: set = set()
 
 
 class MqttBroker:
@@ -60,12 +67,13 @@ class MqttBroker:
         self._sessions: Dict[str, Session] = {}
         self._tree = TopicTree()
         self._retained: Dict[str, Tuple[bytes, int]] = {}
-        # disconnected persistent sessions: cid → (queue, expires_at).
+        # disconnected persistent sessions: cid → (queue, expires_at,
+        # qos2_inbound).
         # QoS≥1 deliveries queue (oldest dropped past the limit, HiveMQ's
         # offline buffering); a session that never reconnects expires after
         # offline_session_expiry_s (HiveMQ's session expiry) so rotating
         # client ids cannot grow state without bound.
-        self._offline: Dict[str, Tuple[deque, float]] = {}
+        self._offline: Dict[str, Tuple[deque, float, set]] = {}
         self.offline_queue_limit = offline_queue_limit
         self.offline_session_expiry_s = offline_session_expiry_s
         self._next_offline_sweep = 0.0
@@ -104,27 +112,33 @@ class MqttBroker:
         with self._lock:
             self._expire_offline()
             pending: List[Tuple[str, bytes, int, bool]] = []
+            qos2_inbound: set = set()
             old = self._sessions.get(client_id)
-            if old is not None and old.pending:
-                # session takeover mid-handshake: the superseded connection
-                # must not drain the backlog to its (likely dead) socket —
-                # the new session inherits it
-                pending = old.pending
-                old.pending = []
+            if old is not None:
+                if old.pending:
+                    # session takeover mid-handshake: the superseded
+                    # connection must not drain the backlog to its (likely
+                    # dead) socket — the new session inherits it
+                    pending = old.pending
+                    old.pending = []
+                qos2_inbound = old.qos2_inbound
             resumed = False
             if clean_start:
                 self._tree.unsubscribe_all(client_id)
                 self._offline.pop(client_id, None)
                 pending = []
+                qos2_inbound = set()
             else:
                 entry = self._offline.pop(client_id, None)
                 if entry is not None:
                     pending = list(entry[0]) + pending
+                    qos2_inbound |= entry[2]
                 # session-present: any server-side state carried over
                 resumed = (entry is not None or old is not None
                            or bool(self._tree.filters_of(client_id)))
             s = Session(client_id, deliver, clean_start)
             s.resumed = resumed
+            s.qos2_inbound = qos2_inbound
             # deliveries are held on `pending` until the transport declares
             # ready via deliver_pending() — this covers both the offline
             # backlog AND live publishes racing the CONNECT handshake (a
@@ -190,14 +204,16 @@ class MqttBroker:
                 q = deque(cur.pending or (),
                           maxlen=self.offline_queue_limit)
                 self._offline[client_id] = (
-                    q, time.time() + self.offline_session_expiry_s)
+                    q, time.time() + self.offline_session_expiry_s,
+                    cur.qos2_inbound)
             self._g_sessions.set(len(self._sessions))
 
     def _expire_offline(self) -> None:
         """Drop offline persistent sessions past their expiry (HiveMQ's
         session-expiry): queue AND subscriptions go. Caller holds _lock."""
         now = time.time()
-        dead = [cid for cid, (_q, exp) in self._offline.items() if exp < now]
+        dead = [cid for cid, (_q, exp, _r) in self._offline.items()
+                if exp < now]
         for cid in dead:
             del self._offline[cid]
             self._tree.unsubscribe_all(cid)
@@ -209,12 +225,30 @@ class MqttBroker:
         with self._lock:
             return list(self._sessions)
 
+    # ------------------------------------------------------------- qos 2
+    def qos2_begin(self, session: Session, packet_id: int) -> bool:
+        """Exactly-once receiver step 1: returns True when this packet id
+        is NEW for the session (caller must forward the publish), False
+        when it is a retry of an unreleased id (caller must NOT forward —
+        just re-acknowledge with PUBREC).  Spec §4.3.3 receiver flow."""
+        with self._lock:
+            if packet_id in session.qos2_inbound:
+                return False
+            session.qos2_inbound.add(packet_id)
+            return True
+
+    def qos2_release(self, session: Session, packet_id: int) -> None:
+        """Exactly-once receiver step 2 (PUBREL): the sender has seen our
+        PUBREC, so the id can never be retried — forget it."""
+        with self._lock:
+            session.qos2_inbound.discard(packet_id)
+
     # ----------------------------------------------------- subscriptions
     def subscribe(self, client_id: str, filter_: str, qos: int = 0) -> int:
-        """Returns granted qos (0/1 supported; 2 downgraded to 1 — the
-        reference caps at maxQos 2 but its pipeline only uses 0/1)."""
+        """Returns granted qos (0/1/2 — the reference broker advertises
+        maxQos 2, hivemq-crd.yaml:13)."""
         validate_filter(filter_)
-        granted = min(qos, 1)
+        granted = min(qos, 2)
         self._tree.subscribe(client_id, filter_, granted)
         # retained delivery on subscribe (spec §3.8.4) — through the same
         # gate as publish(): routing under the lock, a not-yet-ready

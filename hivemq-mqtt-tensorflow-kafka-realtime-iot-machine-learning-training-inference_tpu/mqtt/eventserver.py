@@ -47,7 +47,7 @@ class _EConn:
     """Per-socket state owned by the event loop."""
 
     __slots__ = ("sock", "proto", "inbuf", "outbuf", "lock", "closing",
-                 "paused")
+                 "paused", "registered")
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
@@ -57,6 +57,7 @@ class _EConn:
         self.lock = threading.Lock()
         self.closing = False
         self.paused = False  # reads suspended (publisher backpressure)
+        self.registered = True  # currently in the selector (loop thread)
 
 
 class MqttEventServer:
@@ -135,6 +136,11 @@ class MqttEventServer:
     def connection_count(self) -> int:
         return len(self._conns)
 
+    @property
+    def paused_count(self) -> int:
+        """Connections currently read-suspended by backpressure."""
+        return len(self._paused_conns)
+
     # --------------------------------------------------------- internals
     def _wake(self) -> None:
         try:
@@ -143,14 +149,20 @@ class MqttEventServer:
             pass
 
     def _send_to(self, conn: _EConn, data: bytes) -> None:
-        """Thread-safe outbound enqueue (MqttProtocol's send)."""
+        """Thread-safe outbound enqueue (MqttProtocol's send).
+
+        The watermark counter is updated INSIDE conn.lock so it cannot race
+        _close's leftover accounting: either the bytes are appended+counted
+        before close snapshots them (close subtracts them), or close has
+        already marked the connection and this raises without counting.
+        Lock order conn.lock → _out_lock everywhere."""
         with conn.lock:
             if conn.closing:
                 raise OSError("connection closing")
             conn.outbuf += data
             over = len(conn.outbuf) > self.max_outbuf
-        with self._out_lock:
-            self._total_out += len(data)
+            with self._out_lock:
+                self._total_out += len(data)
         with self._pending_lock:
             self._pending.add(conn)
         if over:
@@ -222,14 +234,22 @@ class MqttEventServer:
         return ev
 
     def _rearm(self, conn: _EConn) -> None:
+        """Loop-thread only: sync the selector with the connection state.
+        A paused connection with nothing to write is UNREGISTERED — keeping
+        it readable would defeat the pause (the selector would keep firing
+        and the loop keep ingesting).  A remote close is then observed on
+        resume, when reads re-arm."""
         ev = self._events_for(conn)
         try:
             if ev:
-                self._sel.modify(conn.sock, ev, conn)
-            else:
-                # nothing to do right now; keep registered for reads so the
-                # socket's close is still observed
-                self._sel.modify(conn.sock, selectors.EVENT_READ, conn)
+                if conn.registered:
+                    self._sel.modify(conn.sock, ev, conn)
+                else:
+                    self._sel.register(conn.sock, ev, conn)
+                    conn.registered = True
+            elif conn.registered:
+                self._sel.unregister(conn.sock)
+                conn.registered = False
         except (KeyError, ValueError, OSError):
             pass
 
@@ -302,29 +322,36 @@ class MqttEventServer:
             return
         self._rearm(conn)
 
-    def _close(self, conn: _EConn, evicted: bool = False) -> None:
-        closing_was = conn.closing
-        conn.closing = True
+    def _close(self, conn: _EConn) -> None:
         self._paused_conns.discard(conn)
         with conn.lock:
+            # eviction (_send_to's outbuf-cap mark) arrives with closing
+            # already True; a graceful close (protocol reject/DISCONNECT)
+            # sets it here — under conn.lock, so no _send_to can slip bytes
+            # in after our leftover accounting
+            closing_was = conn.closing
+            conn.closing = True
             leftover = bytes(conn.outbuf)
             conn.outbuf.clear()
-        if leftover:
-            with self._out_lock:
-                self._total_out -= len(leftover)
-            if not (evicted or closing_was):
-                # graceful close (protocol reject / DISCONNECT): give the
-                # final packets — e.g. the spec-mandated CONNACK rejection
-                # code — one best-effort non-blocking send before the FIN,
-                # matching the threaded front's synchronous send
-                try:
-                    conn.sock.send(leftover)
-                except OSError:
-                    pass
-        try:
-            self._sel.unregister(conn.sock)
-        except (KeyError, ValueError):
-            pass
+            if leftover:
+                with self._out_lock:
+                    self._total_out -= len(leftover)
+        if leftover and not closing_was:
+            # graceful close: give the final packets — e.g. the
+            # spec-mandated CONNACK rejection code — one best-effort
+            # non-blocking send before the FIN, matching the threaded
+            # front's synchronous send.  (An evicted stalled reader gets
+            # no such courtesy: its buffer is the problem.)
+            try:
+                conn.sock.send(leftover)
+            except OSError:
+                pass
+        if conn.registered:
+            try:
+                self._sel.unregister(conn.sock)
+            except (KeyError, ValueError):
+                pass
+            conn.registered = False
         self._conns.pop(conn.sock, None)
         try:
             conn.sock.close()

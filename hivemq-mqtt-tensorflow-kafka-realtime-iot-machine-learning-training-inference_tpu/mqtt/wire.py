@@ -6,9 +6,10 @@ The reference's device fleet speaks real MQTT over TCP to HiveMQ on :1883
 MQTT 3.1.1 server (protocol level 4; level-5 CONNECT/SUBSCRIBE/PUBLISH
 packets are accepted by parsing and skipping their properties block) in
 front of `MqttBroker`, plus a blocking client used by the load-generator
-agents.  QoS 0 and 1 are implemented end to end (PUBLISH→PUBACK); that is
-everything the reference's pipeline uses (scenario qos 0 / evaluation
-qos 1).
+agents.  QoS 0, 1, and 2 are implemented end to end (PUBLISH→PUBACK;
+PUBLISH→PUBREC→PUBREL→PUBCOMP with broker-side dedup surviving reconnect)
+— the reference broker advertises maxQos 2 (hivemq-crd.yaml:13); its
+scenarios use qos 0 (full) and qos 1 (evaluation).
 """
 
 from __future__ import annotations
@@ -23,6 +24,7 @@ from .broker import MqttBroker
 
 # packet types
 CONNECT, CONNACK, PUBLISH, PUBACK = 1, 2, 3, 4
+PUBREC, PUBREL, PUBCOMP = 5, 6, 7
 SUBSCRIBE, SUBACK, UNSUBSCRIBE, UNSUBACK = 8, 9, 10, 11
 PINGREQ, PINGRESP, DISCONNECT = 12, 13, 14
 
@@ -91,8 +93,8 @@ def connect_packet(client_id: str, protocol_level: int = 4,
 
 def publish_packet(topic: str, payload: bytes, qos: int = 0,
                    retain: bool = False, packet_id: int = 0,
-                   protocol_level: int = 4) -> bytes:
-    flags = (qos << 1) | (1 if retain else 0)
+                   protocol_level: int = 4, dup: bool = False) -> bytes:
+    flags = (qos << 1) | (1 if retain else 0) | (0x08 if dup else 0)
     body = _mqtt_str(topic)
     if qos > 0:
         body += struct.pack(">H", packet_id)
@@ -162,6 +164,9 @@ class MqttProtocol:
         self.session = None
         self._next_pid = 0
         self._pid_lock = threading.Lock()
+        # outbound QoS 2 sender state: pid → "rec" (awaiting PUBREC) or
+        # "comp" (PUBREL sent, awaiting PUBCOMP).  Spec §4.3.3 sender flow.
+        self._out_qos2: Dict[int, str] = {}
 
     # ------------------------------------------------------ broker fan-out
     def deliver(self, topic: str, payload: bytes, qos: int, retain: bool):
@@ -170,6 +175,8 @@ class MqttProtocol:
             with self._pid_lock:
                 self._next_pid = self._next_pid % 65535 + 1
                 pid = self._next_pid
+                if qos == 2:
+                    self._out_qos2[pid] = "rec"
         try:
             self._send(publish_packet(topic, payload, qos, retain, pid,
                                       protocol_level=self.level))
@@ -183,7 +190,14 @@ class MqttProtocol:
         Raises ValueError/struct.error on protocol violations (wildcard
         PUBLISH topic, short body) — MQTT says drop the connection."""
         broker = self.broker
+        if ptype != CONNECT and self.session is None:
+            # spec §3.1: the first packet MUST be CONNECT.  Without this a
+            # pre-CONNECT SUBSCRIBE would register topic-tree state under a
+            # None client id that no teardown ever removes.
+            raise ValueError(f"packet type {ptype} before CONNECT")
         if ptype == CONNECT:
+            if self.session is not None:
+                raise ValueError("second CONNECT on one connection")
             _name, pos = _read_str(body, 0)
             self.level = body[pos]
             clean = bool(body[pos + 1] & 0x02)
@@ -222,9 +236,31 @@ class MqttProtocol:
                 pos += 2
             if self.level >= 5:
                 pos = _skip_props(body, pos)
-            broker.publish(topic, body[pos:], qos, retain)
-            if qos == 1:
-                self._send(packet(PUBACK, 0, struct.pack(">H", pid)))
+            if qos == 2:
+                # exactly-once inbound: forward only the FIRST arrival of
+                # this packet id; a DUP retry (PUBREC lost / reconnect
+                # before PUBREL) re-acknowledges without re-forwarding
+                if self.broker.qos2_begin(self.session, pid):
+                    broker.publish(topic, body[pos:], qos, retain)
+                self._send(packet(PUBREC, 0, struct.pack(">H", pid)))
+            else:
+                broker.publish(topic, body[pos:], qos, retain)
+                if qos == 1:
+                    self._send(packet(PUBACK, 0, struct.pack(">H", pid)))
+        elif ptype == PUBREL:
+            # sender released the id: complete the handshake and forget it
+            (pid,) = struct.unpack_from(">H", body, 0)
+            self.broker.qos2_release(self.session, pid)
+            self._send(packet(PUBCOMP, 0, struct.pack(">H", pid)))
+        elif ptype == PUBREC:
+            # receiver acked our QoS 2 delivery: release
+            (pid,) = struct.unpack_from(">H", body, 0)
+            if self._out_qos2.get(pid) == "rec":
+                self._out_qos2[pid] = "comp"
+            self._send(packet(PUBREL, 0x02, struct.pack(">H", pid)))
+        elif ptype == PUBCOMP:
+            (pid,) = struct.unpack_from(">H", body, 0)
+            self._out_qos2.pop(pid, None)
         elif ptype == SUBSCRIBE:
             (pid,) = struct.unpack_from(">H", body, 0)
             pos = 2
@@ -345,6 +381,10 @@ class MqttClient:
         self._sock = socket.create_connection((host, port), timeout=10)
         self._on_message = on_message
         self._acks: Dict[int, threading.Event] = {}
+        # QoS 2 sender: pid → (PUBREC event, PUBCOMP event)
+        self._qos2_acks: Dict[int, Tuple[threading.Event, threading.Event]] = {}
+        # QoS 2 receiver dedup: inbound pids seen but not yet PUBREL'd
+        self._qos2_inbound: set = set()
         self._suback = threading.Event()
         self._suback_codes: List[int] = []
         self._pingresp = threading.Event()
@@ -377,21 +417,46 @@ class MqttClient:
                 if ptype == PUBLISH:
                     qos = (flags >> 1) & 0x03
                     topic, pos = _read_str(body, 0)
+                    duplicate = False
                     if qos > 0:
                         (pid,) = struct.unpack_from(">H", body, pos)
                         pos += 2
+                        if qos == 1:
+                            ack = packet(PUBACK, 0, struct.pack(">H", pid))
+                        else:  # exactly-once receiver: dedup until PUBREL
+                            duplicate = pid in self._qos2_inbound
+                            self._qos2_inbound.add(pid)
+                            ack = packet(PUBREC, 0, struct.pack(">H", pid))
                         with self._wlock:
-                            self._sock.sendall(
-                                packet(PUBACK, 0, struct.pack(">H", pid)))
+                            self._sock.sendall(ack)
                     if self._level >= 5:
                         pos = _skip_props(body, pos)
-                    if self._on_message:
+                    if self._on_message and not duplicate:
                         self._on_message(topic, body[pos:])
                 elif ptype == PUBACK:
                     (pid,) = struct.unpack_from(">H", body, 0)
                     ev = self._acks.pop(pid, None)
                     if ev:
                         ev.set()
+                elif ptype == PUBREC:
+                    (pid,) = struct.unpack_from(">H", body, 0)
+                    pair = self._qos2_acks.get(pid)
+                    if pair:
+                        pair[0].set()
+                    with self._wlock:
+                        self._sock.sendall(
+                            packet(PUBREL, 0x02, struct.pack(">H", pid)))
+                elif ptype == PUBREL:
+                    (pid,) = struct.unpack_from(">H", body, 0)
+                    self._qos2_inbound.discard(pid)
+                    with self._wlock:
+                        self._sock.sendall(
+                            packet(PUBCOMP, 0, struct.pack(">H", pid)))
+                elif ptype == PUBCOMP:
+                    (pid,) = struct.unpack_from(">H", body, 0)
+                    pair = self._qos2_acks.pop(pid, None)
+                    if pair:
+                        pair[1].set()
                 elif ptype == SUBACK:
                     pos = 2  # packet id
                     if self._level >= 5:
@@ -405,18 +470,30 @@ class MqttClient:
 
     def publish(self, topic: str, payload: bytes, qos: int = 0,
                 retain: bool = False, timeout: float = 10.0) -> None:
-        pid, ev = 0, None
+        """QoS 0: fire and forget.  QoS 1: blocks until PUBACK.  QoS 2:
+        blocks through the full PUBREC→PUBREL→PUBCOMP handshake (the
+        reader thread sends the PUBREL on PUBREC arrival)."""
+        pid, ev, pair = 0, None, None
         if qos > 0:
             with self._wlock:
                 self._next_pid = self._next_pid % 65535 + 1
                 pid = self._next_pid
-            ev = threading.Event()
-            self._acks[pid] = ev
+            if qos == 1:
+                ev = threading.Event()
+                self._acks[pid] = ev
+            else:
+                pair = (threading.Event(), threading.Event())
+                self._qos2_acks[pid] = pair
         with self._wlock:
             self._sock.sendall(publish_packet(topic, payload, qos, retain,
                                               pid, self._level))
         if ev is not None and not ev.wait(timeout):
             raise TimeoutError(f"no PUBACK for packet {pid}")
+        if pair is not None:
+            if not pair[0].wait(timeout):
+                raise TimeoutError(f"no PUBREC for packet {pid}")
+            if not pair[1].wait(timeout):
+                raise TimeoutError(f"no PUBCOMP for packet {pid}")
 
     def subscribe(self, filter_: str, qos: int = 0,
                   timeout: float = 10.0) -> None:

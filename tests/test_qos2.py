@@ -1,0 +1,193 @@
+"""MQTT QoS 2 exactly-once — PUBREC/PUBREL/PUBCOMP state machine.
+
+The reference broker advertises maxQos 2
+(reference `infrastructure/hivemq/hivemq-crd.yaml:13`); round 1 silently
+downgraded QoS 2 subscriptions to 1.  These tests pin the full spec §4.3.3
+flow on both TCP fronts: duplicate PUBLISH replay, reconnect mid-handshake
+with a persistent session, and no-duplication through the Kafka bridge."""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from iotml.mqtt.bridge import KafkaBridge
+from iotml.mqtt.broker import MqttBroker
+from iotml.mqtt.eventserver import MqttEventServer
+from iotml.mqtt.wire import (CONNACK, PUBCOMP, PUBREC, MqttClient,
+                             MqttServer, connect_packet, packet,
+                             publish_packet)
+from iotml.stream.broker import Broker
+
+PUBREL = 6
+
+
+def _recv_packet(sock):
+    """Read one MQTT packet (small frames only) from a raw socket."""
+    h = sock.recv(1)
+    if not h:
+        return None, b""
+    (length,) = sock.recv(1)
+    body = b""
+    while len(body) < length:
+        chunk = sock.recv(length - len(body))
+        if not chunk:
+            break
+        body += chunk
+    return h[0], body
+
+
+def _raw_connect(port, client_id, clean=True):
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    s.settimeout(10)
+    s.sendall(connect_packet(client_id, clean=clean))
+    h, _ = _recv_packet(s)
+    assert h >> 4 == CONNACK
+    return s
+
+
+@pytest.mark.parametrize("server_cls", [MqttServer, MqttEventServer])
+def test_qos2_end_to_end_both_fronts(server_cls):
+    """Full QoS 2 pub → broker → QoS 2 sub delivery with both handshakes."""
+    broker = MqttBroker()
+    with server_cls(broker) as srv:
+        got = []
+        done = threading.Event()
+
+        def on_msg(topic, payload):
+            got.append((topic, payload))
+            done.set()
+
+        sub = MqttClient("127.0.0.1", srv.port, "sub2", on_message=on_msg)
+        sub.subscribe("exact/#", qos=2)
+        pub = MqttClient("127.0.0.1", srv.port, "pub2")
+        pub.publish("exact/once", b"only-once", qos=2)  # blocks thru PUBCOMP
+        assert done.wait(5)
+        assert got == [("exact/once", b"only-once")]
+        pub.disconnect()
+        sub.disconnect()
+
+
+def test_qos2_subscribe_granted_2():
+    broker = MqttBroker()
+    assert broker.subscribe("c", "a/#", qos=2) == 2
+
+
+def test_duplicate_publish_replay_forwards_once():
+    """A retried QoS 2 PUBLISH (same pid, DUP set — PUBREC was 'lost') is
+    re-acknowledged but NOT re-forwarded."""
+    broker = MqttBroker()
+    with MqttServer(broker) as srv:
+        got = []
+        sub = MqttClient("127.0.0.1", srv.port, "watcher",
+                         on_message=lambda t, p: got.append(p))
+        sub.subscribe("exact/#", qos=0)
+
+        s = _raw_connect(srv.port, "replayer")
+        pub_pkt = publish_packet("exact/x", b"payload", qos=2, packet_id=77)
+        s.sendall(pub_pkt)
+        h, body = _recv_packet(s)
+        assert h >> 4 == PUBREC and struct.unpack(">H", body)[0] == 77
+        # replay the same pid WITHOUT releasing (simulates lost PUBREC)
+        s.sendall(publish_packet("exact/x", b"payload", qos=2,
+                                 packet_id=77, dup=True))
+        h, body = _recv_packet(s)
+        assert h >> 4 == PUBREC and struct.unpack(">H", body)[0] == 77
+        # release completes the handshake
+        s.sendall(packet(PUBREL, 0x02, struct.pack(">H", 77)))
+        h, body = _recv_packet(s)
+        assert h >> 4 == PUBCOMP
+        time.sleep(0.2)
+        assert got == [b"payload"], "duplicate must not be re-forwarded"
+        # after PUBREL the id is reusable: a NEW publish with pid 77 flows
+        s.sendall(publish_packet("exact/x", b"second", qos=2, packet_id=77))
+        h, _ = _recv_packet(s)
+        assert h >> 4 == PUBREC
+        deadline = time.time() + 5
+        while len(got) < 2 and time.time() < deadline:
+            time.sleep(0.02)
+        assert got == [b"payload", b"second"]
+        s.close()
+        sub.disconnect()
+
+
+def test_reconnect_mid_handshake_persistent_dedup():
+    """Publisher gets PUBREC, dies before PUBREL, reconnects (persistent
+    session) and retries the PUBLISH with DUP: the broker must not forward
+    it again, and the late PUBREL still completes cleanly."""
+    broker = MqttBroker()
+    with MqttServer(broker) as srv:
+        got = []
+        sub = MqttClient("127.0.0.1", srv.port, "watcher",
+                         on_message=lambda t, p: got.append(p))
+        sub.subscribe("exact/#", qos=0)
+
+        s1 = _raw_connect(srv.port, "flaky", clean=False)
+        s1.sendall(publish_packet("exact/x", b"v", qos=2, packet_id=9))
+        h, _ = _recv_packet(s1)
+        assert h >> 4 == PUBREC
+        s1.close()  # dies mid-handshake, no PUBREL
+
+        s2 = _raw_connect(srv.port, "flaky", clean=False)
+        # retry: same packet id, DUP
+        s2.sendall(publish_packet("exact/x", b"v", qos=2, packet_id=9,
+                                  dup=True))
+        h, body = _recv_packet(s2)
+        assert h >> 4 == PUBREC
+        s2.sendall(packet(PUBREL, 0x02, struct.pack(">H", 9)))
+        h, _ = _recv_packet(s2)
+        assert h >> 4 == PUBCOMP
+        time.sleep(0.2)
+        assert got == [b"v"], "reconnect retry must not duplicate delivery"
+        s2.close()
+        sub.disconnect()
+
+
+def test_qos2_no_duplicates_through_bridge():
+    """The L2→L3 guarantee: a replayed QoS 2 PUBLISH reaches the stream
+    broker exactly once."""
+    mqtt_broker = MqttBroker()
+    stream = Broker()
+    bridge = KafkaBridge(mqtt_broker, stream, partitions=2)
+    with MqttEventServer(mqtt_broker) as srv:
+        s = _raw_connect(srv.port, "car-1", clean=False)
+        pkt = publish_packet("vehicles/sensor/data/car-1", b"{\"v\":1}",
+                             qos=2, packet_id=3)
+        s.sendall(pkt)
+        h, _ = _recv_packet(s)
+        assert h >> 4 == PUBREC
+        # replay twice more before releasing
+        s.sendall(publish_packet("vehicles/sensor/data/car-1", b"{\"v\":1}",
+                                 qos=2, packet_id=3, dup=True))
+        _recv_packet(s)
+        s.sendall(publish_packet("vehicles/sensor/data/car-1", b"{\"v\":1}",
+                                 qos=2, packet_id=3, dup=True))
+        _recv_packet(s)
+        s.sendall(packet(PUBREL, 0x02, struct.pack(">H", 3)))
+        h, _ = _recv_packet(s)
+        assert h >> 4 == PUBCOMP
+        s.close()
+    assert bridge.forwarded() == 1
+    total = sum(stream.end_offset("sensor-data", p) for p in range(2))
+    assert total == 1
+
+
+def test_qos2_dedup_state_survives_offline_expiry_cleanup():
+    """Offline persistent sessions keep their unreleased QoS 2 ids (the
+    reconnect dedup), and a clean_start reconnect wipes them."""
+    broker = MqttBroker()
+    sess = broker.connect("c1", lambda *a: None, clean_start=False)
+    assert broker.qos2_begin(sess, 5) is True
+    assert broker.qos2_begin(sess, 5) is False
+    broker.disconnect("c1", sess)
+    # persistent reconnect: id 5 still a duplicate
+    sess2 = broker.connect("c1", lambda *a: None, clean_start=False)
+    assert broker.qos2_begin(sess2, 5) is False
+    broker.qos2_release(sess2, 5)
+    assert broker.qos2_begin(sess2, 5) is True
+    broker.disconnect("c1", sess2)
+    # clean start wipes the handshake state
+    sess3 = broker.connect("c1", lambda *a: None, clean_start=True)
+    assert broker.qos2_begin(sess3, 5) is True
