@@ -1,0 +1,126 @@
+# Provision everything the deploy/ manifests need, from an empty GCP
+# project, in one `terraform apply` — the role the reference's
+# infrastructure/terraform-gcp/main.tf plays (GKE cluster + node pool +
+# model bucket + service-account key handed to the install scripts,
+# main.tf:8-163), re-designed for TPU:
+#
+#   - a standard CPU node pool carries the streaming platform
+#     (deploy/platform.yaml — brokers, bridges, REST control planes);
+#   - a TPU podslice node pool carries the train/score workloads
+#     (deploy/model-training*.yaml select it via the same
+#     gke-tpu-accelerator/topology labels written here);
+#   - a GCS bucket is the model store (ArtifactStore gs:// root);
+#   - a workload service account with objectAdmin on that bucket replaces
+#     the reference's exported private key: GKE workload identity binds it
+#     to the `default` KSA, so no key file ever exists — the
+#     `google-application-credentials` Secret template stays empty.
+#
+# After apply, the kubectl steps in ../README.md run against the fresh
+# cluster (credentials fetched by the kubeconfig output below).
+
+terraform {
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = ">= 5.0"
+    }
+  }
+}
+
+provider "google" {
+  project = var.project
+  region  = var.region
+}
+
+resource "google_container_cluster" "iotml" {
+  name     = var.cluster_name
+  location = var.zone
+
+  # node pools are managed as separate resources below
+  remove_default_node_pool = true
+  initial_node_count       = 1
+
+  workload_identity_config {
+    workload_pool = "${var.project}.svc.id.goog"
+  }
+
+  release_channel {
+    channel = "REGULAR"
+  }
+}
+
+# ---- CPU pool: streaming platform, connectors, observability
+resource "google_container_node_pool" "platform" {
+  name     = "platform"
+  cluster  = google_container_cluster.iotml.name
+  location = var.zone
+
+  node_count = var.platform_node_count
+
+  autoscaling {
+    min_node_count = 1
+    max_node_count = var.platform_node_count
+  }
+
+  node_config {
+    machine_type = var.platform_machine_type
+    oauth_scopes = ["https://www.googleapis.com/auth/cloud-platform"]
+    workload_metadata_config {
+      mode = "GKE_METADATA"
+    }
+  }
+}
+
+# ---- TPU pool: the train Job + scorer Deployment land here through the
+# nodeSelector labels GKE writes for TPU slices
+resource "google_container_node_pool" "tpu" {
+  name     = "tpu-ml"
+  cluster  = google_container_cluster.iotml.name
+  location = var.zone
+
+  initial_node_count = 1
+
+  autoscaling {
+    min_node_count = 0 # scale to zero between training runs
+    max_node_count = 2
+  }
+
+  node_config {
+    machine_type = "ct5lp-hightpu-8t" # one v5e host (8 chips)
+    spot         = var.tpu_spot
+    oauth_scopes = ["https://www.googleapis.com/auth/cloud-platform"]
+    workload_metadata_config {
+      mode = "GKE_METADATA"
+    }
+    labels = {
+      "cloud.google.com/gke-tpu-accelerator" = var.tpu_accelerator
+      "cloud.google.com/gke-tpu-topology"    = var.tpu_topology
+    }
+  }
+}
+
+# ---- model store: the train→bucket→predict handoff target
+resource "google_storage_bucket" "models" {
+  name                        = "iotml-models-${var.project}-${var.cluster_name}"
+  location                    = var.region
+  uniform_bucket_level_access = true
+  force_destroy               = true
+}
+
+# ---- workload identity instead of an exported key file
+resource "google_service_account" "workload" {
+  account_id   = "${var.cluster_name}-workload"
+  display_name = "iotml workload (model store access)"
+}
+
+resource "google_storage_bucket_iam_member" "models_rw" {
+  bucket = google_storage_bucket.models.name
+  role   = "roles/storage.objectAdmin"
+  member = "serviceAccount:${google_service_account.workload.email}"
+}
+
+resource "google_service_account_iam_member" "wi_binding" {
+  service_account_id = google_service_account.workload.name
+  role               = "roles/iam.workloadIdentityUser"
+  member             = "serviceAccount:${var.project}.svc.id.goog[default/default]"
+}

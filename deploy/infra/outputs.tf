@@ -1,0 +1,13 @@
+output "kubeconfig_command" {
+  description = "Fetch credentials for kubectl (the reference's 00_setup_GKE.sh role)"
+  value       = "gcloud container clusters get-credentials ${google_container_cluster.iotml.name} --zone ${var.zone} --project ${var.project}"
+}
+
+output "model_bucket" {
+  description = "gs:// root to pass as the manifests' <artifact-root>"
+  value       = "gs://${google_storage_bucket.models.name}"
+}
+
+output "workload_service_account" {
+  value = google_service_account.workload.email
+}

@@ -1,0 +1,62 @@
+# Input variables for the iotml GKE+TPU provisioning.
+# Counterpart of the reference's infrastructure/terraform-gcp/variables.tf
+# (node_count/region/name/project), re-based for TPU slices.
+
+variable "project" {
+  description = "GCP project id (required)"
+  type        = string
+}
+
+variable "region" {
+  description = "Region for the cluster and bucket"
+  type        = string
+  default     = "us-central2"
+}
+
+variable "zone" {
+  description = "Zone carrying the TPU slice node pool"
+  type        = string
+  default     = "us-central2-b"
+}
+
+variable "cluster_name" {
+  description = "GKE cluster name"
+  type        = string
+  default     = "iotml-cluster"
+}
+
+variable "platform_node_count" {
+  description = "CPU nodes for the streaming platform / brokers"
+  type        = number
+  default     = 3
+}
+
+variable "platform_machine_type" {
+  description = "Machine type for the platform node pool"
+  type        = string
+  default     = "n2-standard-8"
+}
+
+variable "tpu_accelerator" {
+  description = "TPU accelerator type label for the ML node pool"
+  type        = string
+  default     = "tpu-v5-lite-podslice"
+}
+
+variable "tpu_topology" {
+  description = "TPU slice topology (chips layout)"
+  type        = string
+  default     = "2x4"
+}
+
+variable "tpu_spot" {
+  description = "Run the TPU pool on spot capacity (cheap, preemptible — the reference's optional-preemptible knob, as accidental chaos testing)"
+  type        = bool
+  default     = false
+}
+
+variable "image" {
+  description = "Container image the manifests run (built from the repo Dockerfile)"
+  type        = string
+  default     = "iotml:latest"
+}

@@ -1,0 +1,118 @@
+"""Validate every manifest under deploy/ against the codebase.
+
+`kubectl apply --dry-run` checks YAML against the K8s API; this checks it
+against *this repo*: that every image is the one the Dockerfile builds,
+every `python -m` entrypoint is an importable module with a main(), every
+`IOTML_*` env var is one the config layer actually reads, and every
+secretKeyRef points at a secret (and key) defined in secrets.yaml.  Run by
+deploy/smoke.sh; exits non-zero with a per-manifest error list.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import sys
+
+import yaml
+
+DEPLOY_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(DEPLOY_DIR)
+IMAGE = "iotml:latest"
+
+
+def _docs():
+    for fname in sorted(os.listdir(DEPLOY_DIR)):
+        if not fname.endswith(".yaml"):
+            continue
+        with open(os.path.join(DEPLOY_DIR, fname)) as f:
+            for doc in yaml.safe_load_all(f):
+                if doc:
+                    yield fname, doc
+
+
+def _containers(doc):
+    spec = doc.get("spec", {})
+    tmpl = spec.get("template", spec.get("jobTemplate", {}).get(
+        "spec", {}).get("template", {}))
+    pod = tmpl.get("spec", {})
+    return pod.get("containers", []) + pod.get("initContainers", [])
+
+
+def _known_env_keys():
+    """IOTML_* names the config tree accepts (iotml.config)."""
+    from iotml.config import Config, env_key_names
+
+    return set(env_key_names(Config()))
+
+
+def main() -> int:
+    sys.path.insert(0, REPO)
+    errors = []
+
+    secrets = {}
+    for fname, doc in _docs():
+        if doc.get("kind") == "Secret":
+            name = doc["metadata"]["name"]
+            keys = set(doc.get("stringData", {})) | set(doc.get("data", {}))
+            secrets[name] = keys
+
+    try:
+        known_env = _known_env_keys()
+    except Exception as e:  # config helper missing → still check the rest
+        known_env = None
+        errors.append(f"config introspection failed: {e}")
+
+    n_containers = 0
+    for fname, doc in _docs():
+        kind = doc.get("kind", "?")
+        for c in _containers(doc):
+            n_containers += 1
+            where = f"{fname}/{kind}/{c.get('name')}"
+            if c.get("image") != IMAGE:
+                errors.append(f"{where}: image {c.get('image')!r} != "
+                              f"{IMAGE!r} (what the Dockerfile builds)")
+            cmd = list(c.get("command", []))
+            if cmd[:2] == ["python", "-m"] and len(cmd) > 2:
+                mod = cmd[2]
+                try:
+                    m = importlib.import_module(mod)
+                    if not hasattr(m, "main"):
+                        errors.append(f"{where}: module {mod} has no main()")
+                except Exception as e:
+                    errors.append(f"{where}: cannot import {mod}: {e}")
+            for env in c.get("env", []):
+                name = env.get("name", "")
+                if name.startswith("IOTML_") and known_env is not None \
+                        and name not in known_env:
+                    errors.append(f"{where}: env {name} is not a key the "
+                                  f"config layer reads")
+                ref = env.get("valueFrom", {}).get("secretKeyRef")
+                if ref:
+                    sname, key = ref.get("name"), ref.get("key")
+                    if sname not in secrets:
+                        errors.append(f"{where}: secretKeyRef to undefined "
+                                      f"secret {sname!r}")
+                    elif key not in secrets[sname]:
+                        errors.append(f"{where}: secret {sname!r} has no "
+                                      f"key {key!r}")
+        for vol in (doc.get("spec", {}).get("template", {})
+                    .get("spec", {}).get("volumes", [])):
+            s = vol.get("secret", {}).get("secretName")
+            if s and s not in secrets:
+                errors.append(f"{fname}/{kind}: volume secret {s!r} "
+                              f"not defined in secrets.yaml")
+
+    if errors:
+        print(f"validate_manifests: {len(errors)} error(s)")
+        for e in errors:
+            print(f"  - {e}")
+        return 1
+    print(f"validate_manifests: OK ({n_containers} containers across "
+          f"{len(set(f for f, _ in _docs()))} files; image/entrypoint/env/"
+          f"secret references all resolve)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

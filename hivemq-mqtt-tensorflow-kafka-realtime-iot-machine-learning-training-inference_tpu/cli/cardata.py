@@ -42,7 +42,7 @@ def main(argv=None) -> int:
         argv, prog="cardata", usage=USAGE, make_model=_make_model,
         group="cardata-autoencoder", epochs=NB_EPOCH, batch_size=BATCH_SIZE,
         take_batches=TAKE_BATCHES, predict_skip=PREDICT_SKIP,
-        predict_take=TAKE_BATCHES)
+        predict_take=TAKE_BATCHES, h5_interop=True)
 
 
 if __name__ == "__main__":

@@ -119,6 +119,33 @@ def main(argv=None) -> int:
                 anomalies += b"|anomaly|" in m.value
                 off = m.offset + 1
 
+        # ---- persisted model-quality report beside the model (the
+        # notebook's ROC/PR/threshold cells as report.json + report.svg)
+        import numpy as np
+
+        from ..evaluate.anomaly import evaluate_detector
+        from ..evaluate.report import write_report
+
+        # re-read through the serve consumer (rewound) rather than a third
+        # consumer group; the one extra batched forward pass computes the
+        # labeled scores the scorer does not retain
+        consumer2.seek_to_start()
+        xs, ys = [], []
+        for b in SensorBatches(consumer2, batch_size=512, keep_labels=True):
+            xs.append(b.x[: b.n_valid])
+            ys.append(b.labels[: b.n_valid])
+        x_eval = np.concatenate(xs)
+        y_eval = np.concatenate(ys) != "false"
+        eval_scores = np.asarray(reconstruction_errors(
+            CAR_AUTOENCODER, trainer.state.params, x_eval))
+        eval_report = evaluate_detector(CAR_AUTOENCODER, trainer.state.params,
+                                        x_eval, y_eval, threshold=threshold,
+                                        scores=eval_scores)
+        report_paths = write_report(
+            eval_report, eval_scores, y_eval,
+            tempfile.mkdtemp(prefix="iotml_demo_report_"),
+            store=ArtifactStore(root), name="demo-model-eval")
+
         summary = {
             "cars": args.cars,
             "mqtt_messages_bridged": ingested,
@@ -132,6 +159,9 @@ def main(argv=None) -> int:
             "anomaly_threshold": round(threshold, 4),
             "scored": scored,
             "anomalies_flagged": int(anomalies),
+            "roc_auc": round(eval_report.roc_auc, 4),
+            "avg_precision": round(eval_report.avg_precision, 4),
+            "eval_report": report_paths["uploaded"] or report_paths["json"],
             "wall_seconds": round(time.perf_counter() - t_start, 2),
         }
         print(json.dumps(summary, indent=2))

@@ -112,6 +112,18 @@ class Config:
         return json.dumps(self.as_dict(), indent=2, sort_keys=True)
 
 
+def env_key_names(cfg: Optional[Config] = None) -> list:
+    """Every IOTML_<SECTION>_<FIELD> env var the resolver accepts — the
+    deploy manifests are validated against this list so a typo'd env name
+    fails in CI, not silently in the pod."""
+    cfg = cfg or Config()
+    names = []
+    for section, sub in dataclasses.asdict(cfg).items():
+        for field in sub:
+            names.append(f"IOTML_{section.upper()}_{field.upper()}")
+    return names
+
+
 # -------------------------------------------------------------- resolution
 def _coerce(value: Any, typ: type, where: str) -> Any:
     if get_origin(typ) is not None:  # Optional[...] etc.

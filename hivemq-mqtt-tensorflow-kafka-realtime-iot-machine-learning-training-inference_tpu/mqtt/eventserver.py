@@ -37,6 +37,7 @@ import selectors
 import socket
 import struct
 import threading
+import time
 from typing import Dict, Optional
 
 from .broker import MqttBroker
@@ -72,16 +73,26 @@ class MqttEventServer:
       high_watermark / low_watermark: aggregate delivery-backlog bounds for
         publisher backpressure (reads suspended above high, resumed below
         low).
+      stall_timeout_s: overload-protection escape.  If the backlog has not
+        drained below the low watermark after this long with publishers
+        paused, the slowest consumer (largest output buffer) is evicted —
+        repeatedly, one per loop pass — until the backlog sinks and the
+        publishers resume.  Without this, enough stalled consumers each
+        sitting under max_outbuf could hold every publisher paused (and
+        their closed sockets unobserved) forever.
     """
 
     def __init__(self, broker: MqttBroker, host: str = "127.0.0.1",
                  port: int = 0, max_outbuf: int = 4 << 20,
                  high_watermark: int = 16 << 20,
-                 low_watermark: int = 4 << 20):
+                 low_watermark: int = 4 << 20,
+                 stall_timeout_s: float = 10.0):
         self.broker = broker
         self.max_outbuf = max_outbuf
         self.high_watermark = high_watermark
         self.low_watermark = low_watermark
+        self.stall_timeout_s = stall_timeout_s
+        self._pause_started: Optional[float] = None  # loop-thread only
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._lsock.bind((host, port))
@@ -209,6 +220,21 @@ class MqttEventServer:
                         if conn.sock in self._conns:
                             self._rearm(conn)
                     self._paused_conns.clear()
+                    self._pause_started = None
+                elif self._pause_started is not None and \
+                        time.monotonic() - self._pause_started > \
+                        self.stall_timeout_s:
+                    # the backlog is not draining: evict the slowest
+                    # consumer so the system unwedges instead of holding
+                    # every publisher paused indefinitely.  The clock is
+                    # NOT reset — once stalled, one eviction per loop pass
+                    # until the backlog sinks below the low mark (the
+                    # resume branch above then clears the clock).
+                    victim = max(self._conns.values(),
+                                 key=lambda c: len(c.outbuf), default=None)
+                    if victim is not None and victim.outbuf:
+                        victim.closing = True  # eviction, not courtesy close
+                        self._close(victim)
 
     def _accept(self) -> None:
         while True:
@@ -296,6 +322,8 @@ class MqttEventServer:
         if over:
             conn.paused = True
             self._paused_conns.add(conn)
+            if self._pause_started is None:
+                self._pause_started = time.monotonic()
         self._rearm(conn)
 
     def _flush(self, conn: _EConn) -> None:
@@ -324,6 +352,11 @@ class MqttEventServer:
 
     def _close(self, conn: _EConn) -> None:
         self._paused_conns.discard(conn)
+        if not self._paused_conns:
+            # last paused conn gone: clear the stall clock, or a LATER
+            # pause would inherit this one's start time and evict a
+            # healthy consumer instantly
+            self._pause_started = None
         with conn.lock:
             # eviction (_send_to's outbuf-cap mark) arrives with closing
             # already True; a graceful close (protocol reject/DISCONNECT)

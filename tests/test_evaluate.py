@@ -1,5 +1,6 @@
 """Anomaly-eval suite: hand-computed cases + sklearn cross-check."""
 
+import jax
 import numpy as np
 import pytest
 
@@ -80,3 +81,41 @@ def test_evaluate_detector_report():
     assert 0.0 <= rep.roc_auc <= 1.0
     assert "auc=" in rep.summary()
     assert rep.as_dict()["confusion"]["tp"] + rep.as_dict()["confusion"]["fn"] == 16
+
+
+def test_write_report_persists_json_and_svg(tmp_path):
+    """VERDICT r1: the eval numbers become an artifact an operator can
+    open — report.json with curves, report.svg with ROC/PR/histogram —
+    and the directory uploads through the ArtifactStore."""
+    import json
+
+    from iotml.evaluate.anomaly import evaluate_detector
+    from iotml.evaluate.report import write_report
+    from iotml.models.autoencoder import CAR_AUTOENCODER
+    from iotml.train.artifacts import ArtifactStore
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(300, 18)).astype(np.float32)
+    labels = rng.random(300) < 0.1
+    x[labels] *= 6.0  # anomalies reconstruct badly
+    params = CAR_AUTOENCODER.init(jax.random.PRNGKey(0),
+                                  x[:1])["params"]
+    report = evaluate_detector(CAR_AUTOENCODER, params, x, labels,
+                               threshold=5.0)
+    from iotml.evaluate.anomaly import reconstruction_errors
+    scores = np.asarray(reconstruction_errors(CAR_AUTOENCODER, params, x))
+
+    store_root = str(tmp_path / "store")
+    paths = write_report(report, scores, labels,
+                         str(tmp_path / "report"),
+                         store=ArtifactStore(store_root),
+                         name="model-eval")
+    data = json.loads(open(paths["json"]).read())
+    assert data["n"] == 300
+    assert 0.0 <= data["roc_auc"] <= 1.0
+    assert len(data["curves"]["roc"]["fpr"]) > 2
+    svg = open(paths["svg"]).read()
+    assert svg.startswith("<?xml") and "svg" in svg[:300]
+    # trees ship as zip blobs (ArtifactStore.upload_tree contract)
+    assert (tmp_path / "store" / "model-eval.zip").is_file()
+    assert paths["uploaded"]

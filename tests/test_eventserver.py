@@ -281,3 +281,55 @@ def test_packets_before_connect_drop_connection():
         s.close()
     assert broker._tree.filters_of(None) == [] if hasattr(
         broker._tree, "filters_of") else True
+
+
+def test_stalled_backpressure_evicts_slowest_consumer():
+    """Overload-protection escape: when the paused backlog never drains
+    (stalled consumers all under max_outbuf), the slowest consumer is
+    evicted after stall_timeout_s and publishers resume — the system must
+    not wedge forever."""
+    from iotml.mqtt.wire import subscribe_packet
+
+    broker = MqttBroker()
+    with MqttEventServer(broker, max_outbuf=64 << 20,
+                         high_watermark=1 << 20,
+                         low_watermark=256 * 1024,
+                         stall_timeout_s=1.0) as srv:
+        sub = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sub.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+        sub.settimeout(10)
+        sub.connect(("127.0.0.1", srv.port))
+        sub.sendall(connect_packet("stalled"))
+        buf = b""
+        while len(buf) < 4:
+            buf += sub.recv(4 - len(buf))
+        sub.sendall(subscribe_packet(1, [("flood/#", 0)]))
+        time.sleep(0.2)
+
+        pub = MqttClient("127.0.0.1", srv.port, "pub")
+        payload = b"z" * 16384
+
+        def flood():
+            try:
+                for _ in range(1200):  # ~20 MB, enough to trip the pause
+                    pub.publish("flood/x", payload, qos=0)
+            except OSError:
+                pass
+
+        t = threading.Thread(target=flood, daemon=True)
+        t.start()
+        deadline = time.time() + 30
+        while srv.paused_count == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert srv.paused_count > 0
+        # nobody drains the sub; after stall_timeout_s it must be evicted
+        # and the flood must complete
+        t.join(timeout=60)
+        assert not t.is_alive(), "publisher stayed wedged past the timeout"
+        deadline = time.time() + 10
+        while "stalled" in broker.session_ids() and time.time() < deadline:
+            time.sleep(0.05)
+        assert "stalled" not in broker.session_ids()
+        pub.publish("flood/x", b"alive", qos=1)
+        pub.disconnect()
+        sub.close()

@@ -97,3 +97,26 @@ def test_train_commits_offsets_for_committed_resume(tmp_path):
         committed2 = broker.committed("cardata-autoencoder",
                                       "SENSOR_DATA_S_AVRO", 0)
         assert committed2 == 600
+
+
+def test_cardata_h5_artifact_contract(tmp_path):
+    """VERDICT r1: an '.h5' model-file name keeps the reference's artifact
+    format — train stores a Keras h5 blob (loadable by reference-side
+    Keras), predict loads it back and scores."""
+    import h5py
+
+    from iotml.cli.cardata import main as cardata_main
+
+    root = str(tmp_path / "artifacts")
+    rc = cardata_main(["emulator:12000", "SENSOR_DATA_S_AVRO", "0",
+                       "model-predictions", "train", "model1.h5", root,
+                       "--train.epochs=2"])
+    assert rc == 0
+    stored = tmp_path / "artifacts" / "model1.h5"
+    assert stored.is_file()
+    with h5py.File(stored, "r") as f:  # a real Keras h5, not orbax
+        assert "model_config" in f.attrs
+        assert "model_weights" in f
+    rc = cardata_main(["emulator:12000", "SENSOR_DATA_S_AVRO", "0",
+                       "model-predictions", "predict", "model1.h5", root])
+    assert rc == 0

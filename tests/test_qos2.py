@@ -16,12 +16,10 @@ import pytest
 from iotml.mqtt.bridge import KafkaBridge
 from iotml.mqtt.broker import MqttBroker
 from iotml.mqtt.eventserver import MqttEventServer
-from iotml.mqtt.wire import (CONNACK, PUBCOMP, PUBREC, MqttClient,
+from iotml.mqtt.wire import (CONNACK, PUBCOMP, PUBREC, PUBREL, MqttClient,
                              MqttServer, connect_packet, packet,
                              publish_packet)
 from iotml.stream.broker import Broker
-
-PUBREL = 6
 
 
 def _recv_packet(sock):
