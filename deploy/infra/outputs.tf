@@ -11,3 +11,8 @@ output "model_bucket" {
 output "workload_service_account" {
   value = google_service_account.workload.email
 }
+
+output "ksa_annotate_command" {
+  description = "REQUIRED after apply: workload identity needs the KSA annotated with the GSA in addition to the IAM binding, or pods get the node identity (no bucket access)"
+  value       = "kubectl annotate serviceaccount default iam.gke.io/gcp-service-account=${google_service_account.workload.email}"
+}

@@ -85,7 +85,7 @@ def main() -> int:
 
         artifact_root = tempfile.mkdtemp(prefix="iotml_smoke_store_")
 
-        def run_manifest(fname, mode_override=None):
+        def run_manifest(fname):
             (doc,) = [d for d in _load(fname)
                       if d.get("kind") in ("Job", "Deployment")]
             c = _container(doc)
@@ -97,9 +97,6 @@ def main() -> int:
                            f"127.0.0.1:{plat.kafka.port}", a) for a in args]
             args = [artifact_root if a.startswith("gs://") else a
                     for a in args]
-            if mode_override:
-                args = [mode_override if a in ("train", "predict") else a
-                        for a in args]
             env = _resolve_env(c, secrets)
             env.pop("IOTML_MESH_DATA", None)  # no 8-chip slice here
             # the smoke proves the contract, not the convergence: a short

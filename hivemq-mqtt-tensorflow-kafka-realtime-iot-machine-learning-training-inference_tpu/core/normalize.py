@@ -63,14 +63,21 @@ class Normalizer:
             mask[i] = 1.0
         self.schema = schema
         self.dtype = dtype
-        self.scale = jnp.asarray(scale, dtype)
-        self.shift = jnp.asarray(shift, dtype)
-        self.mask = jnp.asarray(mask, dtype)
+        # HOST numpy constants, not device arrays: the default normalizer
+        # is built at import time, and materializing device buffers there
+        # initializes the XLA backend — which must not happen before
+        # jax.distributed.initialize() on multi-host.  jnp.asarray inside
+        # __call__ constant-folds under jit just the same.
+        np_dtype = np.dtype(jnp.dtype(dtype).name)
+        self.scale = scale.astype(np_dtype)
+        self.shift = shift.astype(np_dtype)
+        self.mask = mask.astype(np_dtype)
 
     def __call__(self, x):
         """Normalize a [..., num_sensors] array."""
         x = jnp.asarray(x, self.dtype)
-        return (x * self.scale + self.shift) * self.mask
+        return (x * jnp.asarray(self.scale) + jnp.asarray(self.shift)) \
+            * jnp.asarray(self.mask)
 
     def np(self, x: np.ndarray) -> np.ndarray:
         """Host-side numpy twin (for data-plane preprocessing off-device)."""

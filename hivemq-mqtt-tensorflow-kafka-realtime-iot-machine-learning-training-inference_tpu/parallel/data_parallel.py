@@ -38,10 +38,25 @@ def param_specs(params, mesh: Mesh, model_axis: Optional[str] = "model"):
     return jax.tree_util.tree_map_with_path(spec, params)
 
 
+def put_global(x, sharding: NamedSharding):
+    """Host array → (possibly multi-process) global device array.
+
+    Single-process: plain device_put.  Multi-host (jax.distributed
+    initialized): `x` is THIS PROCESS's share — the rows its consumers
+    pulled from its assigned partitions — and the global array is
+    assembled from every process's share (replicated specs take the full
+    array from each host).  This is the host-local → global boundary of
+    the whole multi-host design: data stays host-local on the DCN side,
+    the mesh sees one logical array on the ICI side."""
+    if jax.process_count() > 1:
+        return jax.make_array_from_process_local_data(sharding, x)
+    return jax.device_put(x, sharding)
+
+
 def shard_params(params, mesh: Mesh, model_axis: Optional[str] = "model"):
     specs = param_specs(params, mesh, model_axis)
     return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+        lambda x, s: put_global(x, NamedSharding(mesh, s)), params, specs)
 
 
 class ShardedTrainer:
@@ -62,6 +77,10 @@ class ShardedTrainer:
         self.state: Optional[TrainState] = None
         self._step = None
         self._data_sharding = batch_sharding(mesh)
+        # multi-host: batch shapes this process has already verified every
+        # other process agrees on (one collective per NEW shape, not per
+        # step)
+        self._agreed_shapes: set = set()
 
     @property
     def data_sharding(self) -> NamedSharding:
@@ -71,7 +90,8 @@ class ShardedTrainer:
         state = TrainState.create(self.model, self.rng, sample_x, tx=self.tx)
         pspecs = param_specs(state.params, self.mesh, self.model_axis)
         params = shard_params(state.params, self.mesh, self.model_axis)
-        opt_state = jax.device_put(state.opt_state, replicated(self.mesh))
+        opt_state = jax.tree.map(
+            lambda a: put_global(a, replicated(self.mesh)), state.opt_state)
         self.state = state.replace(params=params, opt_state=opt_state)
 
         raw = make_raw_train_step(self.model, self.tx, self.supervised)
@@ -94,19 +114,43 @@ class ShardedTrainer:
     def put_batch(self, x, y, mask):
         """Host batch → sharded device arrays (rows split over 'data').
 
-        Rows are zero-padded up to a multiple of the data-axis size (the
-        masked loss already ignores padding), so any batch size works on any
-        mesh — e.g. the reference's batch 100 on an 8-chip slice."""
+        Rows are zero-padded up to a multiple of the data-axis share this
+        process carries (the masked loss already ignores padding), so any
+        batch size works on any mesh — e.g. the reference's batch 100 on an
+        8-chip slice.  Multi-host: `x` is this host's rows (from its
+        assigned partitions); the global batch is their concatenation."""
         import numpy as np
 
-        d = self.mesh.shape["data"]
+        # pad to this process's share of the data axis: with P processes
+        # each contributing rows, the global row count splits over the full
+        # axis only when every local count is a multiple of axis/P
+        d = max(1, self.mesh.shape["data"] // jax.process_count())
         b = x.shape[0]
         if b % d:
             pad = d - b % d
             x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
             y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)])
             mask = np.concatenate([mask, np.zeros((pad,), mask.dtype)])
-        put = lambda a: jax.device_put(a, self._data_sharding)  # noqa: E731
+        if jax.process_count() > 1 and x.shape not in self._agreed_shapes:
+            # every process must present the same local shape or
+            # make_array_from_process_local_data assembles DIFFERENT global
+            # shapes per process and the compiled step hangs in its first
+            # cross-host collective.  Ragged tails are the usual culprit —
+            # use fixed-size batches (SensorBatches pad_tail=True) on every
+            # host.  One allgather per new shape makes the mistake a loud
+            # error instead of a hang.
+            from jax.experimental import multihost_utils
+
+            shapes = multihost_utils.process_allgather(
+                np.asarray(x.shape, np.int64))
+            if not (shapes == shapes[0]).all():
+                raise ValueError(
+                    f"multi-host batch shape mismatch across processes: "
+                    f"{shapes.tolist()} — every host must feed identical "
+                    f"local batch shapes (fixed-size batches, equal step "
+                    f"counts)")
+            self._agreed_shapes.add(x.shape)
+        put = lambda a: put_global(a, self._data_sharding)  # noqa: E731
         return put(x), put(y), put(mask)
 
     def step(self, x, y, mask):
