@@ -54,6 +54,13 @@ class KafkaBridge:
             "kafka_extension_total_forwarded",
             "MQTT publishes bridged into the stream broker (reference "
             "family kafka_extension_*)")
+        # bridge lag: time from MQTT delivery to the stream-broker append.
+        # The reference charts its extension's write latency/rate in
+        # hivemq.json; here the forward is synchronous, so this histogram
+        # IS the end-to-end extension lag an operator watches
+        self._m_lag = default_registry.histogram(
+            "kafka_extension_forward_seconds",
+            "MQTT→stream bridge forward latency per message")
         # the registry counter is process-global (shared across bridges for
         # scrape purposes); per-instance accounting needs its own counter
         self._n_fwd = 0
@@ -65,8 +72,10 @@ class KafkaBridge:
             dest = m.stream_topic
 
             def deliver(topic, payload, qos, retain, _dest=dest):
+                t0 = time.perf_counter()
                 self.stream.produce(_dest, payload, key=topic.encode(),
                                     timestamp_ms=int(time.time() * 1000))
+                self._m_lag.observe(time.perf_counter() - t0)
                 self._m_fwd.inc()
                 with self._n_lock:
                     self._n_fwd += 1

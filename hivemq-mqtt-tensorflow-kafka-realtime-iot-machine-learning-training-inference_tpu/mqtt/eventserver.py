@@ -40,8 +40,24 @@ import threading
 import time
 from typing import Dict, Optional
 
+from ..obs.metrics import default_registry
 from .broker import MqttBroker
 from .wire import MqttProtocol, parse_frame
+
+# Overload-protection metric families — the rebuilt twins of the credit-
+# system panels in the reference's HiveMQ dashboard (hivemq.json charts
+# overload-protection credits and backpressure); the generated Grafana
+# dashboards pick these up from the registry automatically.
+_m_paused = default_registry.gauge(
+    "mqtt_overload_publishers_paused_current",
+    "publisher connections currently read-suspended by backpressure")
+_m_backlog = default_registry.gauge(
+    "mqtt_overload_delivery_backlog_bytes",
+    "bytes buffered for delivery across all connections (the watermark "
+    "quantity)")
+_m_evicted = default_registry.counter(
+    "mqtt_overload_slow_consumers_evicted_total",
+    "consumer connections dropped for not draining their delivery buffer")
 
 
 class _EConn:
@@ -179,6 +195,7 @@ class MqttEventServer:
         if over:
             # slow-consumer eviction: mark and let the loop tear it down
             conn.closing = True
+            _m_evicted.inc()
         if threading.current_thread() is not self._thread:
             self._wake()
 
@@ -209,6 +226,13 @@ class MqttEventServer:
             for conn in pending:
                 if conn.sock in self._conns:
                     self._flush(conn)
+            # overload gauges refresh once per loop pass, not per message
+            # (the registry lock must not ride the enqueue hot path); with
+            # one listener per process — the deployment shape — the gauges
+            # read as this listener's state
+            with self._out_lock:
+                _m_backlog.set(self._total_out)
+            _m_paused.set(len(self._paused_conns))
             # backpressure release: resume paused publishers once the
             # aggregate delivery backlog has drained below the low mark
             if self._paused_conns:
@@ -234,6 +258,7 @@ class MqttEventServer:
                                  key=lambda c: len(c.outbuf), default=None)
                     if victim is not None and victim.outbuf:
                         victim.closing = True  # eviction, not courtesy close
+                        _m_evicted.inc()
                         self._close(victim)
 
     def _accept(self) -> None:

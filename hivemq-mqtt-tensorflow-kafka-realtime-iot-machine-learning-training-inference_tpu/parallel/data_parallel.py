@@ -77,10 +77,9 @@ class ShardedTrainer:
         self.state: Optional[TrainState] = None
         self._step = None
         self._data_sharding = batch_sharding(mesh)
-        # multi-host: batch shapes this process has already verified every
-        # other process agrees on (one collective per NEW shape, not per
-        # step)
-        self._agreed_shapes: set = set()
+        # multi-host: the ONE batch shape all processes agreed on (fixed
+        # at the first step; see put_batch)
+        self._agreed_shape = None
 
     @property
     def data_sharding(self) -> NamedSharding:
@@ -131,25 +130,33 @@ class ShardedTrainer:
             x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
             y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)])
             mask = np.concatenate([mask, np.zeros((pad,), mask.dtype)])
-        if jax.process_count() > 1 and x.shape not in self._agreed_shapes:
+        if jax.process_count() > 1:
             # every process must present the same local shape or
             # make_array_from_process_local_data assembles DIFFERENT global
             # shapes per process and the compiled step hangs in its first
-            # cross-host collective.  Ragged tails are the usual culprit —
-            # use fixed-size batches (SensorBatches pad_tail=True) on every
-            # host.  One allgather per new shape makes the mistake a loud
-            # error instead of a hang.
-            from jax.experimental import multihost_utils
+            # cross-host collective.  The agreement collective runs exactly
+            # ONCE — at the first step, which every process reaches
+            # together — and fixes the shape for the run; later deviations
+            # (a ragged tail one host hit) fail LOCALLY with a clear error
+            # instead of desynchronizing a per-shape collective.
+            if self._agreed_shape is None:
+                from jax.experimental import multihost_utils
 
-            shapes = multihost_utils.process_allgather(
-                np.asarray(x.shape, np.int64))
-            if not (shapes == shapes[0]).all():
+                shapes = multihost_utils.process_allgather(
+                    np.asarray(x.shape, np.int64))
+                if not (shapes == shapes[0]).all():
+                    raise ValueError(
+                        f"multi-host batch shape mismatch across "
+                        f"processes: {shapes.tolist()} — every host must "
+                        f"feed identical local batch shapes")
+                self._agreed_shape = x.shape
+            elif x.shape != self._agreed_shape:
                 raise ValueError(
-                    f"multi-host batch shape mismatch across processes: "
-                    f"{shapes.tolist()} — every host must feed identical "
-                    f"local batch shapes (fixed-size batches, equal step "
-                    f"counts)")
-            self._agreed_shapes.add(x.shape)
+                    f"multi-host batch shape changed mid-run: "
+                    f"{x.shape} != agreed {self._agreed_shape} — use "
+                    f"fixed-size batches on every host (SensorBatches "
+                    f"pad_tail=True, or pad_tail=False which drops ragged "
+                    f"tails) and equal step counts")
         put = lambda a: put_global(a, self._data_sharding)  # noqa: E731
         return put(x), put(y), put(mask)
 

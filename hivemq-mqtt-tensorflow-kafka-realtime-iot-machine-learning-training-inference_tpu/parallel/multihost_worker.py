@@ -61,6 +61,9 @@ def main(argv=None) -> int:
     client = KafkaWireBroker(servers)
     consumer = StreamConsumer(client, consumer_specs(topic, parts),
                               group=f"multihost-{pid}")
+    # pad_tail=False DROPS the ragged tail, so every batch is exactly
+    # batch_size rows — the fixed local shape the multi-host put_batch
+    # contract requires on every host
     batches = list(SensorBatches(consumer, batch_size=32, only_normal=True,
                                  pad_tail=False))
     assert batches, f"host {pid}: no data in partitions {parts}"
