@@ -256,7 +256,10 @@ class MqttEventServer:
                     # resume branch above then clears the clock).
                     victim = max(self._conns.values(),
                                  key=lambda c: len(c.outbuf), default=None)
-                    if victim is not None and victim.outbuf:
+                    if victim is not None and victim.outbuf \
+                            and not victim.closing:
+                        # not victim.closing: an outbuf-cap eviction may
+                        # have marked (and counted) it already this pass
                         victim.closing = True  # eviction, not courtesy close
                         _m_evicted.inc()
                         self._close(victim)

@@ -1,0 +1,112 @@
+"""Byte-identical fast `np.array2string` for prediction rows.
+
+The serve path's payload contract is `np.array2string(row)` — the exact
+string the reference's OutputCallback produced (cardata-v3.py:247).
+Profiling shows that call IS the serve bottleneck: ~90% of a drain's wall
+clock goes to numpy's per-element Python formatting pipeline
+(FloatingFormat.fillFormat + _formatArray), ~5× the cost of the
+underlying dragon4 C calls.
+
+`format_rows` reproduces numpy's output byte-for-byte for the common
+case — 1-D finite float rows, default print options, positional
+(non-exponential) formatting — by calling dragon4 once per element and
+re-implementing the padding + line-wrap assembly
+(numpy/_core/arrayprint.py: `FloatingFormat.fillFormat` positional
+branch, `_formatArray`'s 1-D recurser with `_extendLine`).  Rows that
+would take any other numpy path — non-finite values, exponential
+trigger (|x|max ≥ 1e8, nonzero |x|min < 1e-4, or max/min > 1000), or
+non-default printoptions — fall back to `np.array2string` itself, so
+equality holds unconditionally (pinned by tests/test_fastfmt.py against
+numpy on adversarial inputs).
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import List
+
+import numpy as np
+
+# the printoptions this fast path reproduces; anything else → fallback
+_DEFAULTS = {
+    "precision": 8, "suppress": False, "floatmode": "maxprec",
+    "sign": "-", "linewidth": 75,
+}
+
+_LINEWIDTH = 75
+_HANG = " "                       # 1-D next_line_prefix
+_ELEM_W = _LINEWIDTH - 1          # minus max(len(sep.rstrip()), len(']'))
+
+# the public format_float_positional wrapper spends ~3× the C call's cost
+# in argument validation; go straight to dragon4 when the private symbol
+# exists (same function numpy itself dispatches to), else use the wrapper
+try:
+    from numpy._core._multiarray_umath import \
+        dragon4_positional as _dragon4  # type: ignore[attr-defined]
+except ImportError:  # numpy layout changed: correctness over speed
+    _dragon4 = np.format_float_positional
+
+
+def _options_are_default() -> bool:
+    opts = np.get_printoptions()
+    return all(opts.get(k) == v for k, v in _DEFAULTS.items())
+
+
+def _format_fast_row(row: np.ndarray) -> str:
+    """One finite, non-exponential row → np.array2string(row) bytes."""
+    fmt = _dragon4
+    strs = [fmt(x, precision=8, unique=True, fractional=True, trim=".")
+            for x in row]
+    parts = [s.split(".") for s in strs]
+    pad_left = max(len(p[0]) for p in parts)
+    pad_right = max(len(p[1]) for p in parts)
+    words = [
+        " " * (pad_left - len(p[0])) + s + " " * (pad_right - len(p[1]))
+        for s, p in zip(strs, parts)
+    ]
+    # numpy's 1-D assembly: hanging indent ' ', separator ' ' appended
+    # after every element but the last, wrap when the next word would
+    # cross elem_width, then strip the indent and wrap in brackets
+    out = []
+    line = _HANG
+    last = len(words) - 1
+    for i, w in enumerate(words):
+        if len(line) + len(w) > _ELEM_W and len(line) > len(_HANG):
+            out.append(line.rstrip())
+            line = _HANG
+        line += w
+        if i != last:
+            line += " "
+    out.append(line)
+    return "[" + "\n".join(out)[1:] + "]"
+
+
+def format_rows(rows: np.ndarray) -> List[str]:
+    """np.array2string for each row of [N, F], byte-identical, fast.
+
+    Vectorized eligibility: a row takes the fast path iff every value is
+    finite and the positional format applies (no exponential trigger).
+    Everything else — and any session with non-default printoptions —
+    formats through numpy itself."""
+    rows = np.asarray(rows)
+    if rows.ndim != 2 or rows.dtype.kind != "f" or \
+            not _options_are_default():
+        return [np.array2string(r) for r in rows]
+
+    finite = np.isfinite(rows).all(axis=1)
+    absd = np.abs(rows.astype(np.float64))
+    nz = np.where(absd > 0, absd, np.nan)
+    with np.errstate(invalid="ignore", divide="ignore", over="ignore"), \
+            warnings.catch_warnings():
+        # all-zero rows are legitimately all-NaN here; has_nz handles them
+        warnings.simplefilter("ignore", RuntimeWarning)
+        mx = np.nanmax(nz, axis=1)
+        mn = np.nanmin(nz, axis=1)
+        has_nz = ~np.isnan(mx)
+        exp = has_nz & ((mx >= 1e8) | (mn < 1e-4) | (mx / mn > 1000.0))
+    fast = finite & ~exp
+
+    return [
+        _format_fast_row(row) if ok else np.array2string(row)
+        for row, ok in zip(rows, fast)
+    ]

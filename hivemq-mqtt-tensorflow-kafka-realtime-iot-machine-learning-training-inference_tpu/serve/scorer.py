@@ -26,6 +26,7 @@ from ..obs import metrics as obs_metrics
 from ..data.dataset import SensorBatches
 from ..stream.producer import OutputSequence
 from ..train.loop import make_eval_step
+from .fastfmt import format_rows
 
 
 def format_prediction(row: np.ndarray) -> str:
@@ -110,11 +111,23 @@ class StreamScorer:
         # per-row reconstruction error over every non-batch axis
         err_axes = tuple(range(2, preds.ndim))
         errs = np.mean(np.square(preds - xs), axis=err_axes)  # [S, B]
+        # one vectorized formatting pass over every valid row in the
+        # super-batch (byte-identical to np.array2string per row — the
+        # serve bottleneck, see fastfmt)
+        flat = preds.reshape((S * B,) + preds.shape[2:])
+        valid_rows = np.concatenate(
+            [flat[k * B: k * B + b.n_valid] for k, b in enumerate(bs)])
+        if valid_rows.ndim == 2:
+            msgs = format_rows(valid_rows)
+        else:  # windowed/LSTM rows are [T, F]: 2-D payloads, numpy formats
+            msgs = [format_prediction(r) for r in valid_rows]
+        mi = 0
         for k, b in enumerate(bs):
-            pred, err = preds[k], errs[k]
+            err = errs[k]
             for i in range(b.n_valid):
                 idx = base + b.first_index + i
-                msg = format_prediction(pred[i])
+                msg = msgs[mi]
+                mi += 1
                 if self.threshold is not None:
                     verdict = "anomaly" if err[i] > self.threshold else "normal"
                     msg = f"{msg}|{verdict}|{err[i]:.6f}"

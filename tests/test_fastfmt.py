@@ -1,0 +1,94 @@
+"""fastfmt must be byte-identical to np.array2string — always.
+
+The payload format is a wire contract (reference OutputCallback payloads,
+cardata-v3.py:247); a single divergent byte breaks downstream consumers.
+These tests hammer the fast path with the shapes the scorer produces and
+with adversarial inputs that must trigger the numpy fallback."""
+
+import numpy as np
+import pytest
+
+from iotml.serve.fastfmt import format_rows
+
+
+def _check(rows):
+    got = format_rows(rows)
+    want = [np.array2string(r) for r in rows]
+    for g, w, r in zip(got, want, rows):
+        assert g == w, f"mismatch for {r!r}:\n fast={g!r}\n  np ={w!r}"
+
+
+def test_typical_prediction_rows():
+    rng = np.random.default_rng(0)
+    _check(rng.uniform(-1, 1, (500, 18)).astype(np.float32))
+
+
+def test_relu_outputs_with_exact_zeros():
+    rng = np.random.default_rng(1)
+    x = np.maximum(rng.normal(size=(200, 18)), 0.0).astype(np.float32)
+    _check(x)
+
+
+def test_wide_and_narrow_rows():
+    rng = np.random.default_rng(2)
+    for f in (1, 2, 5, 30, 64):
+        _check(rng.uniform(-5, 5, (50, f)).astype(np.float32))
+
+
+def test_exponential_trigger_rows_fall_back():
+    rng = np.random.default_rng(3)
+    x = rng.uniform(-1, 1, (50, 18)).astype(np.float32)
+    x[::7, 3] = 3e-05          # tiny → exp format
+    x[::11, 5] = 2.5e9         # huge → exp format
+    x[::13, 7] = 1.0           # ratio trigger rows
+    x[::13, 8] = 2000.0
+    _check(x)
+
+
+def test_nonfinite_rows_fall_back():
+    x = np.ones((8, 6), np.float32)
+    x[1, 2] = np.nan
+    x[3, 4] = np.inf
+    x[5, 0] = -np.inf
+    _check(x)
+
+
+def test_adversarial_magnitudes():
+    rng = np.random.default_rng(4)
+    vals = np.array([0.0, 1e-4, 9.9e-5, 1e8 - 1, 1e8, -0.5, 123.456,
+                     0.1, 1/3, 2/3, 1e3, 999.0, 1001.0], np.float64)
+    for _ in range(50):
+        row = rng.choice(vals, size=rng.integers(1, 20))
+        _check(row[None, :])
+    _check(vals[None, :].astype(np.float32))
+
+
+def test_float64_rows():
+    rng = np.random.default_rng(5)
+    _check(rng.normal(size=(100, 12)))
+
+
+def test_non_default_printoptions_fall_back():
+    rng = np.random.default_rng(6)
+    x = rng.uniform(-1, 1, (5, 8)).astype(np.float32)
+    with np.printoptions(precision=3):
+        _check(x)
+
+
+def test_integer_valued_floats():
+    _check(np.array([[0.0, 1.0, 2.0, 100.0, -3.0]], np.float32))
+    _check(np.zeros((3, 18), np.float32))
+
+
+def test_fast_path_is_actually_fast():
+    import time
+
+    rng = np.random.default_rng(7)
+    rows = rng.uniform(-1, 1, (2000, 18)).astype(np.float32)
+    t0 = time.perf_counter()
+    format_rows(rows)
+    fast = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    [np.array2string(r) for r in rows]
+    base = time.perf_counter() - t0
+    assert fast < base, f"fast path slower than numpy: {fast} vs {base}"
