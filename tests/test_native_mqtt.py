@@ -1,0 +1,195 @@
+"""Native MQTT ingest engine (cpp/mqtt_ingest.cc) — protocol behavior,
+payload parity with the Python fronts, and fan-in at connection count.
+
+The engine is ingest-only (SURVEY L2's HiveMQ role for this pipeline:
+absorb fleet publishes, hand payloads to the Kafka extension); full
+broker semantics stay on the Python fronts."""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from iotml.mqtt.wire import (CONNACK, PUBACK, SUBACK, MqttClient,
+                             connect_packet, publish_packet,
+                             subscribe_packet)
+from iotml.stream.broker import Broker
+
+pytest.importorskip("ctypes")
+native_ingest = pytest.importorskip("iotml.mqtt.native_ingest")
+try:
+    _probe = native_ingest.NativeMqttIngest()
+    _probe.close()
+except Exception:  # no toolchain → the pure-Python fronts remain
+    pytest.skip("native stream engine unavailable", allow_module_level=True)
+
+
+class _Pump:
+    """Background poller: the engine only processes events inside poll(),
+    so anything that waits for a server response needs one running."""
+
+    def __init__(self, ing):
+        self.ing = ing
+        self.got = []
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self.got.extend(self.ing.poll(timeout_ms=20))
+
+    def stop(self):
+        self._stop.set()
+        self._t.join(timeout=5)
+
+
+def test_connect_publish_qos0_and_1():
+    with native_ingest.NativeMqttIngest() as ing:
+        pump = _Pump(ing)
+        try:
+            c = MqttClient("127.0.0.1", ing.port, "car-1")
+            c.publish("vehicles/sensor/data/car-1", b"p0", qos=0)
+            c.publish("vehicles/sensor/data/car-1", b"p1", qos=1)  # waits PUBACK
+            deadline = time.time() + 5
+            while len(pump.got) < 2 and time.time() < deadline:
+                time.sleep(0.02)
+            assert [(t.decode(), p) for t, p in pump.got] == [
+                ("vehicles/sensor/data/car-1", b"p0"),
+                ("vehicles/sensor/data/car-1", b"p1")]
+            c.disconnect()
+        finally:
+            pump.stop()
+
+
+def test_mqtt5_publish_with_properties():
+    with native_ingest.NativeMqttIngest() as ing:
+        pump = _Pump(ing)
+        try:
+            c = MqttClient("127.0.0.1", ing.port, "v5car", protocol_level=5)
+            c.publish("vehicles/sensor/data/v5car", b"v5payload", qos=1)
+            deadline = time.time() + 5
+            while not pump.got and time.time() < deadline:
+                time.sleep(0.02)
+            assert pump.got == [(b"vehicles/sensor/data/v5car", b"v5payload")]
+            c.disconnect()
+        finally:
+            pump.stop()
+
+
+def test_subscribe_refused_with_failure_code():
+    with native_ingest.NativeMqttIngest() as ing:
+        pump = _Pump(ing)
+        try:
+            c = MqttClient("127.0.0.1", ing.port, "nosub")
+            with pytest.raises(ValueError, match="rejected"):
+                c.subscribe("vehicles/#", qos=0)
+            c.disconnect()
+        finally:
+            pump.stop()
+
+
+def test_qos2_publish_drops_connection():
+    with native_ingest.NativeMqttIngest() as ing:
+        s = socket.create_connection(("127.0.0.1", ing.port), timeout=5)
+        s.sendall(connect_packet("q2"))
+        s.settimeout(5)
+        buf = b""
+        while len(buf) < 4:
+            ing.poll(timeout_ms=20)
+            try:
+                buf += s.recv(4 - len(buf))
+            except socket.timeout:
+                pass
+        assert buf[0] >> 4 == CONNACK
+        s.sendall(publish_packet("t", b"x", qos=2, packet_id=1))
+        for _ in range(20):
+            ing.poll(timeout_ms=20)
+        assert s.recv(16) == b""  # dropped
+        s.close()
+
+
+def test_malformed_frame_drops_only_that_connection():
+    with native_ingest.NativeMqttIngest() as ing:
+        pump = _Pump(ing)
+        try:
+            bad = socket.create_connection(("127.0.0.1", ing.port),
+                                           timeout=5)
+            bad.sendall(b"\x30\xff\xff\xff\xff\xff")  # malformed varint
+            bad.settimeout(5)
+            assert bad.recv(16) == b""
+            bad.close()
+            # engine still serves others
+            c = MqttClient("127.0.0.1", ing.port, "fine")
+            c.publish("t/a", b"ok", qos=1)
+            c.disconnect()
+        finally:
+            pump.stop()
+
+
+def test_bridge_parity_and_filtering():
+    """NativeIngestBridge forwards the same record shape KafkaBridge does
+    and drops non-matching topics."""
+    stream = Broker()
+    with native_ingest.NativeIngestBridge(stream, partitions=2) as bridge:
+        c = MqttClient("127.0.0.1", bridge.port, "car-9")
+        c.publish("vehicles/sensor/data/car-9", b'{"v":1}', qos=1)
+        c.publish("other/topic", b"nope", qos=1)
+        c.publish("vehicles/sensor/data/car-9", b'{"v":2}', qos=0)
+        deadline = time.time() + 10
+        while bridge.forwarded() < 2 and time.time() < deadline:
+            time.sleep(0.02)
+        c.disconnect()
+    assert bridge.forwarded() == 2
+    msgs = []
+    for p in range(2):
+        msgs.extend(stream.fetch("sensor-data", p, 0, 100))
+    assert sorted(m.value for m in msgs) == [b'{"v":1}', b'{"v":2}']
+    assert all(m.key == b"vehicles/sensor/data/car-9" for m in msgs)
+
+
+def test_many_connections_fanin_native():
+    n_conns, per_conn = 300, 30
+    stream = Broker()
+    with native_ingest.NativeIngestBridge(stream, partitions=4) as bridge:
+        barrier = threading.Barrier(n_conns)
+        errors = []
+
+        def run(i):
+            try:
+                s = socket.create_connection(("127.0.0.1", bridge.port),
+                                             timeout=10)
+                s.sendall(connect_packet(f"car-{i:05d}"))
+                buf = b""
+                while len(buf) < 4:
+                    chunk = s.recv(4 - len(buf))
+                    if not chunk:
+                        raise ConnectionError("EOF before CONNACK")
+                    buf += chunk
+                barrier.wait(timeout=60)
+                pkt = publish_packet(f"vehicles/sensor/data/car-{i:05d}",
+                                     b"{}", qos=0)
+                for _ in range(per_conn):
+                    s.sendall(pkt)
+                s.close()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                try:
+                    barrier.abort()
+                except Exception:
+                    pass
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(n_conns)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors[:3]
+        want = n_conns * per_conn
+        deadline = time.time() + 30
+        while bridge.forwarded() < want and time.time() < deadline:
+            time.sleep(0.05)
+        assert bridge.forwarded() == want
