@@ -7,11 +7,13 @@ Reference baselines (BASELINE.md):
 - fleet ingest: the full scenario is 100k MQTT clients at 1 msg/10 s ⇒
   ≈10,000 msgs/s fleet-wide steady state (scenario.xml:13-14,48-49).
 
-Five benches, each a JSON line on stdout (the headline metric is printed
+Six benches, each a JSON line on stdout (the headline metric is printed
 LAST so line-oriented consumers keep finding it):
 
   fleet_ingest_msgs_per_sec        raw-socket MQTT fleet → epoll listener →
                                    Kafka bridge → stream topic (L1→L3)
+  fleet_ingest_native_msgs_per_sec the same fleet through the C++ ingest
+                                   engine (cpp/mqtt_ingest.cc)
   wire_train_records_per_sec_per_chip
                                    the SAME train job as the headline, but
                                    over the TCP Kafka wire protocol with the
@@ -327,7 +329,31 @@ def _drive_fleet(port, n_conns, duration, payload, forwarded_fn, conns_fn,
         target=_fleet_worker,
         args=(port, slices[w], payload, stop, counts, w, barrier, errors),
         daemon=True) for w in range(n_workers)]
-    rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+
+    # ru_maxrss is a LIFETIME high-water mark — after the compute benches
+    # it is already at peak and the delta would read ~0.  Sample current
+    # VmRSS during THIS window instead.
+    def _vm_rss_kb() -> int:
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS"):
+                        return int(line.split()[1])
+        except OSError:
+            pass
+        return 0
+
+    rss0 = _vm_rss_kb()
+    rss_peak = [rss0]
+    rss_stop = threading.Event()
+
+    def _rss_sampler():
+        while not rss_stop.is_set():
+            rss_peak[0] = max(rss_peak[0], _vm_rss_kb())
+            time.sleep(0.1)
+
+    rss_thread = threading.Thread(target=_rss_sampler, daemon=True)
+    rss_thread.start()
     t_setup = time.perf_counter()
     for t in threads:
         t.start()
@@ -356,7 +382,9 @@ def _drive_fleet(port, n_conns, duration, payload, forwarded_fn, conns_fn,
         time.sleep(0.05)
     drain_s = time.perf_counter() - t_drain
     forwarded = forwarded_fn()
-    rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    rss_stop.set()
+    rss_thread.join(timeout=2)
+    rss1 = rss_peak[0]
     in_stream = sum(stream.end_offset("sensor-data", p)
                     for p in range(partitions))
     out = dict(value=forwarded / (elapsed + drain_s), n_conns=live_conns,
@@ -437,46 +465,52 @@ def bench_fleet_ingest_native():
 def main():
     t_all = time.perf_counter()
 
-    fleet = bench_fleet_ingest()
-    v = fleet.pop("value")
-    _emit("fleet_ingest_msgs_per_sec", v, "msgs/s",
-          v / FLEET_BASELINE_MPS, **fleet)
-
+    # Execution order ≠ print order: the compute benches run FIRST (clean
+    # allocator/process state — the fleet benches churn GBs of message
+    # objects that fragment the heap and depress later timings), but the
+    # headline metric still PRINTS last for line-oriented consumers.
+    # Results are recorded as each bench completes and flushed in the
+    # finally block, so a late bench failure cannot discard the metrics
+    # already measured.
+    results = {}
+    order = [
+        ("fleet_ingest_msgs_per_sec", "msgs/s", FLEET_BASELINE_MPS),
+        ("fleet_ingest_native_msgs_per_sec", "msgs/s", FLEET_BASELINE_MPS),
+        ("wire_train_records_per_sec_per_chip", "records/s",
+         TRAIN_BASELINE_RPS),
+        # no reference twin for long context (its only sequence mechanism
+        # is an LSTM at look_back=1): vs_baseline deliberately 0
+        ("flash_attention_fwd_bwd_tokens_per_sec", "tokens/s", None),
+        # serve compares against the same measured reference job rate —
+        # its predict pod scores the identical 10k-record slice per cycle
+        # (cardata-v3.py:269-274)
+        ("serve_rows_per_sec", "rows/s", TRAIN_BASELINE_RPS),
+        ("streaming_train_records_per_sec_per_chip", "records/s",
+         TRAIN_BASELINE_RPS),
+    ]
     try:
-        nfleet = bench_fleet_ingest_native()
-    except Exception as e:  # no toolchain: the Python front remains
-        print(f"# fleet_ingest_native skipped: {e}", file=sys.stderr)
-    else:
-        v = nfleet.pop("value")
-        _emit("fleet_ingest_native_msgs_per_sec", v, "msgs/s",
-              v / FLEET_BASELINE_MPS, **nfleet)
-
-    wire = bench_train_wire()
-    v = wire.pop("value")
-    _emit("wire_train_records_per_sec_per_chip", v, "records/s",
-          v / TRAIN_BASELINE_RPS, **wire)
-
-    lc = bench_long_context()
-    v = lc.pop("value")
-    # no reference twin exists (its only sequence mechanism is an LSTM at
-    # look_back=1); vs_baseline deliberately 0 — the metric records the
-    # long-context capability, not a speedup over the reference
-    _emit("flash_attention_fwd_bwd_tokens_per_sec", v, "tokens/s", 0.0, **lc)
-
-    serve = bench_serve()
-    v = serve.pop("value")
-    # the serve baseline is the same measured reference job rate — its
-    # predict pod scores the identical 10k-record slice per cycle
-    # (cardata-v3.py:269-274)
-    _emit("serve_rows_per_sec", v, "rows/s", v / TRAIN_BASELINE_RPS, **serve)
-
-    inproc = bench_train_inproc()
-    v = inproc.pop("value")
-    _emit("streaming_train_records_per_sec_per_chip", v, "records/s",
-          v / TRAIN_BASELINE_RPS, **inproc)
-
-    print(f"# total_bench_wall={time.perf_counter() - t_all:.1f}s",
-          file=sys.stderr)
+        results["streaming_train_records_per_sec_per_chip"] = \
+            bench_train_inproc()
+        results["wire_train_records_per_sec_per_chip"] = bench_train_wire()
+        results["flash_attention_fwd_bwd_tokens_per_sec"] = \
+            bench_long_context()
+        results["serve_rows_per_sec"] = bench_serve()
+        results["fleet_ingest_msgs_per_sec"] = bench_fleet_ingest()
+        try:
+            results["fleet_ingest_native_msgs_per_sec"] = \
+                bench_fleet_ingest_native()
+        except Exception as e:  # no toolchain: the Python front remains
+            print(f"# fleet_ingest_native skipped: {e}", file=sys.stderr)
+    finally:
+        for metric, unit, baseline in order:
+            res = results.get(metric)
+            if res is None:
+                continue
+            v = res.pop("value")
+            _emit(metric, v, unit,
+                  (v / baseline) if baseline else 0.0, **res)
+        print(f"# total_bench_wall={time.perf_counter() - t_all:.1f}s",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -16,8 +16,8 @@ group; per-host stream consumers for the data plane):
 4. assert the loss DECREASES and print a `MULTIHOST ... ok` line the
    spawner greps.
 
-The spawner (tests/test_multihost.py, or dryrun_multichip with
-IOTML_DRYRUN_MULTIHOST=1) must set JAX_PLATFORMS=cpu and
+The spawner (tests/test_multihost.py, or dryrun_multichip — on by
+default, IOTML_DRYRUN_MULTIHOST=0 opts out) must set JAX_PLATFORMS=cpu and
 XLA_FLAGS=--xla_force_host_platform_device_count=<local devices> in the
 child environment BEFORE this module imports jax.
 """
