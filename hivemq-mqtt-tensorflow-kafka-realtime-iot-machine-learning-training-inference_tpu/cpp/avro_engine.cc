@@ -77,11 +77,17 @@ extern "C" {
 //                row-major [n_msgs x n_strings] with the given stride.
 // Returns number of rows decoded; a malformed message stops decoding and
 // returns the negative of (rows_ok + 1) so callers can pinpoint it.
-int64_t iotml_decode_batch(const uint8_t* blob, const int64_t* offsets,
-                           int64_t n_msgs, const int8_t* types,
-                           const uint8_t* nullable, int64_t n_fields,
-                           int64_t strip, double* out_numeric,
-                           char* out_labels, int64_t label_stride) {
+int64_t iotml_decode_batch_nulls(const uint8_t* blob,
+                                 const int64_t* offsets, int64_t n_msgs,
+                                 const int8_t* types,
+                                 const uint8_t* nullable, int64_t n_fields,
+                                 int64_t strip, double* out_numeric,
+                                 char* out_labels, int64_t label_stride,
+                                 uint8_t* out_nulls) {
+  // out_nulls: optional [n_msgs * n_fields] bitmap (1 = the nullable
+  // union chose the null branch).  The columnar outputs cannot represent
+  // null distinctly (numeric null -> 0.0, string null -> ""), so callers
+  // needing exact null semantics check the bitmap and fall back.
   // Precompute per-field output slot (numeric col or string col).
   int64_t n_numeric = 0, n_strings = 0;
   for (int64_t f = 0; f < n_fields; ++f) {
@@ -103,6 +109,7 @@ int64_t iotml_decode_batch(const uint8_t* blob, const int64_t* offsets,
         if (pos < 0) return -(i + 1);
         is_null = (branch == 0);
       }
+      if (out_nulls) out_nulls[i * n_fields + f] = is_null ? 1 : 0;
       switch (types[f]) {
         case F_FLOAT: {
           double v = 0.0;
@@ -167,6 +174,16 @@ int64_t iotml_decode_batch(const uint8_t* blob, const int64_t* offsets,
     }
   }
   return n_msgs;
+}
+
+int64_t iotml_decode_batch(const uint8_t* blob, const int64_t* offsets,
+                           int64_t n_msgs, const int8_t* types,
+                           const uint8_t* nullable, int64_t n_fields,
+                           int64_t strip, double* out_numeric,
+                           char* out_labels, int64_t label_stride) {
+  return iotml_decode_batch_nulls(blob, offsets, n_msgs, types, nullable,
+                                  n_fields, strip, out_numeric, out_labels,
+                                  label_stride, nullptr);
 }
 
 // Encode n_msgs records from columnar input (the decode layout in reverse).

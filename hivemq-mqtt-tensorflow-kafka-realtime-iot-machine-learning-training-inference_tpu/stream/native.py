@@ -71,6 +71,8 @@ def load() -> Optional[ctypes.CDLL]:
     try:
         lib = ctypes.CDLL(_SO_PATH)
         lib.iotml_decode_batch.restype = ctypes.c_int64
+        if hasattr(lib, "iotml_decode_batch_nulls"):
+            lib.iotml_decode_batch_nulls.restype = ctypes.c_int64
         lib.iotml_encode_batch.restype = ctypes.c_int64
         lib.iotml_engine_version.restype = ctypes.c_int64
         if lib.iotml_engine_version() < ENGINE_VERSION:
@@ -105,23 +107,20 @@ class NativeCodec:
             raise RuntimeError("native stream engine unavailable")
 
     # ------------------------------------------------------------- decode
-    def decode_batch(self, messages: List[bytes], strip: int = 0
-                     ) -> Tuple[np.ndarray, np.ndarray]:
-        """→ (numeric [n, n_numeric] float64, labels [n, n_strings] '<U15').
-
-        Numeric columns are the schema's non-string fields in order — for
-        the car schemas that is exactly the 18-sensor matrix.
-        """
+    def _decode_impl(self, messages: List[bytes], strip: int,
+                     stride: int, want_nulls: bool):
         n = len(messages)
         if n == 0:
-            return (np.zeros((0, self.n_numeric)),
-                    np.zeros((0, self.n_strings), f"S{LABEL_STRIDE}"))
+            empty = (np.zeros((0, self.n_numeric)),
+                     np.zeros((0, self.n_strings), f"S{stride}"))
+            return empty + ((np.zeros((0, self.n_fields), np.uint8),)
+                            if want_nulls else ())
         blob = b"".join(messages)
         offsets = np.zeros((n + 1,), np.int64)
         np.cumsum([len(m) for m in messages], out=offsets[1:])
         numeric = np.empty((n, self.n_numeric), np.float64)
-        labels = np.zeros((n, max(self.n_strings, 1)), f"S{LABEL_STRIDE}")
-        rc = self._lib.iotml_decode_batch(
+        labels = np.zeros((n, max(self.n_strings, 1)), f"S{stride}")
+        args = [
             ctypes.c_char_p(blob),
             offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             ctypes.c_int64(n),
@@ -131,27 +130,57 @@ class NativeCodec:
             ctypes.c_int64(strip),
             numeric.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
             labels.ctypes.data_as(ctypes.c_char_p),
-            ctypes.c_int64(LABEL_STRIDE))
+            ctypes.c_int64(stride),
+        ]
+        if want_nulls:
+            nulls = np.zeros((n, self.n_fields), np.uint8)
+            args.append(nulls.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+            rc = self._lib.iotml_decode_batch_nulls(*args)
+        else:
+            rc = self._lib.iotml_decode_batch(*args)
         if rc != n:
             raise ValueError(f"malformed Avro message at row {-rc - 1}")
-        return numeric, labels[:, : self.n_strings]
+        out = (numeric, labels[:, : self.n_strings])
+        return out + ((nulls,) if want_nulls else ())
+
+    def decode_batch(self, messages: List[bytes], strip: int = 0,
+                     stride: int = LABEL_STRIDE
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+        """→ (numeric [n, n_numeric] float64, labels [n, n_strings]).
+
+        Numeric columns are the schema's non-string fields in order — for
+        the car schemas that is exactly the 18-sensor matrix.
+        """
+        return self._decode_impl(messages, strip, stride, want_nulls=False)
+
+    def decode_batch_nulls(self, messages: List[bytes], strip: int = 0,
+                           stride: int = LABEL_STRIDE):
+        """decode_batch + per-field null bitmap [n, n_fields] (uint8).
+
+        The columnar outputs cannot represent a null union distinctly
+        (numeric null → 0.0, string null → ""); exact-semantics callers
+        check the bitmap and fall back when any null is present."""
+        if not hasattr(self._lib, "iotml_decode_batch_nulls"):
+            raise RuntimeError("engine too old for null bitmaps")
+        return self._decode_impl(messages, strip, stride, want_nulls=True)
 
     # ------------------------------------------------------------- encode
     def encode_batch(self, numeric: np.ndarray, labels: Optional[np.ndarray],
-                     schema_id: int = -1) -> List[bytes]:
+                     schema_id: int = -1,
+                     stride: int = LABEL_STRIDE) -> List[bytes]:
         """Columnar rows → list of (optionally framed) Avro messages."""
         numeric = np.ascontiguousarray(numeric, np.float64)
         n = numeric.shape[0]
         if labels is None:
-            labels = np.zeros((n, self.n_strings), f"S{LABEL_STRIDE}")
-        labels = np.ascontiguousarray(labels.astype(f"S{LABEL_STRIDE}"))
-        cap = n * (5 + self.n_fields * 20 + self.n_strings * LABEL_STRIDE) + 64
+            labels = np.zeros((n, self.n_strings), f"S{stride}")
+        labels = np.ascontiguousarray(labels.astype(f"S{stride}"))
+        cap = n * (5 + self.n_fields * 20 + self.n_strings * stride) + 64
         out = np.empty((cap,), np.uint8)
         offsets = np.zeros((n + 1,), np.int64)
         total = self._lib.iotml_encode_batch(
             numeric.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
             labels.ctypes.data_as(ctypes.c_char_p),
-            ctypes.c_int64(LABEL_STRIDE),
+            ctypes.c_int64(stride),
             ctypes.c_int64(n),
             self.types.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
             self.nullable.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),

@@ -422,6 +422,102 @@ class SourceMeta:
                 "fields": [{"name": n, "type": k} for n, k in self.columns]}
 
 
+class _NativeAvroSource:
+    """Batch AVRO source decode through the C++ engine.
+
+    The pure-python decoder dominates REKEY/CTAS cost; this decodes a whole
+    poll columnar-natively and rebuilds records with exact python types
+    (ints stay ints, booleans stay bools).  Conservative fallbacks keep
+    python-decode semantics authoritative — the whole batch takes the
+    per-message python path when: the native decode errors, any nullable
+    union chose its null branch (python decodes those as None; the
+    columnar layout cannot represent that), any string sits at the stride
+    limit (possible truncation) or is not valid ASCII/UTF-8 for numpy's
+    U-cast, or any int/long exceeds the float64-exact range (2^53)."""
+
+    STRIDE = 64
+    INT_EXACT = 2 ** 53
+
+    def __init__(self, schema):
+        from ..stream.native import NativeCodec
+
+        self.codec = NativeCodec(schema)
+        if not hasattr(self.codec._lib, "iotml_decode_batch_nulls"):
+            # probe ONCE: a stale engine without the null bitmap would
+            # otherwise raise-and-fall-back on every single batch
+            raise RuntimeError("engine lacks null-bitmap decode")
+
+        def conv_for(avro_type):
+            if avro_type in ("int", "long"):
+                return int
+            if avro_type == "boolean":
+                return bool
+            return float
+        self.numeric = [(f.name, conv_for(f.avro_type))
+                        for f in schema.fields if f.avro_type != "string"]
+        # columns needing the 2^53 exactness guard (float64 round-trip)
+        self._int_cols = [i for i, (_, conv) in enumerate(self.numeric)
+                          if conv is int]
+        self.strings = [f.name for f in schema.fields
+                        if f.avro_type == "string"]
+
+    def decode(self, messages) -> Optional[list]:
+        """→ list[dict] for the whole batch, or None → caller falls back."""
+        import numpy as np
+
+        try:
+            num, lab, nulls = self.codec.decode_batch_nulls(
+                [m.value for m in messages], strip=5, stride=self.STRIDE)
+            if nulls.any():
+                # null unions decode as None only on the python path
+                return None
+            if self._int_cols and (
+                    np.abs(num[:, self._int_cols]) >= self.INT_EXACT).any():
+                return None  # int/long beyond float64-exact range
+            num_l = num.tolist()
+            if self.strings:
+                lab_u = lab.astype("U")  # raises on non-ASCII bytes
+                if (np.char.str_len(lab_u) >= self.STRIDE - 1).any():
+                    return None  # possible truncation at the stride limit
+                lab_l = lab_u.tolist()
+            else:
+                lab_l = None
+        except (ValueError, TypeError, RuntimeError, UnicodeDecodeError):
+            return None
+        recs = []
+        for i, m in enumerate(messages):
+            rec = {}
+            for (name, conv), v in zip(self.numeric, num_l[i]):
+                rec[name] = conv(v)
+            if lab_l is not None:
+                for name, v in zip(self.strings, lab_l[i]):
+                    rec[name] = v
+            rec["ROWKEY"] = (m.key or b"").decode(errors="replace")
+            rec["ROWTIME"] = m.timestamp_ms
+            recs.append(rec)
+        return recs
+
+
+def _make_native_source(meta: SourceMeta):
+    if meta.value_format != "AVRO":
+        return None
+    try:
+        return _NativeAvroSource(meta.record_schema())
+    except Exception:
+        return None
+
+
+def _decode_batch(meta: SourceMeta, codec: Optional[AvroCodec],
+                  native: Optional[_NativeAvroSource],
+                  messages) -> list:
+    """→ list[Optional[dict]] aligned with messages (None = poisoned)."""
+    if native is not None:
+        recs = native.decode(messages)
+        if recs is not None:
+            return recs
+    return [_decode_record(meta, codec, m) for m in messages]
+
+
 def _decode_record(meta: SourceMeta, codec: Optional[AvroCodec],
                    m: Message) -> Optional[dict]:
     """Message → dict keyed by upper-case column name (+ pseudo-columns)."""
@@ -485,13 +581,31 @@ class SqlSelectTask(StreamTask):
         self.stmt = stmt
         self.src_codec = (AvroCodec(src_meta.record_schema())
                           if src_meta.value_format == "AVRO" else None)
+        self._native_src = _make_native_source(src_meta)
         self.sink_codec = None
         self.sink_schema_id = None
+        self._native_sink = None
         if sink_meta.value_format == "AVRO":
             schema = sink_meta.record_schema()
             self.sink_codec = AvroCodec(schema)
             self.sink_schema_id = registry.register(
                 subject_for_topic(sink_meta.topic), schema.avro_json())
+            # native batch encode (C++ engine): the pure-python zigzag
+            # encoder dominates CSAS cost; byte-identical per
+            # tests/test_sql.py::test_csas_native_encode_byte_parity
+            try:
+                from ..stream.native import NativeCodec
+
+                self._native_sink = NativeCodec(schema)
+                self._label_stride = _NativeAvroSource.STRIDE
+                self._sink_numeric = [f.name for f in schema.fields
+                                      if f.avro_type != "string"]
+                self._sink_strings = [f.name for f in schema.fields
+                                      if f.avro_type == "string"]
+                self._sink_ints = [f.name for f in schema.fields
+                                   if f.avro_type in ("int", "long")]
+            except Exception:
+                self._native_sink = None
 
     def _project(self, rec: dict) -> Optional[dict]:
         out = {}
@@ -506,10 +620,52 @@ class SqlSelectTask(StreamTask):
                     return None  # NULL in arithmetic / div-by-zero: drop row
         return out
 
+    def _encode_avro_rows(self, rows):
+        """rows → framed Avro values; native columnar batch when eligible.
+
+        Eligibility is value-dependent: no None values (the python codec's
+        null-union branch) and every string short enough for the native
+        engine's fixed label stride.  Ineligible batches take the python
+        codec row-by-row — output bytes are identical either way."""
+        if self._native_sink is not None and rows:
+            import numpy as np
+
+            # strings checked BEFORE building the S-dtype array (it would
+            # silently truncate long values rather than fail)
+            ok = all(isinstance(row.get(n), str)
+                     and len(row[n]) < self._label_stride
+                     for row in rows for n in self._sink_strings)
+            if ok and self._sink_ints:
+                # int/long ride a float64 matrix: beyond 2^53 the round
+                # trip is lossy — python codec keeps exactness
+                lim = _NativeAvroSource.INT_EXACT
+                ok = all(isinstance(row.get(n), (int, float))
+                         and abs(row[n]) < lim
+                         for row in rows for n in self._sink_ints)
+            if ok:
+                try:
+                    num = np.array(
+                        [[row[n] for n in self._sink_numeric]
+                         for row in rows], np.float64)
+                    labels = np.array(
+                        [[row[n] for n in self._sink_strings]
+                         for row in rows],
+                        dtype=f"S{self._label_stride}") if \
+                        self._sink_strings else None
+                    return self._native_sink.encode_batch(
+                        num, labels, schema_id=self.sink_schema_id,
+                        stride=self._label_stride)
+                except (TypeError, ValueError, KeyError):
+                    pass  # None/odd values: python codec handles the unions
+        return [frame(self.sink_codec.encode(
+            {n: row.get(n) for n, _ in self.sink_meta.columns}),
+            self.sink_schema_id) for row in rows]
+
     def process(self, messages):
-        out = []
-        for m in messages:
-            rec = _decode_record(self.src_meta, self.src_codec, m)
+        picked = []  # (key, row, timestamp) per surviving record
+        recs = _decode_batch(self.src_meta, self.src_codec,
+                             self._native_src, messages)
+        for m, rec in zip(messages, recs):
             if rec is None:
                 continue  # poisoned message: drop, don't halt (KSQL DLQ-ish)
             if self.stmt.where is not None:
@@ -526,16 +682,19 @@ class SqlSelectTask(StreamTask):
                 key = str(kv).encode() if kv is not None else m.key
             else:
                 key = m.key
-            if self.sink_meta.value_format == "AVRO":
-                enc = {n: row.get(n) for n, _ in self.sink_meta.columns}
-                val = frame(self.sink_codec.encode(enc), self.sink_schema_id)
-            elif self.sink_meta.value_format == "DELIMITED":
-                val = ",".join("" if row.get(n) is None else str(row[n])
-                               for n, _ in self.sink_meta.columns).encode()
-            else:
-                val = json.dumps(row, default=str).encode()
-            out.append((key, val, m.timestamp_ms))
-        return out
+            picked.append((key, row, m.timestamp_ms))
+        if not picked:
+            return []
+        if self.sink_meta.value_format == "AVRO":
+            vals = self._encode_avro_rows([row for _, row, _ in picked])
+        elif self.sink_meta.value_format == "DELIMITED":
+            vals = [",".join("" if row.get(n) is None else str(row[n])
+                             for n, _ in self.sink_meta.columns).encode()
+                    for _, row, _ in picked]
+        else:
+            vals = [json.dumps(row, default=str).encode()
+                    for _, row, _ in picked]
+        return [(key, val, ts) for (key, _, ts), val in zip(picked, vals)]
 
 
 class SqlAggTask(StreamTask):
@@ -554,6 +713,7 @@ class SqlAggTask(StreamTask):
         self.stmt = stmt
         self.src_codec = (AvroCodec(src_meta.record_schema())
                           if src_meta.value_format == "AVRO" else None)
+        self._native_src = _make_native_source(src_meta)
         # (group_key, window_start) → {alias: accumulator}
         self.acc: Dict[tuple, dict] = {}
         # Restore changelog state only when this group has committed input
@@ -658,8 +818,9 @@ class SqlAggTask(StreamTask):
 
     def _process_chunk(self, messages, undo):
         touched = set()
-        for m in messages:
-            rec = _decode_record(self.src_meta, self.src_codec, m)
+        recs = _decode_batch(self.src_meta, self.src_codec,
+                             self._native_src, messages)
+        for m, rec in zip(messages, recs):
             if rec is None:
                 continue
             if self.stmt.where is not None:
