@@ -48,7 +48,7 @@ class Platform:
         from ..core.schema import CAR_SCHEMA, KSQL_CAR_SCHEMA
         from ..mqtt.bridge import KafkaBridge
         from ..mqtt.broker import MqttBroker
-        from ..mqtt.wire import MqttServer
+        from ..mqtt.eventserver import MqttEventServer
         from ..obs import metrics as obs_metrics
         from ..stream import Broker, SchemaRegistry, SchemaRegistryServer
         from ..stream.kafka_wire import KafkaWireServer
@@ -89,7 +89,11 @@ class Platform:
         self.mqtt_broker = MqttBroker()
         self.bridge = KafkaBridge(self.mqtt_broker, self.broker,
                                   partitions=partitions)
-        self.mqtt = MqttServer(self.mqtt_broker, host=host, port=mqtt_port)
+        # the epoll front: fleet-scale connection counts + HiveMQ-style
+        # overload protection (watermark backpressure, slow-consumer
+        # eviction) — same MqttProtocol semantics as the threaded server
+        self.mqtt = MqttEventServer(self.mqtt_broker, host=host,
+                                    port=mqtt_port)
 
         from ..obs.control_center import ControlCenter
 
@@ -194,8 +198,7 @@ class Platform:
             s.stop()
         self.kafka.shutdown()
         self.kafka.server_close()
-        self.mqtt.shutdown()
-        self.mqtt.server_close()
+        self.mqtt.stop()
         if self.metrics_server is not None:
             self.metrics_server.shutdown()
             self.metrics_server.server_close()

@@ -262,6 +262,6 @@ int64_t iotml_encode_batch(const double* numeric, const char* labels,
 
 // Bumped whenever the C ABI grows; stream/native.py rebuilds stale .so files
 // (version 2: + kafka wire client).
-int64_t iotml_engine_version() { return 2; }
+int64_t iotml_engine_version() { return 3; }
 
 }  // extern "C"

@@ -42,7 +42,7 @@ def _build() -> bool:
         return False
 
 
-ENGINE_VERSION = 2  # must match iotml_engine_version() in avro_engine.cc
+ENGINE_VERSION = 3  # must match iotml_engine_version() in avro_engine.cc
 
 
 def _stale() -> bool:
@@ -71,8 +71,7 @@ def load() -> Optional[ctypes.CDLL]:
     try:
         lib = ctypes.CDLL(_SO_PATH)
         lib.iotml_decode_batch.restype = ctypes.c_int64
-        if hasattr(lib, "iotml_decode_batch_nulls"):
-            lib.iotml_decode_batch_nulls.restype = ctypes.c_int64
+        lib.iotml_decode_batch_nulls.restype = ctypes.c_int64
         lib.iotml_encode_batch.restype = ctypes.c_int64
         lib.iotml_engine_version.restype = ctypes.c_int64
         if lib.iotml_engine_version() < ENGINE_VERSION:
@@ -159,9 +158,8 @@ class NativeCodec:
 
         The columnar outputs cannot represent a null union distinctly
         (numeric null → 0.0, string null → ""); exact-semantics callers
-        check the bitmap and fall back when any null is present."""
-        if not hasattr(self._lib, "iotml_decode_batch_nulls"):
-            raise RuntimeError("engine too old for null bitmaps")
+        check the bitmap and fall back when any null is present.  The
+        ENGINE_VERSION gate in load() guarantees the symbol exists."""
         return self._decode_impl(messages, strip, stride, want_nulls=True)
 
     # ------------------------------------------------------------- encode

@@ -433,7 +433,12 @@ class _NativeAvroSource:
     union chose its null branch (python decodes those as None; the
     columnar layout cannot represent that), any string sits at the stride
     limit (possible truncation) or is not valid ASCII/UTF-8 for numpy's
-    U-cast, or any int/long exceeds the float64-exact range (2^53)."""
+    U-cast, or any int/long exceeds the float64-exact range (2^53), or
+    any message lacks the Confluent magic byte (the python path's
+    unframe() treats those as poisoned).  Known narrow divergence: a
+    string with TRAILING NUL bytes decodes natively with them stripped
+    (numpy S-dtype semantics) — undetectable post-decode and accepted;
+    embedded NULs round-trip."""
 
     STRIDE = 64
     INT_EXACT = 2 ** 53
@@ -441,11 +446,7 @@ class _NativeAvroSource:
     def __init__(self, schema):
         from ..stream.native import NativeCodec
 
-        self.codec = NativeCodec(schema)
-        if not hasattr(self.codec._lib, "iotml_decode_batch_nulls"):
-            # probe ONCE: a stale engine without the null bitmap would
-            # otherwise raise-and-fall-back on every single batch
-            raise RuntimeError("engine lacks null-bitmap decode")
+        self.codec = NativeCodec(schema)  # version-gated: bitmap guaranteed
 
         def conv_for(avro_type):
             if avro_type in ("int", "long"):
@@ -465,6 +466,10 @@ class _NativeAvroSource:
         """→ list[dict] for the whole batch, or None → caller falls back."""
         import numpy as np
 
+        if any(m.value[:1] != b"\x00" for m in messages):
+            # python-path parity: unframe() rejects a non-zero magic byte
+            # as poisoned; a blind 5-byte strip would decode it instead
+            return None
         try:
             num, lab, nulls = self.codec.decode_batch_nulls(
                 [m.value for m in messages], strip=5, stride=self.STRIDE)
@@ -632,8 +637,10 @@ class SqlSelectTask(StreamTask):
 
             # strings checked BEFORE building the S-dtype array (it would
             # silently truncate long values rather than fail)
+            # NUL-free: the C++ encoder measures strings to the first NUL
             ok = all(isinstance(row.get(n), str)
                      and len(row[n]) < self._label_stride
+                     and "\x00" not in row[n]
                      for row in rows for n in self._sink_strings)
             if ok and self._sink_ints:
                 # int/long ride a float64 matrix: beyond 2^53 the round
