@@ -520,18 +520,25 @@ def main():
         ("streaming_train_records_per_sec_per_chip", "records/s",
          TRAIN_BASELINE_RPS),
     ]
+    import gc
+
+    def run(name, fn):
+        # a full collection between benches: each bench churns millions of
+        # objects, and leftover garbage measurably depresses the next
+        # bench's timings on this single-core box
+        gc.collect()
+        results[name] = fn()
+
     try:
-        results["streaming_train_records_per_sec_per_chip"] = \
-            bench_train_inproc()
-        results["wire_train_records_per_sec_per_chip"] = bench_train_wire()
-        results["flash_attention_fwd_bwd_tokens_per_sec"] = \
-            bench_long_context()
-        results["serve_rows_per_sec"] = bench_serve()
-        results["ksql_pipeline_records_per_sec"] = bench_ksql_pipeline()
-        results["fleet_ingest_msgs_per_sec"] = bench_fleet_ingest()
+        run("streaming_train_records_per_sec_per_chip", bench_train_inproc)
+        run("wire_train_records_per_sec_per_chip", bench_train_wire)
+        run("flash_attention_fwd_bwd_tokens_per_sec", bench_long_context)
+        run("serve_rows_per_sec", bench_serve)
+        run("ksql_pipeline_records_per_sec", bench_ksql_pipeline)
+        run("fleet_ingest_msgs_per_sec", bench_fleet_ingest)
         try:
-            results["fleet_ingest_native_msgs_per_sec"] = \
-                bench_fleet_ingest_native()
+            run("fleet_ingest_native_msgs_per_sec",
+                bench_fleet_ingest_native)
         except Exception as e:  # no toolchain: the Python front remains
             print(f"# fleet_ingest_native skipped: {e}", file=sys.stderr)
     finally:

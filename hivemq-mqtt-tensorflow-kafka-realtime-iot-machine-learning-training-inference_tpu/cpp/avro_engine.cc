@@ -260,8 +260,9 @@ int64_t iotml_encode_batch(const double* numeric, const char* labels,
   return pos;
 }
 
-// Bumped whenever the C ABI grows; stream/native.py rebuilds stale .so files
-// (version 2: + kafka wire client).
+// Bumped whenever the C ABI grows; stream/native.py rebuilds stale .so files.
+// ABI history: 1 = avro batch codec; 2 = + kafka wire client;
+// 3 = + iotml_decode_batch_nulls (null-bitmap decode)
 int64_t iotml_engine_version() { return 3; }
 
 }  // extern "C"

@@ -70,17 +70,19 @@ def load() -> Optional[ctypes.CDLL]:
         return None
     try:
         lib = ctypes.CDLL(_SO_PATH)
-        lib.iotml_decode_batch.restype = ctypes.c_int64
-        lib.iotml_decode_batch_nulls.restype = ctypes.c_int64
-        lib.iotml_encode_batch.restype = ctypes.c_int64
+        # version gate FIRST: touching a symbol a stale engine lacks would
+        # raise AttributeError before the check meant to reject it
         lib.iotml_engine_version.restype = ctypes.c_int64
         if lib.iotml_engine_version() < ENGINE_VERSION:
             # stale binary and the rebuild failed (or produced an old ABI):
             # treat as unavailable rather than risk missing symbols
             _lib = None
             return None
+        lib.iotml_decode_batch.restype = ctypes.c_int64
+        lib.iotml_decode_batch_nulls.restype = ctypes.c_int64
+        lib.iotml_encode_batch.restype = ctypes.c_int64
         _lib = lib
-    except OSError:
+    except (OSError, AttributeError):
         _lib = None
     return _lib
 
