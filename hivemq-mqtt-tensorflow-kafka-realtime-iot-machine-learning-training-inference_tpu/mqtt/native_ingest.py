@@ -152,15 +152,18 @@ class NativeIngestBridge:
         batch = self.ingest.poll(timeout_ms)
         if not batch:
             return 0
-        produce = self.stream.produce
-        dest = self.mapping.stream_topic
         ts = int(time.time() * 1000)
-        n = 0
-        for topic, payload in batch:
-            if self._matches(topic):
-                produce(dest, payload, key=topic, timestamp_ms=ts)
-                n += 1
-        if n:
+        matches = self._matches
+        entries = [(topic, payload, ts) for topic, payload in batch
+                   if matches(topic)]
+        n = len(entries)
+        if entries:
+            # bulk append under one broker lock — the per-message produce
+            # loop was this bridge's bottleneck once parsing went native.
+            # produce_many is the Broker duck-type contract (emulator,
+            # wire client, native client alike), so a real cluster swap
+            # stays a constructor change.
+            self.stream.produce_many(self.mapping.stream_topic, entries)
             self._n_fwd += n
             self._m_fwd.inc(n)
         return n

@@ -103,3 +103,28 @@ def test_output_sequence_orders_and_detects_gaps():
     seq.setitem(9, "x")
     with pytest.raises(ValueError, match="duplicate"):
         seq.setitem(9, "again")
+
+
+def test_produce_many_matches_per_message_produce():
+    """Bulk append must land records on the same partitions (key hash) and
+    apply the same retention trimming as produce()."""
+    from iotml.stream.broker import Broker
+
+    a, b = Broker(), Broker()
+    for br in (a, b):
+        br.create_topic("t", partitions=4, retention_messages=5)
+    entries = [(f"k{i % 7}".encode(), f"v{i}".encode(), 9)
+               for i in range(40)]
+    last = -1
+    for k, v, ts in entries:
+        last = a.produce("t", v, key=k, timestamp_ms=ts)
+    # same 3-tuple signature + last-offset return as the wire/native
+    # clients' produce_many (the Broker duck-type contract)
+    assert b.produce_many("t", entries) == last
+    for p in range(4):
+        assert a.end_offset("t", p) == b.end_offset("t", p)
+        assert a.begin_offset("t", p) == b.begin_offset("t", p)
+        ma = a.fetch("t", p, a.begin_offset("t", p), 100)
+        mb = b.fetch("t", p, b.begin_offset("t", p), 100)
+        assert [(m.key, m.value, m.timestamp_ms) for m in ma] == \
+            [(m.key, m.value, m.timestamp_ms) for m in mb]
