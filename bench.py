@@ -395,6 +395,12 @@ def _drive_fleet(port, n_conns, duration, payload, forwarded_fn, conns_fn,
     stop.set()
     for t in threads:
         t.join(timeout=30)
+    # a worker that failed to join is still publishing: its count would be
+    # snapshotted below while forwarded keeps growing, corrupting
+    # delivered_pct — record stragglers so the line is self-describing
+    stragglers = sum(1 for t in threads if t.is_alive())
+    if stragglers:
+        errors.append(f"{stragglers} worker(s) failed to join in 30s")
     elapsed = time.perf_counter() - t0
     # drain: the front keeps parsing the kernel-buffered backlog after the
     # publishers stop; the drain time COUNTS toward the rate (forwarded

@@ -77,10 +77,14 @@ void close_conn(Ingest* ig, int fd) {
 }
 
 // best-effort small control response (CONNACK/PUBACK/PINGRESP fit kernel
-// buffers virtually always; on EAGAIN the ack is dropped — qos1 senders
-// retry, which is within at-least-once)
-void reply(int fd, const uint8_t* data, size_t n) {
-  ::send(fd, data, n, MSG_NOSIGNAL);
+// buffers virtually always; on EAGAIN with NOTHING sent the ack is dropped
+// whole — qos1 senders retry, which is within at-least-once).  A PARTIAL
+// write (0 < sent < n) is worse than a dropped ack: the client's inbound
+// stream now starts mid-frame and every later ack misparses, so the only
+// framing-safe move is to drop the connection.  Returns false in that case.
+bool reply(int fd, const uint8_t* data, size_t n) {
+  ssize_t sent = ::send(fd, data, n, MSG_NOSIGNAL);
+  return !(sent >= 0 && static_cast<size_t>(sent) < n);
 }
 
 // parse one frame out of buf[pos..n); returns false if incomplete.
@@ -125,12 +129,10 @@ bool handle_frame(Ingest* ig, int fd, Conn& c, uint8_t ptype, uint8_t flags,
       c.connected = true;
       if (c.level >= 5) {
         const uint8_t ack[] = {0x20, 0x03, 0x00, 0x00, 0x00};
-        reply(fd, ack, sizeof ack);
-      } else {
-        const uint8_t ack[] = {0x20, 0x02, 0x00, 0x00};
-        reply(fd, ack, sizeof ack);
+        return reply(fd, ack, sizeof ack);
       }
-      return true;
+      const uint8_t ack[] = {0x20, 0x02, 0x00, 0x00};
+      return reply(fd, ack, sizeof ack);
     }
     case PUBLISH: {
       if (!c.connected) return false;
@@ -168,7 +170,7 @@ bool handle_frame(Ingest* ig, int fd, Conn& c, uint8_t ptype, uint8_t flags,
       if (qos == 1) {
         const uint8_t ack[] = {0x40, 0x02, uint8_t(pid >> 8),
                                uint8_t(pid & 0xFF)};
-        reply(fd, ack, sizeof ack);
+        return reply(fd, ack, sizeof ack);
       }
       return true;
     }
@@ -208,19 +210,16 @@ bool handle_frame(Ingest* ig, int fd, Conn& c, uint8_t ptype, uint8_t flags,
       ack.push_back(b[1]);
       if (c.level >= 5) ack.push_back(0x00);
       for (int k = 0; k < filters; ++k) ack.push_back(0x80);
-      reply(fd, ack.data(), ack.size());
-      return true;
+      return reply(fd, ack.data(), ack.size());
     }
     case UNSUBSCRIBE: {
       if (n < 2) return false;
       uint8_t ack[] = {0xB0, 0x02, b[0], b[1]};
-      reply(fd, ack, sizeof ack);
-      return true;
+      return reply(fd, ack, sizeof ack);
     }
     case PINGREQ: {
       const uint8_t ack[] = {0xD0, 0x00};
-      reply(fd, ack, sizeof ack);
-      return true;
+      return reply(fd, ack, sizeof ack);
     }
     case DISCONNECT:
       return false;

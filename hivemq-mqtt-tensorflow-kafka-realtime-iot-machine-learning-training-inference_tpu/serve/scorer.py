@@ -45,6 +45,18 @@ class StreamScorer:
       threshold: optional reconstruction-error threshold; when set, rows also
         get an anomaly verdict appended (the notebook's fixed-threshold
         protocol, threshold 5).
+
+    Delivery semantics: input is at-least-once (offsets commit once per
+    drain, after every polled row is scored — a mid-drain commit would
+    record offsets for rows still inside the batcher's poll/filter buffers
+    and lose them on crash-resume).  The flip side is that predictions are
+    flushed to the output topic per super-batch, so a crash mid-drain
+    re-emits every super-batch of that drain on resume: the output topic is
+    at-least-once too, with a duplicate window of up to one drain.
+    Duplicates are benign here — each prediction row is keyed by its global
+    index through OutputSequence.setitem, so idempotent downstream
+    consumers (and the reference's, which tolerates pod-restart re-scoring,
+    python-scripts/README.md:24) deduplicate on key.
     """
 
     #: Upper bound on batches stacked into one device dispatch.  A drain of
