@@ -26,7 +26,8 @@ DeliveryFn = Callable[[str, bytes, int, bool], None]
 
 class Session:
     __slots__ = ("client_id", "deliver", "clean_start", "connected_at",
-                 "pending", "resumed", "qos2_inbound")
+                 "pending", "resumed", "qos2_inbound", "will",
+                 "will_delay_s")
 
     def __init__(self, client_id: str, deliver: DeliveryFn,
                  clean_start: bool = True):
@@ -34,6 +35,13 @@ class Session:
         self.deliver = deliver
         self.clean_start = clean_start
         self.connected_at = time.time()
+        # Last Will from CONNECT: (topic, payload, qos, retain) published on
+        # abnormal disconnect (socket drop, keepalive timeout, protocol
+        # violation, session takeover), DISCARDED on clean DISCONNECT.
+        # will_delay_s is the v5 Will Delay Interval: a persistent session
+        # that reconnects within the delay cancels the will.
+        self.will: Optional[Tuple[str, bytes, int, bool]] = None
+        self.will_delay_s: float = 0.0
         # (topic, payload, qos, retain) queued while this persistent
         # session was offline, held until the transport is ready (CONNACK
         # sent); live publishes append here until drained so ordering is
@@ -67,16 +75,24 @@ class MqttBroker:
         self._sessions: Dict[str, Session] = {}
         self._tree = TopicTree()
         self._retained: Dict[str, Tuple[bytes, int]] = {}
-        # disconnected persistent sessions: cid → (queue, expires_at,
-        # qos2_inbound).
+        # disconnected persistent sessions: cid → [queue, expires_at,
+        # qos2_inbound, delayed_will] where delayed_will is None or
+        # ((topic, payload, qos, retain), due_time) — a v5 will-delay will
+        # pending publication unless the session reconnects first.
         # QoS≥1 deliveries queue (oldest dropped past the limit, HiveMQ's
         # offline buffering); a session that never reconnects expires after
         # offline_session_expiry_s (HiveMQ's session expiry) so rotating
         # client ids cannot grow state without bound.
-        self._offline: Dict[str, Tuple[deque, float, set]] = {}
+        self._offline: Dict[str, list] = {}
         self.offline_queue_limit = offline_queue_limit
         self.offline_session_expiry_s = offline_session_expiry_s
         self._next_offline_sweep = 0.0
+        # ONE consolidated timer for all pending delayed wills, armed for
+        # the earliest due time (a timer thread per will would mean
+        # thousands of stacks during a fleet-scale disconnect wave —
+        # exactly the silent-fleet event wills exist for)
+        self._will_timer: Optional[threading.Timer] = None
+        self._will_timer_due = float("inf")
         # RLock: delivery callbacks may legally re-enter (a subscriber that
         # publishes from its handler, e.g. a bridge)
         self._lock = threading.RLock()
@@ -99,7 +115,9 @@ class MqttBroker:
 
     # ---------------------------------------------------------- sessions
     def connect(self, client_id: str, deliver: DeliveryFn,
-                clean_start: bool = True) -> Session:
+                clean_start: bool = True,
+                will: Optional[Tuple[str, bytes, int, bool]] = None,
+                will_delay_s: float = 0.0) -> Session:
         """Register a session.  A reconnect with the same client id takes
         over (the old delivery path is dropped — MQTT session takeover).
 
@@ -108,9 +126,16 @@ class MqttBroker:
         `session.pending`; the transport calls `deliver_pending(session)`
         once it is ready (AFTER sending CONNACK — a PUBLISH before CONNACK
         breaks the handshake).  Until that drain, live publishes for the
-        session append behind the queued ones, preserving order."""
+        session append behind the queued ones, preserving order.
+
+        `will`/`will_delay_s` register the connection's Last Will
+        (published on abnormal disconnect — see `disconnect`).  Takeover
+        counts as abnormal FOR THE OLD CONNECTION: its will is published
+        here, unless a will delay applies (the new connection to the same
+        session cancels a delayed will, MQTT 5 §3.1.3.2.2)."""
+        takeover_will = None
         with self._lock:
-            self._expire_offline()
+            due_wills = self._expire_offline()
             pending: List[Tuple[str, bytes, int, bool]] = []
             qos2_inbound: set = set()
             old = self._sessions.get(client_id)
@@ -122,6 +147,11 @@ class MqttBroker:
                     pending = old.pending
                     old.pending = []
                 qos2_inbound = old.qos2_inbound
+                if old.will is not None and old.will_delay_s <= 0:
+                    takeover_will = old.will
+                # either way the old connection's will is settled now —
+                # its late teardown must not publish it again
+                old.will = None
             resumed = False
             if clean_start:
                 self._tree.unsubscribe_all(client_id)
@@ -131,6 +161,7 @@ class MqttBroker:
             else:
                 entry = self._offline.pop(client_id, None)
                 if entry is not None:
+                    # reconnect before the will delay fired: cancel it
                     pending = list(entry[0]) + pending
                     qos2_inbound |= entry[2]
                 # session-present: any server-side state carried over
@@ -139,6 +170,8 @@ class MqttBroker:
             s = Session(client_id, deliver, clean_start)
             s.resumed = resumed
             s.qos2_inbound = qos2_inbound
+            s.will = will
+            s.will_delay_s = will_delay_s
             # deliveries are held on `pending` until the transport declares
             # ready via deliver_pending() — this covers both the offline
             # backlog AND live publishes racing the CONNECT handshake (a
@@ -146,7 +179,12 @@ class MqttBroker:
             s.pending = pending
             self._sessions[client_id] = s
             self._g_sessions.set(len(self._sessions))
-            return s
+        # outside the lock: will fan-out must not stall the broker
+        for w in due_wills:
+            self.publish(*w)
+        if takeover_will is not None:
+            self.publish(*takeover_will)
+        return s
 
     def deliver_pending(self, session: Session) -> int:
         """Drain a freshly-connected session's queued messages and switch
@@ -185,38 +223,113 @@ class MqttBroker:
                         session.pending.pop(0)
                     ci += 1
 
+    def discard_will(self, session: Session) -> None:
+        """Clean DISCONNECT received: the will must never be published
+        (§3.1.2-10).  Called by the transport BEFORE teardown."""
+        with self._lock:
+            session.will = None
+
     def disconnect(self, client_id: str,
                    session: Optional[Session] = None) -> None:
         """End a session.  Pass the Session returned by connect() so a
         stale connection's teardown cannot destroy a session that was
-        taken over by a newer connection with the same client id."""
-        with self._lock:
-            self._expire_offline()
-            cur = self._sessions.get(client_id)
-            if cur is None or (session is not None and cur is not session):
-                return
-            del self._sessions[client_id]
-            if cur.clean_start:
-                self._tree.unsubscribe_all(client_id)
-            else:
-                # persistent session goes offline: queue QoS≥1 deliveries
-                # until it reconnects (bounded, drop-oldest) or expires
-                q = deque(cur.pending or (),
-                          maxlen=self.offline_queue_limit)
-                self._offline[client_id] = (
-                    q, time.time() + self.offline_session_expiry_s,
-                    cur.qos2_inbound)
-            self._g_sessions.set(len(self._sessions))
+        taken over by a newer connection with the same client id.
 
-    def _expire_offline(self) -> None:
+        Any will still registered on the session is published here — the
+        transport discards it first on a clean DISCONNECT, so reaching
+        this point with a will set means the disconnect was abnormal.  A
+        v5 will delay on a persistent session defers publication: the will
+        rides the offline entry and fires in the expiry sweep unless the
+        session reconnects first (which cancels it)."""
+        will = None
+        delayed = None
+        with self._lock:
+            due_wills = self._expire_offline()
+            cur = self._sessions.get(client_id)
+            if cur is not None and \
+                    (session is None or cur is session):
+                del self._sessions[client_id]
+                will, cur.will = cur.will, None
+                if will is not None and not cur.clean_start \
+                        and cur.will_delay_s > 0:
+                    # spec: publish at the earlier of will-delay expiry and
+                    # session expiry — both bounds land in the sweep, and a
+                    # timer guarantees the sweep happens even on an
+                    # otherwise-quiet broker
+                    delay = min(cur.will_delay_s,
+                                self.offline_session_expiry_s)
+                    delayed = (will, time.time() + delay)
+                    will = None
+                if cur.clean_start:
+                    self._tree.unsubscribe_all(client_id)
+                else:
+                    # persistent session goes offline: queue QoS≥1
+                    # deliveries until it reconnects (bounded, drop-oldest)
+                    # or expires
+                    q = deque(cur.pending or (),
+                              maxlen=self.offline_queue_limit)
+                    self._offline[client_id] = [
+                        q, time.time() + self.offline_session_expiry_s,
+                        cur.qos2_inbound, delayed]
+                    if delayed is not None:
+                        self._arm_will_timer(delayed[1])
+                self._g_sessions.set(len(self._sessions))
+        # outside the lock: will fan-out must not stall the broker
+        for w in due_wills:
+            self.publish(*w)
+        if will is not None:
+            self.publish(*will)
+
+    def _expire_offline(self) -> list:
         """Drop offline persistent sessions past their expiry (HiveMQ's
-        session-expiry): queue AND subscriptions go. Caller holds _lock."""
+        session-expiry): queue AND subscriptions go.  Returns due delayed
+        wills (v5 will-delay-interval) for the CALLER to publish after
+        releasing _lock — fan-out under the broker lock would let one slow
+        subscriber socket stall every connect/disconnect/publish."""
         now = time.time()
-        dead = [cid for cid, (_q, exp, _r) in self._offline.items()
-                if exp < now]
+        due_wills = []
+        dead = []
+        for cid, entry in self._offline.items():
+            if entry[3] is not None and entry[3][1] <= now:
+                due_wills.append(entry[3][0])
+                entry[3] = None
+            if entry[1] < now:
+                dead.append(cid)
         for cid in dead:
             del self._offline[cid]
             self._tree.unsubscribe_all(cid)
+        return due_wills
+
+    def _arm_will_timer(self, due_time: float) -> None:
+        """Ensure the consolidated will timer fires by `due_time`.
+        Caller holds _lock."""
+        if due_time >= self._will_timer_due:
+            return  # an earlier firing is already scheduled
+        if self._will_timer is not None:
+            self._will_timer.cancel()
+        self._will_timer_due = due_time
+        t = threading.Timer(max(due_time - time.time(), 0.0),
+                            self._sweep_due_wills)
+        t.daemon = True
+        t.start()
+        self._will_timer = t
+
+    def _sweep_due_wills(self) -> None:
+        """Timer target: publish any delayed wills that have come due and
+        re-arm for the next pending one.  Without this, a will on a quiet
+        broker (no connects/publishes to trigger the lazy sweep) would
+        never fire — and a silent fleet is exactly the condition a will
+        exists to report."""
+        with self._lock:
+            self._will_timer = None
+            self._will_timer_due = float("inf")
+            due = self._expire_offline()
+            nxt = min((e[3][1] for e in self._offline.values()
+                       if e[3] is not None), default=None)
+            if nxt is not None:
+                self._arm_will_timer(nxt)
+        for w in due:
+            self.publish(*w)
 
     def session_count(self) -> int:
         return len(self._sessions)
@@ -289,10 +402,11 @@ class MqttBroker:
         self._m_in.inc()
         delivered = queued = 0
         live: List[Tuple[Session, int]] = []
+        due_wills: list = []
         with self._lock:  # routing + queue mutation atomic; delivery after
             now = time.time()
             if now >= self._next_offline_sweep:
-                self._expire_offline()
+                due_wills = self._expire_offline()
                 self._next_offline_sweep = now + 5.0
             if retain:
                 if payload:
@@ -326,6 +440,8 @@ class MqttBroker:
         for sess, eff in live:  # outside the lock: a slow socket blocks
             sess.deliver(topic, payload, eff, False)  # only its publisher
             delivered += 1
+        for w in due_wills:  # due delayed wills, also outside the lock
+            self.publish(*w)
         if delivered:
             self._m_out.inc(delivered)
         if queued:

@@ -64,7 +64,7 @@ class _EConn:
     """Per-socket state owned by the event loop."""
 
     __slots__ = ("sock", "proto", "inbuf", "outbuf", "lock", "closing",
-                 "paused", "registered")
+                 "paused", "registered", "last_recv")
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
@@ -75,6 +75,7 @@ class _EConn:
         self.closing = False
         self.paused = False  # reads suspended (publisher backpressure)
         self.registered = True  # currently in the selector (loop thread)
+        self.last_recv = time.monotonic()  # keepalive clock (loop thread)
 
 
 class MqttEventServer:
@@ -96,18 +97,24 @@ class MqttEventServer:
         publishers resume.  Without this, enough stalled consumers each
         sitting under max_outbuf could hold every publisher paused (and
         their closed sockets unobserved) forever.
+      handshake_timeout_s: a connection that has not completed CONNECT
+        within this bound is dropped (same 30s stance as the threaded
+        front) — otherwise half-open sockets that never speak MQTT would
+        hold fds and selector slots forever.
     """
 
     def __init__(self, broker: MqttBroker, host: str = "127.0.0.1",
                  port: int = 0, max_outbuf: int = 4 << 20,
                  high_watermark: int = 16 << 20,
                  low_watermark: int = 4 << 20,
-                 stall_timeout_s: float = 10.0):
+                 stall_timeout_s: float = 10.0,
+                 handshake_timeout_s: float = 30.0):
         self.broker = broker
         self.max_outbuf = max_outbuf
         self.high_watermark = high_watermark
         self.low_watermark = low_watermark
         self.stall_timeout_s = stall_timeout_s
+        self.handshake_timeout_s = handshake_timeout_s
         self._pause_started: Optional[float] = None  # loop-thread only
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -130,6 +137,7 @@ class MqttEventServer:
         self._wake_r.setblocking(False)
         self._running = False
         self._thread: Optional[threading.Thread] = None
+        self._next_ka_sweep = 0.0  # loop-thread only
 
     # --------------------------------------------------------- lifecycle
     def start(self) -> "MqttEventServer":
@@ -233,6 +241,25 @@ class MqttEventServer:
             with self._out_lock:
                 _m_backlog.set(self._total_out)
             _m_paused.set(len(self._paused_conns))
+            # keepalive enforcement (§3.1.2-24): every second, close any
+            # connection silent for over 1.5× its announced keepalive —
+            # abnormal close, so teardown publishes its will.  Paused
+            # connections are exempt: WE stopped reading them, so their
+            # pings may be sitting unread in the kernel buffer.
+            now = time.monotonic()
+            if now >= self._next_ka_sweep:
+                self._next_ka_sweep = now + 1.0
+                for conn in list(self._conns.values()):
+                    if conn.paused:
+                        continue
+                    proto = conn.proto
+                    if proto is None or proto.session is None:
+                        # pre-CONNECT: bound the handshake wait
+                        if now - conn.last_recv > self.handshake_timeout_s:
+                            self._close(conn)
+                    elif proto.keepalive and \
+                            now - conn.last_recv > 1.5 * proto.keepalive:
+                        self._close(conn)
             # backpressure release: resume paused publishers once the
             # aggregate delivery backlog has drained below the low mark
             if self._paused_conns:
@@ -318,6 +345,7 @@ class MqttEventServer:
         if not data:
             self._close(conn)
             return
+        conn.last_recv = time.monotonic()
         conn.inbuf += data
         pos = 0
         try:

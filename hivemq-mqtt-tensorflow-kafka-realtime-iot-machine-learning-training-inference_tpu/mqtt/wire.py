@@ -75,19 +75,76 @@ def _skip_props(buf: bytes, pos: int) -> int:
     return cur[0] + length
 
 
+def _parse_will_props(buf: bytes, pos: int) -> Tuple[int, int]:
+    """Parse an MQTT 5 will-properties block; returns (will_delay_s,
+    next_pos).  Table-driven over the property ids the spec allows in a
+    will block (§3.1.3.2): the Will Delay Interval (0x18) is extracted,
+    the rest are validated-and-skipped."""
+    cur = [pos]
+
+    def read(n: int) -> bytes:
+        chunk = buf[cur[0]:cur[0] + n]
+        cur[0] += n
+        return chunk
+
+    length = decode_varlen(read)
+    p, end = cur[0], cur[0] + length
+    delay = 0
+    while p < end:
+        pid = buf[p]
+        p += 1
+        if pid == 0x18:    # will delay interval: 4-byte int
+            (delay,) = struct.unpack_from(">I", buf, p)
+            p += 4
+        elif pid == 0x01:  # payload format indicator: 1 byte
+            p += 1
+        elif pid == 0x02:  # message expiry interval: 4-byte int
+            p += 4
+        elif pid in (0x03, 0x08):   # content type / response topic: utf8
+            _, p = _read_str(buf, p)
+        elif pid == 0x09:  # correlation data: binary (u16 length)
+            (n,) = struct.unpack_from(">H", buf, p)
+            p += 2 + n
+        elif pid == 0x26:  # user property: utf8 pair
+            _, p = _read_str(buf, p)
+            _, p = _read_str(buf, p)
+        else:
+            raise ValueError(f"bad will property id 0x{pid:02x}")
+    if p != end:
+        raise ValueError("will properties overrun")
+    return delay, end
+
+
 def packet(ptype: int, flags: int, body: bytes) -> bytes:
     return bytes([(ptype << 4) | flags]) + encode_varlen(len(body)) + body
 
 
 def connect_packet(client_id: str, protocol_level: int = 4,
-                   keepalive: int = 60, clean: bool = True) -> bytes:
+                   keepalive: int = 60, clean: bool = True,
+                   will: Optional[Tuple[str, bytes, int, bool]] = None,
+                   will_delay_s: int = 0) -> bytes:
+    """CONNECT.  `will` is (topic, payload, qos, retain) — the Last Will
+    registered with the broker, published on abnormal disconnect (spec
+    §3.1.2-8).  `will_delay_s` emits the v5 Will Delay Interval property
+    (0x18) inside the will-properties block."""
     name = "MQTT"
     flags = 0x02 if clean else 0x00
+    if will is not None:
+        wtopic, wpayload, wqos, wretain = will
+        flags |= 0x04 | ((wqos & 0x03) << 3) | (0x20 if wretain else 0x00)
     body = _mqtt_str(name) + bytes([protocol_level, flags]) + \
         struct.pack(">H", keepalive)
     if protocol_level == 5:
         body += b"\x00"  # empty properties
     body += _mqtt_str(client_id)
+    if will is not None:
+        if protocol_level == 5:
+            if will_delay_s:
+                props = b"\x18" + struct.pack(">I", will_delay_s)
+                body += encode_varlen(len(props)) + props
+            else:
+                body += b"\x00"  # empty will properties
+        body += _mqtt_str(wtopic) + struct.pack(">H", len(wpayload)) + wpayload
     return packet(CONNECT, 0, body)
 
 
@@ -162,6 +219,10 @@ class MqttProtocol:
         self.level = 4
         self.client_id: Optional[str] = None
         self.session = None
+        #: keepalive seconds from CONNECT (0 = disabled).  The transport
+        #: enforces the spec's 1.5× rule: no packet for keepalive*1.5 →
+        #: abnormal close (which publishes the will, §3.1.2-10).
+        self.keepalive = 0
         self._next_pid = 0
         self._pid_lock = threading.Lock()
         # outbound QoS 2 sender state: pid → "rec" (awaiting PUBREC) or
@@ -200,11 +261,31 @@ class MqttProtocol:
                 raise ValueError("second CONNECT on one connection")
             _name, pos = _read_str(body, 0)
             self.level = body[pos]
-            clean = bool(body[pos + 1] & 0x02)
+            cflags = body[pos + 1]
+            clean = bool(cflags & 0x02)
+            (self.keepalive,) = struct.unpack_from(">H", body, pos + 2)
             pos += 4  # level + flags + keepalive
             if self.level >= 5:
                 pos = _skip_props(body, pos)
             client_id, pos = _read_str(body, pos)
+            # Last Will (§3.1.2-8): will flag → will topic + message follow
+            # the client id (after will properties on v5).  Round-1/2 builds
+            # silently discarded these — the failure-detection primitive a
+            # predictive-maintenance fleet leans on (a dead car's will tells
+            # the platform the car is gone).
+            will = None
+            will_delay_s = 0
+            if cflags & 0x04:
+                if self.level >= 5:
+                    will_delay_s, pos = _parse_will_props(body, pos)
+                wtopic, pos = _read_str(body, pos)
+                (wlen,) = struct.unpack_from(">H", body, pos)
+                wpayload = bytes(body[pos + 2:pos + 2 + wlen])
+                if len(wpayload) != wlen:
+                    raise ValueError("truncated will payload")
+                pos += 2 + wlen
+                will = (wtopic, wpayload, (cflags >> 3) & 0x03,
+                        bool(cflags & 0x20))
             if not client_id and not clean:
                 # §3.1.3-8: a zero-byte client id REQUIRES a clean
                 # session — a synthesized persistent id could never
@@ -216,7 +297,9 @@ class MqttProtocol:
                 self._send(packet(CONNACK, 0, reject))
                 return False
             self.client_id = client_id or f"anon-{id(self):x}"
-            self.session = broker.connect(self.client_id, self.deliver, clean)
+            self.session = broker.connect(self.client_id, self.deliver, clean,
+                                          will=will,
+                                          will_delay_s=will_delay_s)
             # byte 1 bit 0 = session-present (MQTT 3.1.1 §3.2.2.2):
             # a resumed persistent session must say so, or spec
             # clients discard their subscription state
@@ -293,13 +376,25 @@ class MqttProtocol:
         elif ptype == PUBACK:
             pass  # client acks for our qos1 deliveries
         elif ptype == DISCONNECT:
+            # clean disconnect discards the will (§3.1.2-10) — EXCEPT the
+            # v5 "disconnect with will message" reason 0x04 (§3.14.2.1),
+            # which closes the network connection normally but still asks
+            # for the will to be published
+            keep_will = (self.level >= 5 and len(body) >= 1
+                         and body[0] == 0x04)
+            if not keep_will and self.session is not None:
+                self.broker.discard_will(self.session)
             return False
         return True
 
     def teardown(self):
         if self.client_id is not None:
             # identity-checked: a session taken over by a newer
-            # connection with this client id survives our teardown
+            # connection with this client id survives our teardown.
+            # Any will still registered on the session is published by the
+            # broker here — reaching teardown without a clean DISCONNECT
+            # (socket error, EOF, keepalive timeout, protocol violation)
+            # is exactly the spec's "abnormal disconnection".
             self.broker.disconnect(self.client_id, self.session)
 
 
@@ -322,6 +417,9 @@ class _Conn(socketserver.BaseRequestHandler):
         self._wlock = threading.Lock()
         proto = MqttProtocol(broker, self._send)
         try:
+            # until CONNECT announces a keepalive, bound the handshake wait
+            self.request.settimeout(30.0)
+            ka_armed = 0
             while True:
                 (h,) = self._read_exact(1)
                 ptype, flags = h >> 4, h & 0x0F
@@ -329,6 +427,13 @@ class _Conn(socketserver.BaseRequestHandler):
                 body = self._read_exact(length) if length else b""
                 if not proto.handle_packet(ptype, flags, body):
                     break
+                if not ka_armed and proto.session is not None:
+                    # §3.1.2-24: 1.5× the keepalive with no packet →
+                    # abnormal close (the timeout surfaces as OSError, so
+                    # teardown publishes the will); keepalive 0 disables
+                    ka_armed = 1
+                    self.request.settimeout(
+                        proto.keepalive * 1.5 if proto.keepalive else None)
         except (ConnectionError, OSError):
             pass
         except (ValueError, struct.error, IndexError):
@@ -375,7 +480,10 @@ class MqttClient:
 
     def __init__(self, host: str, port: int, client_id: str,
                  protocol_level: int = 4, clean: bool = True,
-                 on_message: Optional[Callable[[str, bytes], None]] = None):
+                 on_message: Optional[Callable[[str, bytes], None]] = None,
+                 keepalive: int = 60,
+                 will: Optional[Tuple[str, bytes, int, bool]] = None,
+                 will_delay_s: int = 0):
         self.client_id = client_id
         self._level = protocol_level
         self._sock = socket.create_connection((host, port), timeout=10)
@@ -391,7 +499,9 @@ class MqttClient:
         self._next_pid = 0
         self._wlock = threading.Lock()
         self._sock.sendall(connect_packet(client_id, protocol_level,
-                                          clean=clean))
+                                          keepalive=keepalive, clean=clean,
+                                          will=will,
+                                          will_delay_s=will_delay_s))
         h, body = self._read_packet()
         if h >> 4 != CONNACK:
             raise ConnectionError(f"expected CONNACK, got {h >> 4}")
@@ -400,6 +510,27 @@ class MqttClient:
         self._sock.settimeout(None)
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
+        # honor our announced keepalive: the server evicts at 1.5× with no
+        # inbound packet, so an idle client must ping on its own — one
+        # PINGREQ every keepalive/2 (clients may send early, §3.1.2-23)
+        self._closed = threading.Event()
+        # serializes ping round-trips: with the keepalive thread also
+        # pinging, an unpaired PINGREQ's late PINGRESP could otherwise
+        # satisfy a user ping()'s wait early and break its quiesce-barrier
+        # guarantee (at most ONE outstanding PINGREQ at a time)
+        self._ping_lock = threading.Lock()
+        if keepalive:
+            self._keeper = threading.Thread(
+                target=self._keepalive_loop, args=(keepalive / 2,),
+                daemon=True)
+            self._keeper.start()
+
+    def _keepalive_loop(self, interval_s: float) -> None:
+        while not self._closed.wait(interval_s):
+            try:
+                self.ping(timeout=interval_s)
+            except (OSError, TimeoutError):
+                return  # connection gone (or wedged): the reader owns errors
 
     def _read_exact(self, n: int) -> bytes:
         return _recv_exact(self._sock, n)
@@ -515,17 +646,37 @@ class MqttClient:
         """PINGREQ/PINGRESP round-trip.  Because the server processes each
         connection's packets in order, a returned ping guarantees every
         prior qos-0 publish on this connection has been fully fanned out —
-        the deterministic quiesce barrier the scenario runner uses."""
-        self._pingresp.clear()
-        with self._wlock:
-            self._sock.sendall(packet(PINGREQ, 0, b""))
-        if not self._pingresp.wait(timeout):
-            raise TimeoutError("no PINGRESP")
+        the deterministic quiesce barrier the scenario runner uses.
+        Serialized with the auto-keepalive pings so each PINGRESP pairs
+        with exactly one in-flight PINGREQ."""
+        with self._ping_lock:
+            self._pingresp.clear()
+            with self._wlock:
+                self._sock.sendall(packet(PINGREQ, 0, b""))
+            if not self._pingresp.wait(timeout):
+                raise TimeoutError("no PINGRESP")
 
     def disconnect(self) -> None:
+        self._closed.set()
         try:
             with self._wlock:
                 self._sock.sendall(packet(DISCONNECT, 0, b""))
+            self._sock.close()
+        except OSError:
+            pass
+
+    def drop(self) -> None:
+        """Abort the network connection WITHOUT a DISCONNECT packet — the
+        abnormal-disconnect path (the broker publishes our will).
+        shutdown() first: close() alone would not send the FIN while the
+        reader thread is blocked in recv (the blocked syscall holds the
+        file description open)."""
+        self._closed.set()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._sock.close()
         except OSError:
             pass

@@ -591,7 +591,7 @@ def test_shared_subscription_skips_offline_members():
     broker.disconnect("live1")
     broker.disconnect("live2")
     broker.publish("t", b"all-offline", qos=1)
-    queued = sum(len(q) for q, _, _ in broker._offline.values())
+    queued = sum(len(e[0]) for e in broker._offline.values())
     assert queued == 1
 
 
