@@ -191,12 +191,13 @@ int64_t iotml_decode_batch(const uint8_t* blob, const int64_t* offsets,
 //   frame_schema_id: >= 0 writes the Confluent 5-byte header (magic 0 +
 //                big-endian id); < 0 emits bare Avro.
 // Returns total bytes written, or -1 if out_capacity would overflow.
-int64_t iotml_encode_batch(const double* numeric, const char* labels,
-                           int64_t label_stride, int64_t n_msgs,
-                           const int8_t* types, const uint8_t* nullable,
-                           int64_t n_fields, int64_t frame_schema_id,
-                           uint8_t* out, int64_t out_capacity,
-                           int64_t* out_offsets) {
+int64_t iotml_encode_batch_nulls(const double* numeric, const char* labels,
+                                 int64_t label_stride, int64_t n_msgs,
+                                 const int8_t* types, const uint8_t* nullable,
+                                 int64_t n_fields, int64_t frame_schema_id,
+                                 uint8_t* out, int64_t out_capacity,
+                                 int64_t* out_offsets,
+                                 const uint8_t* nulls) {
   int64_t n_numeric = 0, n_strings = 0;
   for (int64_t f = 0; f < n_fields; ++f) {
     if (types[f] == F_STRING) ++n_strings; else ++n_numeric;
@@ -217,8 +218,18 @@ int64_t iotml_encode_batch(const double* numeric, const char* labels,
     }
     const double* num_row = numeric + i * n_numeric;
     const char* lab_row = labels + i * n_strings * label_stride;
+    const uint8_t* null_row = nulls ? nulls + i * n_fields : nullptr;
     int64_t ncol = 0, scol = 0;
     for (int64_t f = 0; f < n_fields; ++f) {
+      if (null_row && null_row[f]) {
+        // null value: branch 0 of the ["null", T] union, no payload.
+        // A null in a non-nullable field has no encoding — reject so the
+        // caller's Python path decides (it raises there too).
+        if (!nullable[f]) return -1;
+        pos = write_varint(out, pos, 0);
+        if (types[f] == F_STRING) ++scol; else ++ncol;
+        continue;
+      }
       if (nullable[f]) pos = write_varint(out, pos, 1);  // branch 1 = value
       switch (types[f]) {
         case F_FLOAT: {
@@ -260,9 +271,22 @@ int64_t iotml_encode_batch(const double* numeric, const char* labels,
   return pos;
 }
 
+int64_t iotml_encode_batch(const double* numeric, const char* labels,
+                           int64_t label_stride, int64_t n_msgs,
+                           const int8_t* types, const uint8_t* nullable,
+                           int64_t n_fields, int64_t frame_schema_id,
+                           uint8_t* out, int64_t out_capacity,
+                           int64_t* out_offsets) {
+  return iotml_encode_batch_nulls(numeric, labels, label_stride, n_msgs,
+                                  types, nullable, n_fields, frame_schema_id,
+                                  out, out_capacity, out_offsets, nullptr);
+}
+
 // Bumped whenever the C ABI grows; stream/native.py rebuilds stale .so files.
 // ABI history: 1 = avro batch codec; 2 = + kafka wire client;
-// 3 = + iotml_decode_batch_nulls (null-bitmap decode)
-int64_t iotml_engine_version() { return 3; }
+// 3 = + iotml_decode_batch_nulls (null-bitmap decode);
+// 4 = + iotml_json_decode_batch (batch JSON → columnar, json_engine.cc)
+//     + iotml_encode_batch_nulls (null-bitmap encode)
+int64_t iotml_engine_version() { return 4; }
 
 }  // extern "C"

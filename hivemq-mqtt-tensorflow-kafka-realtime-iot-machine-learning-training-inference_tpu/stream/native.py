@@ -42,7 +42,7 @@ def _build() -> bool:
         return False
 
 
-ENGINE_VERSION = 3  # must match iotml_engine_version() in avro_engine.cc
+ENGINE_VERSION = 4  # must match iotml_engine_version() in avro_engine.cc
 
 
 def _stale() -> bool:
@@ -81,6 +81,8 @@ def load() -> Optional[ctypes.CDLL]:
         lib.iotml_decode_batch.restype = ctypes.c_int64
         lib.iotml_decode_batch_nulls.restype = ctypes.c_int64
         lib.iotml_encode_batch.restype = ctypes.c_int64
+        lib.iotml_json_decode_batch.restype = ctypes.c_int64
+        lib.iotml_encode_batch_nulls.restype = ctypes.c_int64
         _lib = lib
     except (OSError, AttributeError):
         _lib = None
@@ -103,6 +105,12 @@ class NativeCodec:
         self.n_fields = len(schema.fields)
         self.n_strings = int((self.types == 4).sum())
         self.n_numeric = self.n_fields - self.n_strings
+        # schema-constant inputs for the JSON batch parser: uppercase
+        # column names (built once, not per poll batch on the hot path)
+        names = [f.name.upper().encode() for f in schema.fields]
+        self._json_names_blob = b"".join(names)
+        self._json_name_offsets = np.zeros((len(names) + 1,), np.int64)
+        np.cumsum([len(b) for b in names], out=self._json_name_offsets[1:])
         self._lib = load()
         if self._lib is None:
             raise RuntimeError("native stream engine unavailable")
@@ -164,11 +172,68 @@ class NativeCodec:
         ENGINE_VERSION gate in load() guarantees the symbol exists."""
         return self._decode_impl(messages, strip, stride, want_nulls=True)
 
+    # --------------------------------------------------------------- json
+    def json_decode_batch(self, messages: List[bytes],
+                          stride: int = LABEL_STRIDE):
+        """Batch-parse flat JSON objects into the same columnar layout as
+        decode_batch: → (numeric [n, n_numeric] float64, labels
+        [n, n_strings] S-stride, nulls [n, n_fields] uint8, fallback [n]
+        uint8).
+
+        Missing columns and explicit JSON nulls on nullable columns set
+        the null bitmap (the fleet's producer-named payloads make the
+        KSQL-mangled columns permanently null — the hot case).  Rows the
+        native parser cannot reproduce exactly (escapes, nested values,
+        type mismatches, ints beyond 2^53, null on a non-nullable column)
+        are flagged in `fallback` with undefined contents — the caller
+        re-decodes those through json.loads.  Keys match schema column
+        names case-insensitively (ASCII upper), like the Python leg's
+        `{k.upper(): v}`."""
+        n = len(messages)
+        if n == 0:
+            return (np.zeros((0, self.n_numeric)),
+                    np.zeros((0, self.n_strings), f"S{stride}"),
+                    np.zeros((0, self.n_fields), np.uint8),
+                    np.zeros((0,), np.uint8))
+        blob = b"".join(messages)
+        offsets = np.zeros((n + 1,), np.int64)
+        np.cumsum([len(m) for m in messages], out=offsets[1:])
+        names_blob = self._json_names_blob
+        name_offsets = self._json_name_offsets
+        numeric = np.empty((n, self.n_numeric), np.float64)
+        labels = np.zeros((n, max(self.n_strings, 1)), f"S{stride}")
+        nulls = np.zeros((n, self.n_fields), np.uint8)
+        fallback = np.zeros((n,), np.uint8)
+        rc = self._lib.iotml_json_decode_batch(
+            ctypes.c_char_p(blob),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.c_int64(n),
+            ctypes.c_char_p(names_blob),
+            name_offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            self.types.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+            self.nullable.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.c_int64(self.n_fields),
+            numeric.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            ctypes.c_int64(self.n_numeric),
+            labels.ctypes.data_as(ctypes.c_char_p),
+            ctypes.c_int64(self.n_strings),
+            ctypes.c_int64(stride),
+            nulls.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            fallback.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+        if rc < 0:
+            raise ValueError("json batch decode rejected arguments")
+        return numeric, labels[:, : self.n_strings], nulls, fallback
+
     # ------------------------------------------------------------- encode
     def encode_batch(self, numeric: np.ndarray, labels: Optional[np.ndarray],
-                     schema_id: int = -1,
-                     stride: int = LABEL_STRIDE) -> List[bytes]:
-        """Columnar rows → list of (optionally framed) Avro messages."""
+                     schema_id: int = -1, stride: int = LABEL_STRIDE,
+                     nulls: Optional[np.ndarray] = None) -> List[bytes]:
+        """Columnar rows → list of (optionally framed) Avro messages.
+
+        `nulls` ([n, n_fields] uint8) encodes branch 0 of the nullable
+        union where set — the column slot's value is ignored for those
+        fields.  A null flagged on a non-nullable field raises (no valid
+        encoding exists)."""
         numeric = np.ascontiguousarray(numeric, np.float64)
         n = numeric.shape[0]
         if labels is None:
@@ -177,7 +242,7 @@ class NativeCodec:
         cap = n * (5 + self.n_fields * 20 + self.n_strings * stride) + 64
         out = np.empty((cap,), np.uint8)
         offsets = np.zeros((n + 1,), np.int64)
-        total = self._lib.iotml_encode_batch(
+        args = [
             numeric.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
             labels.ctypes.data_as(ctypes.c_char_p),
             ctypes.c_int64(stride),
@@ -188,8 +253,15 @@ class NativeCodec:
             ctypes.c_int64(schema_id),
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             ctypes.c_int64(cap),
-            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ]
+        if nulls is not None:
+            nulls = np.ascontiguousarray(nulls, np.uint8)
+            args.append(nulls.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+            total = self._lib.iotml_encode_batch_nulls(*args)
+        else:
+            total = self._lib.iotml_encode_batch(*args)
         if total < 0:
-            raise ValueError("encode buffer overflow")
+            raise ValueError("encode rejected (overflow or impossible null)")
         raw = out.tobytes()
         return [raw[offsets[i]:offsets[i + 1]] for i in range(n)]

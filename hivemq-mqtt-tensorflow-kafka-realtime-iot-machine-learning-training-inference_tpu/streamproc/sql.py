@@ -28,11 +28,12 @@ from __future__ import annotations
 
 import json
 import re
+from collections import Counter
 from struct import error as struct_error
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..core.schema import Field, RecordSchema
-from ..ops.avro import AvroCodec
+from ..ops.avro import AvroCodec, zigzag_encode
 from ..ops.framing import frame, unframe
 from ..stream.broker import Broker, Message
 from ..stream.registry import SchemaRegistry, subject_for_topic
@@ -611,6 +612,49 @@ class SqlSelectTask(StreamTask):
                                    if f.avro_type in ("int", "long")]
             except Exception:
                 self._native_sink = None
+        # ---- fused JSON→AVRO leg (the pipeline's input stage): when the
+        # query is a bare star copy (SELECT * FROM <json-stream>, no WHERE,
+        # no PARTITION BY — reference 01_installConfluentPlatform.sh's
+        # SENSOR_DATA_S_AVRO CSAS), the C++ JSON parser emits straight into
+        # the sink's columnar layout and the C++ Avro encoder takes it from
+        # there: zero per-row Python on the eligible rows, byte-identical
+        # output, row-level fallback for anything the parser can't
+        # reproduce exactly.
+        self._fused_json = None
+        if (src_meta.value_format == "JSON"
+                and self._native_sink is not None
+                and stmt.where is None and not stmt.partition_by
+                and len(stmt.items) == 1 and stmt.items[0].star
+                and sink_meta.columns == src_meta.columns
+                and len(sink_meta.columns) <= 64):
+            self._fused_json = self._native_sink
+        # ---- REKEY pass-through (SELECT ROWKEY AS X, * ... PARTITION BY
+        # X over AVRO→AVRO): the sink record is the ROWKEY string field
+        # followed by the source fields unchanged, and Avro encodes a
+        # record as the concatenation of its field encodings — so the
+        # output value is frame(avro_string(key) + source_payload) with no
+        # decode/encode at all.  The source payload is still structurally
+        # validated in batch (native decode); a batch that fails
+        # validation, any non-framed value, or a non-UTF-8 key falls back
+        # to the generic path wholesale.
+        self._rekey_fast = bool(
+            src_meta.value_format == "AVRO"
+            and sink_meta.value_format == "AVRO"
+            and self._native_src is not None
+            and self.sink_schema_id is not None
+            and stmt.where is None and stmt.partition_by
+            and len(stmt.items) == 2
+            and stmt.items[0].source_col == "ROWKEY"
+            and stmt.items[0].alias == stmt.partition_by
+            and stmt.items[1].star
+            and sink_meta.columns[:1] == [(stmt.items[0].alias, "STRING")]
+            and sink_meta.columns[1:] == list(src_meta.columns))
+        if self._rekey_fast:
+            # constant per task: frame header, plus the non-null union
+            # branch (zigzag 1 = 0x02) when the sink key column is nullable
+            self._rekey_header = frame(b"", self.sink_schema_id)
+            if sink_meta.record_schema().fields[0].nullable:
+                self._rekey_header += b"\x02"
 
     def _project(self, rec: dict) -> Optional[dict]:
         out = {}
@@ -668,7 +712,77 @@ class SqlSelectTask(StreamTask):
             {n: row.get(n) for n, _ in self.sink_meta.columns}),
             self.sink_schema_id) for row in rows]
 
+    def _process_fused_json(self, messages):
+        """JSON→AVRO star copy, native end to end (see __init__)."""
+        import numpy as np
+
+        num, lab, nulls, fb = self._fused_json.json_decode_batch(
+            [m.value for m in messages], stride=self._label_stride)
+        ok = fb == 0
+        encoded = []
+        if ok.any():
+            idx = np.nonzero(ok)[0]
+            encoded = self._native_sink.encode_batch(
+                num[idx], lab[idx] if self._sink_strings else None,
+                schema_id=self.sink_schema_id, stride=self._label_stride,
+                nulls=nulls[idx])
+        out = []
+        enc_i = 0
+        for i, m in enumerate(messages):
+            if ok[i]:
+                out.append((m.key, encoded[enc_i], m.timestamp_ms))
+                enc_i += 1
+            else:
+                # row-level fallback: the Python leg decides (drops
+                # poisoned rows, encodes nulls/escapes/big ints exactly)
+                rec = _decode_record(self.src_meta, self.src_codec, m)
+                if rec is None:
+                    continue
+                row = self._project(rec)
+                if row is None:
+                    continue
+                val = frame(self.sink_codec.encode(
+                    {n: row.get(n) for n, _ in self.sink_meta.columns}),
+                    self.sink_schema_id)
+                out.append((m.key, val, m.timestamp_ms))
+        return out
+
+    def _process_rekey(self, messages):
+        """AVRO rekey pass-through (see __init__); None → generic path."""
+        vals = []
+        for m in messages:
+            if not m.value or m.value[0] != 0:
+                return None  # poisoned frame: generic path drops it
+            vals.append(m.value)
+        try:
+            # structural validation only — the bytes pass through; any
+            # malformed payload sends the whole batch to the generic path
+            # (which drops exactly the bad rows)
+            self._native_src.codec.decode_batch(
+                vals, strip=5, stride=_NativeAvroSource.STRIDE)
+        except (ValueError, TypeError, RuntimeError):
+            return None
+        header = self._rekey_header
+        out = []
+        for m in messages:
+            key = m.key or b""
+            try:
+                key.decode()
+            except UnicodeDecodeError:
+                return None  # replacement-char key: Python path is exact
+            # avro string: zigzag-varint byte length, then the utf-8 bytes
+            out.append((key,
+                        header + zigzag_encode(len(key)) + key + m.value[5:],
+                        m.timestamp_ms))
+        return out
+
     def process(self, messages):
+        if self._fused_json is not None:
+            return self._process_fused_json(messages)
+        if self._rekey_fast:
+            fast = self._process_rekey(messages)
+            if fast is not None:
+                return fast
         picked = []  # (key, row, timestamp) per surviving record
         recs = _decode_batch(self.src_meta, self.src_codec,
                              self._native_src, messages)
@@ -734,6 +848,21 @@ class SqlAggTask(StreamTask):
         if any(broker.committed(group, src_topic, p) is not None
                for p in range(n_src)):
             self._restore_from_changelog()
+        # ---- vectorized COUNT fast path (the reference CTAS:
+        # SELECT ROWKEY AS CAR, COUNT(*) ... WINDOW TUMBLING GROUP BY
+        # ROWKEY): grouping needs only (key, timestamp) and COUNT needs no
+        # fields at all, so eligible batches skip per-row dict
+        # materialization — the source payloads are batch-validated
+        # natively (the Python path drops undecodable rows, so the count
+        # must too) and the (key, window) histogram comes from one
+        # Counter pass.
+        self._fast_count = bool(
+            stmt.where is None and stmt.group_by == "ROWKEY"
+            and self._native_src is not None
+            and all((it.agg == "COUNT" and it.agg_arg is None)
+                    or (not it.agg and it.source_col == "ROWKEY")
+                    for it in stmt.items)
+            and any(it.agg == "COUNT" for it in stmt.items))
 
     def _restore_from_changelog(self) -> None:
         """Rebuild aggregate state from the output topic.
@@ -823,27 +952,63 @@ class SqlAggTask(StreamTask):
                     self.acc[key] = prev
             raise
 
+    def _count_batch(self, messages):
+        """(key, window) → count for an eligible COUNT-only batch, or None
+        → per-row path (validation failure / unframed value)."""
+        vals = []
+        for m in messages:
+            if not m.value or m.value[0] != 0:
+                return None
+            vals.append(m.value)
+        try:
+            # the Python path drops rows that fail to decode — validate the
+            # whole batch natively so the count matches exactly; a batch
+            # with any bad row takes the per-row path (which drops it)
+            self._native_src.codec.decode_batch(
+                vals, strip=5, stride=_NativeAvroSource.STRIDE)
+        except (ValueError, TypeError, RuntimeError):
+            return None
+        w = self.stmt.window_ms
+        return Counter(
+            ((m.key or b"").decode(errors="replace"),
+             (m.timestamp_ms // w) * w if w else 0)
+            for m in messages)
+
     def _process_chunk(self, messages, undo):
         touched = set()
-        recs = _decode_batch(self.src_meta, self.src_codec,
-                             self._native_src, messages)
-        for m, rec in zip(messages, recs):
-            if rec is None:
-                continue
-            if self.stmt.where is not None:
-                try:
-                    if not self.stmt.where(rec):
-                        continue
-                except TypeError:
+        counted = self._count_batch(messages) if self._fast_count else None
+        if counted is not None:
+            for key, cnt in counted.items():
+                if key not in undo:
+                    undo[key] = dict(self.acc[key]) if key in self.acc \
+                        else None
+                slot = self.acc.setdefault(key, {})
+                for it in self.stmt.items:
+                    if it.agg == "COUNT":
+                        slot[it.alias] = slot.get(it.alias, 0) + cnt
+            touched.update(counted)
+        else:
+            recs = _decode_batch(self.src_meta, self.src_codec,
+                                 self._native_src, messages)
+            for m, rec in zip(messages, recs):
+                if rec is None:
                     continue
-            gval = rec.get(self.stmt.group_by) if self.stmt.group_by else ""
-            win = ((m.timestamp_ms // self.stmt.window_ms) * self.stmt.window_ms
-                   if self.stmt.window_ms else 0)
-            key = (str(gval), win)
-            if key not in undo:  # shallow copy: slot values are scalars
-                undo[key] = dict(self.acc[key]) if key in self.acc else None
-            self._update(key, rec)
-            touched.add(key)
+                if self.stmt.where is not None:
+                    try:
+                        if not self.stmt.where(rec):
+                            continue
+                    except TypeError:
+                        continue
+                gval = (rec.get(self.stmt.group_by)
+                        if self.stmt.group_by else "")
+                win = ((m.timestamp_ms // self.stmt.window_ms)
+                       * self.stmt.window_ms if self.stmt.window_ms else 0)
+                key = (str(gval), win)
+                if key not in undo:  # shallow copy: slot values are scalars
+                    undo[key] = (dict(self.acc[key]) if key in self.acc
+                                 else None)
+                self._update(key, rec)
+                touched.add(key)
         out = []
         for gval, win in sorted(touched):
             slot = self.acc[(gval, win)]

@@ -173,7 +173,17 @@ class Trainer:
 
     def fit(self, batches, epochs: int = 1, verbose: bool = False,
             callbacks=()) -> dict:
-        """batches: SensorBatches (or any iterable-of-Batch with .epochs)."""
+        """batches: SensorBatches (or any iterable-of-Batch with .epochs).
+
+        This is the Keras-shaped per-step loop: it re-reads the stream
+        every epoch and fires callbacks per batch — but each step is one
+        device dispatch (~150-200ms over a TPU tunnel), so prefer
+        `fit_compiled` for anything but live-stream/callback training.
+        When the batch source is a frozen slice (`cache=True`) and no
+        per-batch observation is requested, the two are semantically
+        identical and this delegates automatically."""
+        if not callbacks and not verbose and getattr(batches, "cache", False):
+            return self.fit_compiled(batches, epochs)
         history = {"loss": [], "accuracy": [], "records": [], "seconds": []}
         epoch_iter = batches.epochs(epochs) if hasattr(batches, "epochs") \
             else (iter(batches) for _ in range(epochs))

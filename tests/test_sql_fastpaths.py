@@ -1,0 +1,244 @@
+"""Byte-parity of the native SQL fast paths against the generic path.
+
+The pipeline's three hot legs each have a native fast path (fused JSON→AVRO
+CSAS, REKEY pass-through, vectorized COUNT CTAS); all of them promise
+byte-identical topics and identical table state versus the per-row Python
+path.  These tests run the full reference DDL twice — fast paths on and
+forced off — and diff every output topic and the CTAS table.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from iotml.core.schema import KSQL_CAR_SCHEMA
+from iotml.gen.simulator import FleetGenerator, FleetScenario
+from iotml.stream.broker import Broker
+from iotml.stream.native import NativeCodec, available
+from iotml.streamproc.sql import SqlEngine, install_reference_pipeline
+
+pytestmark = pytest.mark.skipif(not available(),
+                                reason="native engine unavailable")
+
+
+def _produce(broker, records, keys=None, topic="sensor-data"):
+    broker.create_topic(topic, partitions=2)
+    for i, rec in enumerate(records):
+        key = (keys[i] if keys else f"car{i % 3}").encode()
+        broker.produce(topic, json.dumps(rec).encode(), key=key,
+                       timestamp_ms=i * 60_000)
+
+
+def _fleet_records(n=40):
+    gen = FleetGenerator(FleetScenario(num_cars=4))
+    return [gen.row_record(gen.step_columns(), i % 4, KSQL_CAR_SCHEMA)
+            for i in range(n)]
+
+
+def _run_pipeline(records, disable_fast, keys=None):
+    broker = Broker()
+    _produce(broker, records, keys)
+    engine = SqlEngine(broker)
+    install_reference_pipeline(engine)
+    fast_flags = []
+    for q in engine.queries.values():
+        t = q.task
+        fast_flags.append((getattr(t, "_fused_json", None) is not None,
+                           getattr(t, "_rekey_fast", False),
+                           getattr(t, "_fast_count", False)))
+        if disable_fast:
+            if hasattr(t, "_fused_json"):
+                t._fused_json = None
+            if hasattr(t, "_rekey_fast"):
+                t._rekey_fast = False
+            if hasattr(t, "_fast_count"):
+                t._fast_count = False
+    engine.pump()
+    topics = {}
+    for topic in ("SENSOR_DATA_S_AVRO", "SENSOR_DATA_S_AVRO_REKEY",
+                  "SENSOR_DATA_EVENTS_PER_5MIN_T"):
+        spec = broker.topic(topic)
+        topics[topic] = [
+            (p, m.key, m.value)
+            for p in range(spec.partitions)
+            for m in broker.fetch(topic, p, 0, 100000)]
+    table = engine.table("SENSOR_DATA_EVENTS_PER_5MIN_T")
+    return topics, table, fast_flags
+
+
+def test_fast_paths_engage_on_reference_ddl():
+    _, _, flags = _run_pipeline(_fleet_records(8), disable_fast=False)
+    assert any(f[0] for f in flags), "fused JSON CSAS did not engage"
+    assert any(f[1] for f in flags), "REKEY pass-through did not engage"
+    assert any(f[2] for f in flags), "COUNT fast path did not engage"
+
+
+def test_reference_pipeline_byte_parity():
+    records = _fleet_records(60)
+    fast_topics, fast_table, _ = _run_pipeline(records, disable_fast=False)
+    slow_topics, slow_table, _ = _run_pipeline(records, disable_fast=True)
+    assert fast_topics == slow_topics
+    assert fast_table == slow_table
+
+
+def test_parity_with_hostile_rows():
+    """Rows the native parsers must fall back on: producer-style key names
+    (the KSQL null-column quirk), nulls, long strings, big ints, escapes,
+    floats in int columns, malformed JSON."""
+    base = _fleet_records(6)
+    hostile = [
+        # producer naming → mangled columns decode as NULL on both paths
+        {"tire_pressure_1_1": 30, "coolant_temp": 90.0,
+         "failure_occurred": "false"},
+        {**base[0], "FAILURE_OCCURRED": "esc\"aped\nnewline"},
+        {**base[1], "FAILURE_OCCURRED": "x" * 200},
+        {**base[2], "TIRE_PRESSURE11": 2 ** 60},
+        {**base[3], "TIRE_PRESSURE11": 1.5},
+        {**base[4], "COOLANT_TEMP": None},
+        {**base[5], "SPEED": 1e999},  # json.dumps → Infinity literal
+    ]
+    records = base + hostile
+    fast_topics, fast_table, _ = _run_pipeline(records, disable_fast=False)
+    slow_topics, slow_table, _ = _run_pipeline(records, disable_fast=True)
+    assert fast_topics == slow_topics
+    assert fast_table == slow_table
+
+
+def test_parity_with_malformed_messages():
+    """Non-JSON values and unframed Avro must drop identically."""
+    broker_pairs = []
+    for disable in (False, True):
+        broker = Broker()
+        broker.create_topic("sensor-data", partitions=1)
+        recs = _fleet_records(4)
+        for i, rec in enumerate(recs):
+            broker.produce("sensor-data", json.dumps(rec).encode(),
+                           key=b"car0", timestamp_ms=i)
+        broker.produce("sensor-data", b"not json at all", key=b"car0",
+                       timestamp_ms=9)
+        broker.produce("sensor-data", b"[1,2,3]", key=b"car0",
+                       timestamp_ms=10)
+        engine = SqlEngine(broker)
+        install_reference_pipeline(engine)
+        if disable:
+            for q in engine.queries.values():
+                t = q.task
+                if hasattr(t, "_fused_json"):
+                    t._fused_json = None
+                if hasattr(t, "_rekey_fast"):
+                    t._rekey_fast = False
+                if hasattr(t, "_fast_count"):
+                    t._fast_count = False
+        engine.pump()
+        out = [(m.key, m.value)
+               for m in broker.fetch("SENSOR_DATA_S_AVRO", 0, 0, 1000)]
+        broker_pairs.append((out, engine.table(
+            "SENSOR_DATA_EVENTS_PER_5MIN_T")))
+    assert broker_pairs[0] == broker_pairs[1]
+
+
+class TestNativeJsonDecode:
+    def test_columnar_parity_with_json_loads(self):
+        gen = FleetGenerator(FleetScenario(num_cars=3))
+        recs = [gen.row_record(gen.step_columns(), i % 3, KSQL_CAR_SCHEMA)
+                for i in range(32)]
+        msgs = [json.dumps(r).encode() for r in recs]
+        nc = NativeCodec(KSQL_CAR_SCHEMA)
+        num, lab, nulls, fb = nc.json_decode_batch(msgs, stride=64)
+        assert fb.sum() == 0
+        assert nulls.sum() == 0
+        numeric = [f.name for f in KSQL_CAR_SCHEMA.fields
+                   if f.avro_type != "string"]
+        strings = [f.name for f in KSQL_CAR_SCHEMA.fields
+                   if f.avro_type == "string"]
+        for i, r in enumerate(recs):
+            d = {k.upper(): v for k, v in r.items()}
+            assert [float(d[n]) for n in numeric] == num[i].tolist()
+            assert [d[s].encode() for s in strings] == list(lab[i])
+
+    def test_fallback_cases(self):
+        nc = NativeCodec(KSQL_CAR_SCHEMA)
+        gen = FleetGenerator(FleetScenario(num_cars=1))
+        good = gen.row_record(gen.step_columns(), 0, KSQL_CAR_SCHEMA)
+        cases = [
+            b"not json",
+            json.dumps({**good, "FAILURE_OCCURRED": "a\\u0041"}).encode(),
+            json.dumps({**good, "TIRE_PRESSURE11": 2 ** 53}).encode(),
+            json.dumps({**good, "TIRE_PRESSURE11": 0.5}).encode(),
+            json.dumps({**good, "FAILURE_OCCURRED": 7}).encode(),
+            json.dumps({**good, "extra": {"nested": 1}}).encode(),
+            json.dumps(good).encode() + b" trailing",
+        ]
+        _, _, _, fb = nc.json_decode_batch(cases, stride=64)
+        assert fb.tolist() == [1] * len(cases)
+        # unknown scalar keys are fine (dict semantics: ignored by the star)
+        ok_extra = json.dumps({**good, "extra": 1,
+                               "other": "s"}).encode()
+        _, _, _, fb = nc.json_decode_batch([ok_extra], stride=64)
+        assert fb.tolist() == [0]
+        # missing columns and explicit nulls are NULL rows, not fallbacks
+        nullish = [b"{}",
+                   json.dumps({**good, "COOLANT_TEMP": None}).encode()]
+        _, _, nulls, fb = nc.json_decode_batch(nullish, stride=64)
+        assert fb.tolist() == [0, 0]
+        assert nulls[0].all()          # empty object: every column null
+        cool = [f.name for f in KSQL_CAR_SCHEMA.fields].index("COOLANT_TEMP")
+        assert nulls[1, cool] == 1 and nulls[1].sum() == 1
+
+    def test_number_grammar_rejects_non_json_spellings(self):
+        nc = NativeCodec(KSQL_CAR_SCHEMA)
+        gen = FleetGenerator(FleetScenario(num_cars=1))
+        good = gen.row_record(gen.step_columns(), 0, KSQL_CAR_SCHEMA)
+        for bad_num in ("0x1A", "+1", "1.", ".5", "01", "1e", "- 1"):
+            raw = json.dumps(good).encode().replace(
+                json.dumps(good["COOLANT_TEMP"]).encode(),
+                bad_num.encode(), 1)
+            _, _, _, fb = nc.json_decode_batch([raw], stride=64)
+            assert fb.tolist() == [1], bad_num
+
+    def test_strictness_parity_ctrl_chars_and_utf8(self):
+        """json.loads is strict: raw control chars in strings and invalid
+        UTF-8 anywhere reject the whole message — the native parser must
+        fall those rows back, and must ACCEPT valid multi-byte UTF-8."""
+        nc = NativeCodec(KSQL_CAR_SCHEMA)
+        gen = FleetGenerator(FleetScenario(num_cars=1))
+        good = gen.row_record(gen.step_columns(), 0, KSQL_CAR_SCHEMA)
+        raw = json.dumps(good).encode()
+        reject = [
+            raw.replace(b'"false"', b'"fa\x00se"'),   # NUL in value
+            raw.replace(b'"SPEED"', b'"SP\x01ED"'),   # ctrl in key
+            raw.replace(b'"false"', b'"fa\xffse"'),   # invalid utf-8
+            raw.replace(b'"false"', b'"fa\xc0\xafse"'),  # overlong
+        ]
+        accept = [
+            raw.replace(b'"false"', b'"fa\xc3\xa9se"'),        # 2-byte
+            raw.replace(b'"false"', b'"fa\xf0\x9f\x98\x80se"'),  # 4-byte
+        ]
+        # an encoded UTF-16 surrogate is a fallback for the native parser
+        # but NOT a Python reject (json.loads decodes bytes with
+        # 'surrogatepass') — conservative fallback keeps parity, the
+        # python leg owns whatever happens next
+        surrogate = raw.replace(b'"false"', b'"fa\xed\xa0\x80se"')
+        _, _, _, fb = nc.json_decode_batch(reject + [surrogate], stride=64)
+        assert fb.tolist() == [1] * (len(reject) + 1)
+        for m in reject:  # python oracle agrees these are rejects
+            with pytest.raises((ValueError, UnicodeDecodeError)):
+                json.loads(m)
+        json.loads(surrogate)  # ...but accepts this one (surrogatepass)
+        _, lab, _, fb = nc.json_decode_batch(accept, stride=64)
+        assert fb.tolist() == [0, 0]
+        assert lab[0, 0] == json.loads(accept[0])["FAILURE_OCCURRED"].encode()
+
+    def test_duplicate_keys_last_wins(self):
+        gen = FleetGenerator(FleetScenario(num_cars=1))
+        good = gen.row_record(gen.step_columns(), 0, KSQL_CAR_SCHEMA)
+        raw = json.dumps(good).encode()
+        # append a duplicate of COOLANT_TEMP with a new value
+        raw = raw[:-1] + b', "COOLANT_TEMP": 123.5}'
+        nc = NativeCodec(KSQL_CAR_SCHEMA)
+        num, _, _, fb = nc.json_decode_batch([raw], stride=64)
+        assert fb.tolist() == [0]
+        cool_idx = [f.name for f in KSQL_CAR_SCHEMA.fields
+                    if f.avro_type != "string"].index("COOLANT_TEMP")
+        assert num[0, cool_idx] == 123.5
