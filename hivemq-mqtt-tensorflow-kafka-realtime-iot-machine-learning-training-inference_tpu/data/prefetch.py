@@ -2,11 +2,18 @@
 
 SURVEY §7 'hard parts (b)': host Avro decode + network consume must hide
 under the device step or throughput dies.  A background thread drains the
-batch iterator and stages `jax.device_put` results into a small queue, so
-while the TPU executes step N the host is already decoding and transferring
-step N+1 (double/triple buffering).  With a sharding, `device_put` lands
-shards directly on the mesh (the per-partition → per-shard assignment path
-used by `parallel.data_parallel`).
+batch iterator (decode/normalize/filter — the host-CPU leg) into a small
+queue; the CONSUMER thread issues the `jax.device_put` as it dequeues.
+`device_put` is asynchronous — it returns immediately and the DMA proceeds
+in the background — so the transfer still overlaps the device step without
+the worker thread ever touching JAX.  Keeping all JAX dispatch on one
+thread matters: concurrent dispatch from the staging thread intermittently
+aborted inside the PJRT CPU client on the forced-host 8-device mesh the
+test suite uses (SIGABRT in an XLA-internal thread, ~1 in 3 full-suite
+runs), and single-threaded dispatch costs nothing on real hardware.  With
+a sharding, `device_put` lands shards directly on the mesh (the
+per-partition → per-shard assignment path used by
+`parallel.data_parallel`).
 """
 
 from __future__ import annotations
@@ -65,9 +72,11 @@ class DevicePrefetcher:
         return False
 
     def _work(self):
+        # host-side decode only: the consumer thread runs to_device (all
+        # JAX calls stay on one thread — see module docstring)
         try:
             for b in self.batches:
-                if not self._put(self.to_device(b)):
+                if not self._put(b):
                     return  # consumer closed mid-stream
         except BaseException as e:  # surfaced on the consumer side
             self._err = e
@@ -103,6 +112,6 @@ class DevicePrefetcher:
                     if self._err is not None:
                         raise self._err
                     return
-                yield item
+                yield self.to_device(item)
         finally:
             self.close()
