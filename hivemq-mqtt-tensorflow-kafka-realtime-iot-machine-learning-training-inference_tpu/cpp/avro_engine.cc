@@ -18,6 +18,8 @@
 #include <cstdint>
 #include <cstring>
 
+#include "utf8_check.h"
+
 namespace {
 
 enum FieldType : int8_t {
@@ -30,15 +32,21 @@ enum FieldType : int8_t {
 };
 
 // Avro zigzag varint. Returns new position, or -1 on truncation.
+// `overlong` (optional) reports a non-minimal encoding — a multi-byte
+// varint whose final byte is 0x00 encodes a value a shorter varint could
+// carry; the canonical re-encode would differ byte-wise, which strict
+// (pass-through) callers must reject.
 inline int64_t read_varint(const uint8_t* buf, int64_t pos, int64_t end,
-                           int64_t* out) {
+                           int64_t* out, bool* overlong = nullptr) {
   uint64_t acc = 0;
   int shift = 0;
+  int64_t start = pos;
   while (pos < end) {
     uint8_t b = buf[pos++];
     acc |= static_cast<uint64_t>(b & 0x7F) << shift;
     if (!(b & 0x80)) {
       *out = static_cast<int64_t>(acc >> 1) ^ -static_cast<int64_t>(acc & 1);
+      if (overlong) *overlong = (b == 0x00 && pos - start > 1);
       return pos;
     }
     shift += 7;
@@ -77,17 +85,25 @@ extern "C" {
 //                row-major [n_msgs x n_strings] with the given stride.
 // Returns number of rows decoded; a malformed message stops decoding and
 // returns the negative of (rows_ok + 1) so callers can pinpoint it.
-int64_t iotml_decode_batch_nulls(const uint8_t* blob,
-                                 const int64_t* offsets, int64_t n_msgs,
-                                 const int8_t* types,
-                                 const uint8_t* nullable, int64_t n_fields,
-                                 int64_t strip, double* out_numeric,
-                                 char* out_labels, int64_t label_stride,
-                                 uint8_t* out_nulls) {
+static int64_t decode_impl(const uint8_t* blob,
+                           const int64_t* offsets, int64_t n_msgs,
+                           const int8_t* types,
+                           const uint8_t* nullable, int64_t n_fields,
+                           int64_t strip, double* out_numeric,
+                           char* out_labels, int64_t label_stride,
+                           uint8_t* out_nulls, bool strict) {
   // out_nulls: optional [n_msgs * n_fields] bitmap (1 = the nullable
   // union chose the null branch).  The columnar outputs cannot represent
   // null distinctly (numeric null -> 0.0, string null -> ""), so callers
   // needing exact null semantics check the bitmap and fall back.
+  //
+  // strict mode is the pass-through/count validation gate: it rejects
+  // anything the Python codec would reject (invalid UTF-8 in a string,
+  // union branch outside {0,1}) OR would silently CANONICALIZE on a
+  // decode→re-encode round trip (trailing bytes after the record,
+  // non-minimal varints) — exactly the conditions under which forwarding
+  // the original bytes unchanged would diverge from the per-row path.
+  //
   // Precompute per-field output slot (numeric col or string col).
   int64_t n_numeric = 0, n_strings = 0;
   for (int64_t f = 0; f < n_fields; ++f) {
@@ -105,8 +121,11 @@ int64_t iotml_decode_batch_nulls(const uint8_t* blob,
       bool is_null = false;
       if (nullable[f]) {
         int64_t branch;
-        pos = read_varint(buf, pos, end, &branch);
+        bool overlong = false;
+        pos = read_varint(buf, pos, end, &branch, &overlong);
         if (pos < 0) return -(i + 1);
+        if (strict && (overlong || (branch != 0 && branch != 1)))
+          return -(i + 1);
         is_null = (branch == 0);
       }
       if (out_nulls) out_nulls[i * n_fields + f] = is_null ? 1 : 0;
@@ -137,8 +156,9 @@ int64_t iotml_decode_batch_nulls(const uint8_t* blob,
         case F_LONG: {
           int64_t v = 0;
           if (!is_null) {
-            pos = read_varint(buf, pos, end, &v);
-            if (pos < 0) return -(i + 1);
+            bool overlong = false;
+            pos = read_varint(buf, pos, end, &v, &overlong);
+            if (pos < 0 || (strict && overlong)) return -(i + 1);
           }
           num_row[ncol++] = static_cast<double>(v);
           break;
@@ -160,8 +180,12 @@ int64_t iotml_decode_batch_nulls(const uint8_t* blob,
             break;
           }
           int64_t len;
-          pos = read_varint(buf, pos, end, &len);
+          bool overlong = false;
+          pos = read_varint(buf, pos, end, &len, &overlong);
           if (pos < 0 || len < 0 || pos + len > end) return -(i + 1);
+          if (strict && (overlong ||
+                         !iotml::valid_utf8(buf + pos, buf + pos + len)))
+            return -(i + 1);
           int64_t copy = len < label_stride - 1 ? len : label_stride - 1;
           std::memcpy(slot, buf + pos, copy);
           slot[copy] = '\0';
@@ -172,8 +196,21 @@ int64_t iotml_decode_batch_nulls(const uint8_t* blob,
           return -(i + 1);
       }
     }
+    if (strict && pos != end) return -(i + 1);  // trailing bytes
   }
   return n_msgs;
+}
+
+int64_t iotml_decode_batch_nulls(const uint8_t* blob,
+                                 const int64_t* offsets, int64_t n_msgs,
+                                 const int8_t* types,
+                                 const uint8_t* nullable, int64_t n_fields,
+                                 int64_t strip, double* out_numeric,
+                                 char* out_labels, int64_t label_stride,
+                                 uint8_t* out_nulls) {
+  return decode_impl(blob, offsets, n_msgs, types, nullable, n_fields,
+                     strip, out_numeric, out_labels, label_stride,
+                     out_nulls, /*strict=*/false);
 }
 
 int64_t iotml_decode_batch(const uint8_t* blob, const int64_t* offsets,
@@ -181,9 +218,24 @@ int64_t iotml_decode_batch(const uint8_t* blob, const int64_t* offsets,
                            const uint8_t* nullable, int64_t n_fields,
                            int64_t strip, double* out_numeric,
                            char* out_labels, int64_t label_stride) {
-  return iotml_decode_batch_nulls(blob, offsets, n_msgs, types, nullable,
-                                  n_fields, strip, out_numeric, out_labels,
-                                  label_stride, nullptr);
+  return decode_impl(blob, offsets, n_msgs, types, nullable, n_fields,
+                     strip, out_numeric, out_labels, label_stride, nullptr,
+                     /*strict=*/false);
+}
+
+// Strict validation decode for the pass-through/count fast paths (see
+// decode_impl): rejects what the Python codec rejects or would
+// canonicalize, so "validated" means "forwarding the original bytes is
+// byte-identical to decode→re-encode".
+int64_t iotml_decode_batch_strict(const uint8_t* blob,
+                                  const int64_t* offsets, int64_t n_msgs,
+                                  const int8_t* types,
+                                  const uint8_t* nullable, int64_t n_fields,
+                                  int64_t strip, double* out_numeric,
+                                  char* out_labels, int64_t label_stride) {
+  return decode_impl(blob, offsets, n_msgs, types, nullable, n_fields,
+                     strip, out_numeric, out_labels, label_stride, nullptr,
+                     /*strict=*/true);
 }
 
 // Encode n_msgs records from columnar input (the decode layout in reverse).
@@ -286,7 +338,8 @@ int64_t iotml_encode_batch(const double* numeric, const char* labels,
 // ABI history: 1 = avro batch codec; 2 = + kafka wire client;
 // 3 = + iotml_decode_batch_nulls (null-bitmap decode);
 // 4 = + iotml_json_decode_batch (batch JSON → columnar, json_engine.cc)
-//     + iotml_encode_batch_nulls (null-bitmap encode)
-int64_t iotml_engine_version() { return 4; }
+//     + iotml_encode_batch_nulls (null-bitmap encode);
+// 5 = + iotml_format_rows_f32/f64 (batch np.array2string, fmt_engine.cc)
+int64_t iotml_engine_version() { return 5; }
 
 }  // extern "C"

@@ -81,17 +81,65 @@ def _format_fast_row(row: np.ndarray) -> str:
     return "[" + "\n".join(out)[1:] + "]"
 
 
+def _format_rows_native(rows: np.ndarray):
+    """Whole-batch formatting in the C++ engine (fmt_engine.cc): one call
+    formats every eligible row; ineligible rows come back flagged and are
+    formatted through np.array2string here.  Returns None when the native
+    engine is unavailable or the dtype is not float32/float64 — caller
+    falls through to the per-row Python fast path."""
+    import ctypes
+
+    from ..stream import native
+
+    lib = native.load()
+    if lib is None or rows.dtype not in (np.float32, np.float64):
+        return None
+    fn = (lib.iotml_format_rows_f32 if rows.dtype == np.float32
+          else lib.iotml_format_rows_f64)
+    ptr_t = ctypes.c_float if rows.dtype == np.float32 else ctypes.c_double
+    rows = np.ascontiguousarray(rows)
+    n, f = rows.shape
+    # worst-case padded word ~ (20 left + 20 right + 2) chars; cap adds
+    # wrap newlines + brackets with slack, retried doubled on overflow
+    cap = int(n * (f * 44 + f + 18) + 64)
+    for _ in range(2):
+        out = np.empty((cap,), np.uint8)
+        offsets = np.zeros((n + 1,), np.int64)
+        fallback = np.zeros((n,), np.uint8)
+        total = fn(rows.ctypes.data_as(ctypes.POINTER(ptr_t)),
+                   ctypes.c_int64(n), ctypes.c_int64(f),
+                   out.ctypes.data_as(ctypes.c_char_p),
+                   ctypes.c_int64(cap),
+                   offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                   fallback.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+        if total >= 0:
+            raw = out.tobytes()
+            return [
+                raw[offsets[i]:offsets[i + 1]].decode()
+                if not fallback[i] else np.array2string(rows[i])
+                for i in range(n)
+            ]
+        cap *= 2
+    return None
+
+
 def format_rows(rows: np.ndarray) -> List[str]:
     """np.array2string for each row of [N, F], byte-identical, fast.
 
     Vectorized eligibility: a row takes the fast path iff every value is
     finite and the positional format applies (no exponential trigger).
     Everything else — and any session with non-default printoptions —
-    formats through numpy itself."""
+    formats through numpy itself.  The whole-batch C++ formatter carries
+    the eligible rows when the native engine is present; the per-element
+    dragon4 path below is the pure-Python fallback."""
     rows = np.asarray(rows)
     if rows.ndim != 2 or rows.dtype.kind != "f" or \
             not _options_are_default():
         return [np.array2string(r) for r in rows]
+
+    native_out = _format_rows_native(rows)
+    if native_out is not None:
+        return native_out
 
     finite = np.isfinite(rows).all(axis=1)
     absd = np.abs(rows.astype(np.float64))

@@ -42,7 +42,7 @@ def _build() -> bool:
         return False
 
 
-ENGINE_VERSION = 4  # must match iotml_engine_version() in avro_engine.cc
+ENGINE_VERSION = 5  # must match iotml_engine_version() in avro_engine.cc
 
 
 def _stale() -> bool:
@@ -80,9 +80,12 @@ def load() -> Optional[ctypes.CDLL]:
             return None
         lib.iotml_decode_batch.restype = ctypes.c_int64
         lib.iotml_decode_batch_nulls.restype = ctypes.c_int64
+        lib.iotml_decode_batch_strict.restype = ctypes.c_int64
         lib.iotml_encode_batch.restype = ctypes.c_int64
         lib.iotml_json_decode_batch.restype = ctypes.c_int64
         lib.iotml_encode_batch_nulls.restype = ctypes.c_int64
+        lib.iotml_format_rows_f32.restype = ctypes.c_int64
+        lib.iotml_format_rows_f64.restype = ctypes.c_int64
         _lib = lib
     except (OSError, AttributeError):
         _lib = None
@@ -117,7 +120,7 @@ class NativeCodec:
 
     # ------------------------------------------------------------- decode
     def _decode_impl(self, messages: List[bytes], strip: int,
-                     stride: int, want_nulls: bool):
+                     stride: int, want_nulls: bool, strict: bool = False):
         n = len(messages)
         if n == 0:
             empty = (np.zeros((0, self.n_numeric)),
@@ -145,6 +148,8 @@ class NativeCodec:
             nulls = np.zeros((n, self.n_fields), np.uint8)
             args.append(nulls.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
             rc = self._lib.iotml_decode_batch_nulls(*args)
+        elif strict:
+            rc = self._lib.iotml_decode_batch_strict(*args)
         else:
             rc = self._lib.iotml_decode_batch(*args)
         if rc != n:
@@ -153,14 +158,21 @@ class NativeCodec:
         return out + ((nulls,) if want_nulls else ())
 
     def decode_batch(self, messages: List[bytes], strip: int = 0,
-                     stride: int = LABEL_STRIDE
+                     stride: int = LABEL_STRIDE, strict: bool = False
                      ) -> Tuple[np.ndarray, np.ndarray]:
         """→ (numeric [n, n_numeric] float64, labels [n, n_strings]).
 
         Numeric columns are the schema's non-string fields in order — for
         the car schemas that is exactly the 18-sensor matrix.
-        """
-        return self._decode_impl(messages, strip, stride, want_nulls=False)
+
+        strict=True is the pass-through validation mode: it additionally
+        rejects (ValueError) records the Python codec would reject
+        (invalid UTF-8 strings, union branch outside {0,1}) or would
+        canonicalize on re-encode (trailing bytes, non-minimal varints) —
+        i.e. success guarantees forwarding the ORIGINAL bytes equals
+        decode→re-encode, the fast-path parity contract."""
+        return self._decode_impl(messages, strip, stride, want_nulls=False,
+                                 strict=strict)
 
     def decode_batch_nulls(self, messages: List[bytes], strip: int = 0,
                            stride: int = LABEL_STRIDE):

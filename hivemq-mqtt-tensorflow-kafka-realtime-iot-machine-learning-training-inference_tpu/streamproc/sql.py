@@ -755,11 +755,14 @@ class SqlSelectTask(StreamTask):
                 return None  # poisoned frame: generic path drops it
             vals.append(m.value)
         try:
-            # structural validation only — the bytes pass through; any
-            # malformed payload sends the whole batch to the generic path
-            # (which drops exactly the bad rows)
+            # strict validation — the bytes pass through, so success must
+            # guarantee forwarding the ORIGINAL payload is byte-identical
+            # to decode→re-encode (no trailing bytes, minimal varints,
+            # valid UTF-8, sane union branches); anything else sends the
+            # whole batch to the generic path, which drops/canonicalizes
+            # exactly the bad rows
             self._native_src.codec.decode_batch(
-                vals, strip=5, stride=_NativeAvroSource.STRIDE)
+                vals, strip=5, stride=_NativeAvroSource.STRIDE, strict=True)
         except (ValueError, TypeError, RuntimeError):
             return None
         header = self._rekey_header
@@ -961,11 +964,12 @@ class SqlAggTask(StreamTask):
                 return None
             vals.append(m.value)
         try:
-            # the Python path drops rows that fail to decode — validate the
-            # whole batch natively so the count matches exactly; a batch
-            # with any bad row takes the per-row path (which drops it)
+            # the Python path drops rows that fail to decode (including
+            # invalid UTF-8 in a string field) — validate the whole batch
+            # in strict mode so the count matches exactly; a batch with
+            # any bad row takes the per-row path (which drops it)
             self._native_src.codec.decode_batch(
-                vals, strip=5, stride=_NativeAvroSource.STRIDE)
+                vals, strip=5, stride=_NativeAvroSource.STRIDE, strict=True)
         except (ValueError, TypeError, RuntimeError):
             return None
         w = self.stmt.window_ms

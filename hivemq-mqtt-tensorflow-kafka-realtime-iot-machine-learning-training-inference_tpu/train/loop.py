@@ -19,6 +19,7 @@ TPU-first translation:
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 from typing import Callable, Optional
 
 import jax
@@ -131,23 +132,45 @@ def make_scanned_fit(model, tx, supervised: bool = False):
 # would re-trace (and without backend caching, re-compile) every time.  Keyed
 # on (model, tx identity-or-descriptor, supervised) so repeated jobs — e.g.
 # bench warm passes, periodic retrains — reuse the compiled program.
-_SCANNED_CACHE: dict = {}
+# Bounded LRU (not a bare dict): the closures hold their models strongly,
+# so an unbounded cache in a long-lived process that rebuilds models per
+# retrain cycle would pin every dead model and compiled program forever.
+_CACHE_LIMIT = 8
+_SCANNED_CACHE: OrderedDict = OrderedDict()
+_EVAL_CACHE: OrderedDict = OrderedDict()
+
+
+def _lru_get(cache, key, make):
+    fn = cache.get(key)
+    if fn is None:
+        fn = cache[key] = make()
+        if len(cache) > _CACHE_LIMIT:
+            cache.popitem(last=False)  # evict least-recently used
+    else:
+        cache.move_to_end(key)
+    return fn
 
 
 def scanned_fit_cached(model, tx, supervised: bool, tx_key=None):
     key = (model, tx_key if tx_key is not None else id(tx), supervised)
-    fn = _SCANNED_CACHE.get(key)
-    if fn is None:
-        fn = _SCANNED_CACHE[key] = make_scanned_fit(model, tx, supervised)
-    return fn
+    return _lru_get(_SCANNED_CACHE, key,
+                    lambda: make_scanned_fit(model, tx, supervised))
 
 
 def make_eval_step(model, supervised: bool = False):
-    @jax.jit
-    def step(params, x):
-        return model.apply({"params": params}, x)
+    """jit eval closure, cached per model (bounded LRU, see
+    _SCANNED_CACHE): every StreamScorer (and each serve drain in a
+    restart-per-drain deployment) calls this, and a fresh jit closure per
+    call would recompile the eval program each time — ~0.6s per drain on
+    a TPU tunnel, dominating a 10k-row drain."""
+    def make():
+        @jax.jit
+        def step(params, x):
+            return model.apply({"params": params}, x)
 
-    return step
+        return step
+
+    return _lru_get(_EVAL_CACHE, model, make)
 
 
 class Trainer:
@@ -229,13 +252,45 @@ class Trainer:
         import numpy as np
 
         t0 = time.perf_counter()
-        bs = list(iter(batches))
-        if not bs:
+        # Overlap host decode with the host→device transfer: batches are
+        # staged to the device in chunks AS they decode (device_put is
+        # asynchronous — the DMA for chunk k rides under the stream
+        # decode of chunk k+1), instead of decoding the whole slice
+        # before the first byte moves.  The chunks are concatenated on
+        # device; the fused kernel still sees one contiguous [N, B, F].
+        CHUNK = 32
+        dev_x, dev_m = [], []
+        cur_x, cur_m, host_y = [], [], []
+        first_x = None
+        records = 0
+        xs_nbytes = 0
+        # go through .epochs(1) when the source has it: for a cache=True
+        # SensorBatches that's what populates the replay cache (a bare
+        # iter() would consume the stream without caching, and a later
+        # fit over the same source would see nothing)
+        it = next(batches.epochs(1)) if hasattr(batches, "epochs") \
+            else iter(batches)
+        for b in it:
+            if first_x is None:
+                first_x = b.x
+            cur_x.append(b.x)
+            cur_m.append(b.mask)
+            host_y.append(b.y if b.y is not None else b.x)
+            records += b.n_valid
+            if len(cur_x) == CHUNK:
+                x = np.stack(cur_x)
+                xs_nbytes += x.nbytes
+                dev_x.append(jax.device_put(x))
+                dev_m.append(jax.device_put(np.stack(cur_m)))
+                cur_x, cur_m = [], []
+        if cur_x:
+            x = np.stack(cur_x)
+            xs_nbytes += x.nbytes
+            dev_x.append(jax.device_put(x))
+            dev_m.append(jax.device_put(np.stack(cur_m)))
+        if first_x is None:
             return {"loss": [], "accuracy": [], "records": [], "seconds": []}
-        xs = np.stack([b.x for b in bs])
-        masks = np.stack([b.mask for b in bs])
-        records = sum(b.n_valid for b in bs)
-        self._ensure_state(bs[0].x)
+        self._ensure_state(first_x)
 
         from ..ops import fused_train
 
@@ -244,25 +299,29 @@ class Trainer:
             fused_train.supported(self.state, self.supervised) and \
             self._tx_key is not None and \
             activity_l1 is not None and \
-            xs.nbytes <= fused_train.VMEM_DATA_BUDGET_BYTES
+            xs_nbytes <= fused_train.VMEM_DATA_BUDGET_BYTES
         if fused == "always" and not use_fused:
             raise ValueError("fused fit unsupported for this model/optimizer/"
                              "slice size")
+        import jax.numpy as _jnp
+
+        xs = dev_x[0] if len(dev_x) == 1 else _jnp.concatenate(dev_x)
+        masks = dev_m[0] if len(dev_m) == 1 else _jnp.concatenate(dev_m)
         if use_fused:
-            xs, masks = jax.device_put((xs, masks))
             self.state, losses, accs = fused_train.fused_fit(
                 self.state, xs, masks, epochs,
                 lr=self.learning_rate, l1=activity_l1)
         else:
             scanned = scanned_fit_cached(self.model, self.tx, self.supervised,
                                          tx_key=self._tx_key)
-            ys = np.stack([b.y if b.y is not None else b.x for b in bs])
-            xs, ys, masks = jax.device_put((xs, ys, masks))
+            ys = jax.device_put(np.stack(host_y))
             self.state, (losses, accs) = scanned(self.state, xs, ys, masks,
                                                  epochs)
         obs_metrics.records_trained.inc(records * epochs)
-        losses = np.asarray(jax.device_get(losses))
-        accs = np.asarray(jax.device_get(accs))
+        # ONE sync for both metric vectors: each device_get is a full
+        # tunnel round trip, and the second would wait on nothing new
+        losses, accs = (np.asarray(a)
+                        for a in jax.device_get((losses, accs)))
         dt = time.perf_counter() - t0
         return {"loss": losses.tolist(), "accuracy": accs.tolist(),
                 "records": [records] * epochs, "seconds": [dt / epochs] * epochs}

@@ -92,3 +92,75 @@ def test_fast_path_is_actually_fast():
     [np.array2string(r) for r in rows]
     base = time.perf_counter() - t0
     assert fast < base, f"fast path slower than numpy: {fast} vs {base}"
+
+
+# ---- native whole-batch formatter (fmt_engine.cc) -----------------------
+
+
+def _native_active():
+    from iotml.stream.native import available
+    return available()
+
+
+@pytest.mark.skipif(not _native_active(), reason="native engine unavailable")
+class TestNativeFormatter:
+    def test_native_path_engages(self):
+        from iotml.serve.fastfmt import _format_rows_native
+        rows = np.array([[1.25, 2.5]], np.float32)
+        out = _format_rows_native(rows)
+        assert out is not None
+        assert out[0] == np.array2string(rows[0])
+
+    def test_decimal_tie_rounding(self):
+        # dyadic rationals whose decimal expansion terminates with a '5'
+        # exactly at fractional digit 9: the 8-digit cutoff is an exact
+        # tie, resolved to-even over the exact value (dragon4 semantics)
+        ties = np.array([[1 / 512, 3 / 512, 5 / 512, 255 / 512],
+                        [7 / 512, 9 / 512, 11 / 512, 201 / 512]])
+        _check(ties)                    # float64
+        _check(ties.astype(np.float32))
+
+    def test_float32_vs_float64_precision(self):
+        # dragon4 runs at array dtype precision: f32 rows must use f32
+        # shortest-repr digits (1 + f32-ulp is "1.0000001", not the f64
+        # expansion "1.00000012")
+        v32 = np.nextafter(np.float32(1.0), np.float32(2.0))
+        _check(np.array([[v32, np.float32(0.1)]], np.float32))
+        v64 = np.nextafter(1.0, 2.0)
+        _check(np.array([[v64, 0.1]]))
+
+    def test_negative_zero_and_integers(self):
+        _check(np.array([[-0.0, 0.0, 1.0, -100.0, 25.0, 1e7]]))
+        _check(np.array([[-0.0, 0.0, 1.0, -100.0]], np.float32))
+
+    def test_eligibility_boundaries(self):
+        # values straddling every exponential-trigger bound, per row
+        _check(np.array([[9.9999999e7, 12345.0]]))      # just under 1e8
+        _check(np.array([[1.00000001e8, 12345.0]]))     # just over → exp
+        _check(np.array([[1.0e-4, 0.002]]))             # at the tiny bound
+        _check(np.array([[0.99999e-4, 0.002]]))         # below → exp
+        _check(np.array([[1.0, 999.99]]))               # ratio just under
+        _check(np.array([[1.0, 1000.01]]))              # ratio over → exp
+
+    def test_wrap_assembly_long_rows(self):
+        rng = np.random.default_rng(7)
+        for f in (18, 19, 29, 30, 31, 60, 100, 200):
+            _check(rng.uniform(-9.99, 9.99, (20, f)).astype(np.float32))
+            _check(rng.uniform(-9.99, 9.99, (8, f)))
+
+    def test_random_fuzz_against_numpy(self):
+        rng = np.random.default_rng(11)
+        for _ in range(20):
+            scale = 10.0 ** rng.integers(-3, 7)
+            rows = (rng.normal(size=(40, 18)) * scale)
+            _check(rows.astype(np.float32))
+            _check(rows)
+
+    def test_mixed_fallback_and_native_rows(self):
+        rng = np.random.default_rng(13)
+        x = rng.uniform(-1, 1, (60, 12)).astype(np.float32)
+        x[5, 0] = np.nan
+        x[17, 3] = np.inf
+        x[23, 7] = 5e9
+        x[31, 2] = 1e-6
+        _check(x)

@@ -242,3 +242,73 @@ class TestNativeJsonDecode:
         cool_idx = [f.name for f in KSQL_CAR_SCHEMA.fields
                     if f.avro_type != "string"].index("COOLANT_TEMP")
         assert num[0, cool_idx] == 123.5
+
+
+def test_strict_decode_rejects_noncanonical_avro():
+    """The pass-through paths may only forward bytes that decode→re-encode
+    would reproduce exactly: trailing bytes, invalid UTF-8 in strings,
+    non-minimal varints, and out-of-range union branches must all fall
+    back (strict ValueError) even though lax decode accepts them."""
+    from iotml.ops.avro import AvroCodec
+    from iotml.ops.framing import frame
+
+    codec = AvroCodec(KSQL_CAR_SCHEMA)
+    nc = NativeCodec(KSQL_CAR_SCHEMA)
+    gen = FleetGenerator(FleetScenario(num_cars=1))
+    rec = gen.row_record(gen.step_columns(), 0, KSQL_CAR_SCHEMA)
+    good = frame(codec.encode(rec), 7)
+    # sanity: the clean message passes strict validation
+    nc.decode_batch([good], strip=5, stride=64, strict=True)
+
+    bad_cases = {
+        "trailing": good + b"JUNK",
+        # FAILURE_OCCURRED is the last field: a valid-length string whose
+        # bytes are invalid UTF-8
+        "utf8": good[:-5] + bytes([good[-5]]) + b"\xff\xff\xff\xff",
+        # first field's union branch varint 1 (0x02) re-encoded overlong
+        # as 0x82 0x00
+        "overlong": good[:5] + b"\x82\x00" + good[6:],
+    }
+    for name, msg in bad_cases.items():
+        with pytest.raises(ValueError):
+            nc.decode_batch([msg], strip=5, stride=64, strict=True)
+        # ...while the lax decode (the ingest path's tolerance) accepts
+        # the trailing-bytes and overlong spellings
+        if name != "utf8":
+            nc.decode_batch([msg], strip=5, stride=64)
+
+
+def test_rekey_passthrough_parity_with_trailing_junk():
+    """End-to-end: a sensor-data JSON message is fine, but a crafted AVRO
+    message with trailing junk lands in SENSOR_DATA_S_AVRO via direct
+    produce; the REKEY output must be identical fast vs slow."""
+    from iotml.ops.avro import AvroCodec
+    from iotml.ops.framing import frame
+
+    outs = []
+    for disable in (False, True):
+        broker = Broker()
+        _produce(broker, _fleet_records(6))
+        engine = SqlEngine(broker)
+        install_reference_pipeline(engine)
+        codec = AvroCodec(KSQL_CAR_SCHEMA)
+        gen = FleetGenerator(FleetScenario(num_cars=1))
+        rec = gen.row_record(gen.step_columns(), 0, KSQL_CAR_SCHEMA)
+        broker.produce("SENSOR_DATA_S_AVRO",
+                       frame(codec.encode(rec), 3) + b"TRAILING",
+                       key=b"carX", timestamp_ms=5)
+        if disable:
+            for q in engine.queries.values():
+                t = q.task
+                if hasattr(t, "_fused_json"):
+                    t._fused_json = None
+                if hasattr(t, "_rekey_fast"):
+                    t._rekey_fast = False
+                if hasattr(t, "_fast_count"):
+                    t._fast_count = False
+        engine.pump()
+        spec = broker.topic("SENSOR_DATA_S_AVRO_REKEY")
+        outs.append([(p, m.key, m.value) for p in range(spec.partitions)
+                     for m in broker.fetch("SENSOR_DATA_S_AVRO_REKEY",
+                                           p, 0, 10000)])
+    assert outs[0] == outs[1]
