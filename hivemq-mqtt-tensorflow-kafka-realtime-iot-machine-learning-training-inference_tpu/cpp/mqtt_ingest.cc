@@ -30,6 +30,8 @@
 //   iotml_mqtt_ingest_close(h)
 
 #include <arpa/inet.h>
+#include <malloc.h>
+#include <ctime>
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
@@ -63,6 +65,7 @@ struct Ingest {
   std::vector<uint8_t> blob;
   std::vector<int32_t> tlens;
   std::vector<int32_t> plens;
+  int64_t last_trim_ms = 0;  // rate limit for malloc_trim (see clear())
 };
 
 void set_nonblock(int fd) {
@@ -237,6 +240,12 @@ void* iotml_mqtt_ingest_create(uint16_t port) {
   if (lfd < 0) return nullptr;
   int one = 1;
   setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  // deep receive buffers, inherited by accepted sockets with the right
+  // window scale: under backpressure stalls the unread kernel buffers
+  // overflow on loopback (drops → sender RTO exponential backoff, stuck
+  // flows at rto ~29s) — a deep buffer rides the stall out instead
+  int rcvbuf = 1 << 20;
+  setsockopt(lfd, SOL_SOCKET, SO_RCVBUF, &rcvbuf, sizeof rcvbuf);
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
@@ -330,7 +339,7 @@ long iotml_mqtt_ingest_poll(void* h, int timeout_ms) {
         // bound per-event intake: a connection whose kernel buffer filled
         // during a backpressure stall must not balloon its parse buffer
         // (the capacity would be retained); the rest re-reports next pass
-        if (c.in.size() >= (1u << 20)) break;
+        if (c.in.size() >= (256u << 10)) break;
       } else if (got == 0) {
         eof = true;  // parse what arrived in this pass FIRST — frames
         break;       // read together with the FIN must not be discarded
@@ -355,7 +364,15 @@ long iotml_mqtt_ingest_poll(void* h, int timeout_ms) {
       if (malformed) drop = true;
       if (!drop && pos > 0) {
         c.in.erase(c.in.begin(), c.in.begin() + pos);
-        if (c.in.capacity() > (256u << 10) && c.in.size() < 4096)
+        // shrink burst capacity: after a backlog burst (e.g. the post-
+        // stop drain of a full fleet) EVERY connection's parse buffer
+        // holds a tens-to-hundreds-of-KB capacity; at 9k connections the
+        // old >256KB threshold retained over a GB of dead capacity.  The
+        // 64KB threshold keeps steady-state buffers (a few KB per pass)
+        // untouched — no shrink/regrow churn — while reclaiming the
+        // drain-phase spikes (capacity cap is 256KB, the per-event
+        // intake bound).
+        if (c.in.capacity() > (64u << 10) && c.in.size() < 4096)
           c.in.shrink_to_fit();
       }
     }
@@ -378,6 +395,21 @@ void iotml_mqtt_ingest_clear(void* h) {
   ig->blob.clear();
   ig->tlens.clear();
   ig->plens.clear();
+  // hand freed heap back to the kernel: the burst buffers this engine
+  // churns (arena + per-conn parse buffers) otherwise sit in glibc's
+  // arenas and read as broker RSS forever.  Rate-limited to ~2/s —
+  // clear() runs after EVERY drained pass under load, and an
+  // every-pass trim would walk the arenas and madvise pages the next
+  // burst faults straight back in.  malloc_trim is glibc-specific; on
+  // other libcs it simply doesn't exist and this file is glibc/Linux-
+  // only already (epoll).
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC_COARSE, &ts);
+  int64_t now_ms = ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+  if (now_ms - ig->last_trim_ms >= 500) {
+    ig->last_trim_ms = now_ms;
+    malloc_trim(0);
+  }
 }
 
 void iotml_mqtt_ingest_close(void* h) {

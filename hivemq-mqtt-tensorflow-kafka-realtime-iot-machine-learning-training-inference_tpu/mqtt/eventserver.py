@@ -118,6 +118,14 @@ class MqttEventServer:
         self._pause_started: Optional[float] = None  # loop-thread only
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # generous receive buffers, set on the LISTENER so accepted sockets
+        # inherit them with the right window scale (post-connect shrinking
+        # wedges TCP).  Under a fleet-scale burst, per-conn buffer overflow
+        # on loopback manifests as packet loss → RTO exponential backoff →
+        # sockets stuck for tens of seconds with cwnd 1 (observed at
+        # backoff 7 / rto 29s in the 9k-conn drain phase) — a deep buffer
+        # absorbs a pass's worth of backlog instead.
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 20)
         self._lsock.bind((host, port))
         self._lsock.listen(1024)
         self._lsock.setblocking(False)
@@ -335,18 +343,35 @@ class MqttEventServer:
             pass
 
     def _readable(self, conn: _EConn) -> None:
-        try:
-            data = conn.sock.recv(1 << 16)
-        except (BlockingIOError, InterruptedError):
-            return
-        except OSError:
-            self._close(conn)
-            return
-        if not data:
-            self._close(conn)
+        # drain up to 4 chunks per event: one 64KB recv per pass left the
+        # kernel buffer refilling faster than the loop could circle back
+        # under burst load, overflowing it (→ loopback drops → RTO
+        # exponential backoff: stuck senders observed at rto ~29s, cwnd 1);
+        # bounded so one firehose connection cannot starve the rest of the
+        # pass.  Frames read together with an EOF are parsed BEFORE the
+        # close — the FIN does not void the data in front of it.
+        eof = False
+        got_any = False
+        for _ in range(4):
+            try:
+                data = conn.sock.recv(1 << 16)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                self._close(conn)
+                return
+            if not data:
+                eof = True
+                break
+            conn.inbuf += data
+            got_any = True
+            if len(data) < (1 << 16):
+                break
+        if not got_any:
+            if eof:
+                self._close(conn)
             return
         conn.last_recv = time.monotonic()
-        conn.inbuf += data
         pos = 0
         try:
             while True:
@@ -366,7 +391,7 @@ class MqttEventServer:
             return
         if pos:
             del conn.inbuf[:pos]
-        if conn.closing:
+        if conn.closing or eof:
             self._close(conn)
             return
         # publisher backpressure: this connection just fed us input; if the

@@ -4,6 +4,9 @@ is the de-facto sanitizer for the rebuild's hot shared structures — the
 broker log, the wire server, and the group coordinator under churn."""
 
 import threading
+import time
+
+import pytest
 
 from iotml.stream.broker import Broker
 from iotml.stream.group import GroupConsumer, GroupCoordinator
@@ -302,3 +305,133 @@ def test_firehose_publisher_bounded_broker_memory():
         pub.publish("vehicles/sensor/data/car-1", b"final", qos=1)
         pub.disconnect()
         sub.close()
+
+
+def test_close_storm_zero_loss_event_front():
+    """Deterministic connect/publish/close churn on the epoll front: every
+    qos-0 publish written before a clean close() must reach the bridge.
+
+    This pins the once-seen 'zombie connection' tail loss: under burst
+    load the listener's receive buffers overflowed on loopback, the
+    kernel dropped segments, and the closing senders fell into RTO
+    exponential backoff (observed rto ~29s, cwnd 1) — reading as lost
+    messages to any drain that gives up earlier.  Deep listener rcvbuf +
+    multi-chunk reads keep the flows out of backoff, and frames that
+    arrive with the FIN are parsed before the close."""
+    import socket as socket_mod
+
+    from iotml.mqtt.bridge import KafkaBridge
+    from iotml.mqtt.broker import MqttBroker
+    from iotml.mqtt.eventserver import MqttEventServer
+    from iotml.mqtt.wire import CONNACK, connect_packet, publish_packet
+    from iotml.stream.broker import Broker
+
+    mqtt_broker = MqttBroker()
+    stream = Broker()
+    stream.create_topic("sensor-data", partitions=4)
+    bridge = KafkaBridge(mqtt_broker, stream, partitions=4)
+    sent_counts = [0] * 4  # per-worker: summed after join (no shared +=)
+    stop = threading.Event()
+    errors: list = []
+
+    def churn(w):
+        payload = b"p" * 200
+        try:
+            for round_ in range(30):
+                socks = []
+                for i in range(20):
+                    s = socket_mod.create_connection(
+                        ("127.0.0.1", srv.port), timeout=10)
+                    s.sendall(connect_packet(f"storm-{w}-{round_}-{i}"))
+                    buf = b""
+                    while len(buf) < 4:
+                        chunk = s.recv(4 - len(buf))
+                        if not chunk:
+                            raise ConnectionError("EOF before CONNACK")
+                        buf += chunk
+                    assert buf[0] >> 4 == CONNACK
+                    socks.append(s)
+                for s in socks:
+                    # burst then IMMEDIATE close — the storm shape
+                    s.sendall(publish_packet(
+                        f"vehicles/sensor/data/s{w}", payload) * 25)
+                    sent_counts[w] += 25
+                    s.close()
+        except Exception as e:  # noqa: BLE001 - surfaced in the assert
+            errors.append(repr(e))
+
+    with MqttEventServer(mqtt_broker) as srv:
+        threads = [threading.Thread(target=churn, args=(w,), daemon=True)
+                   for w in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads), "churn worker hung"
+        assert not errors, errors
+        total = sum(sent_counts)
+        deadline = time.time() + 60
+        while bridge.forwarded() < total and time.time() < deadline:
+            time.sleep(0.02)
+        assert bridge.forwarded() == total, \
+            f"lost {total - bridge.forwarded()} of {total} in close-storm"
+
+
+def test_close_storm_zero_loss_native_front():
+    """The same storm against the C++ ingest engine."""
+    import socket as socket_mod
+
+    from iotml.mqtt.native_ingest import NativeIngestBridge
+    from iotml.mqtt.wire import CONNACK, connect_packet, publish_packet
+    from iotml.stream.broker import Broker
+
+    pytest.importorskip("ctypes")
+    from iotml.stream.native import available
+    if not available():
+        pytest.skip("native engine unavailable")
+
+    stream = Broker()
+    stream.create_topic("sensor-data", partitions=4)
+    sent_counts = [0] * 4  # per-worker: summed after join (no shared +=)
+    errors: list = []
+
+    def churn(w, port):
+        payload = b"p" * 200
+        try:
+            for round_ in range(30):
+                socks = []
+                for i in range(20):
+                    s = socket_mod.create_connection(
+                        ("127.0.0.1", port), timeout=10)
+                    s.sendall(connect_packet(f"storm-{w}-{round_}-{i}"))
+                    buf = b""
+                    while len(buf) < 4:
+                        chunk = s.recv(4 - len(buf))
+                        if not chunk:
+                            raise ConnectionError("EOF before CONNACK")
+                        buf += chunk
+                    assert buf[0] >> 4 == CONNACK
+                    socks.append(s)
+                for s in socks:
+                    s.sendall(publish_packet(
+                        f"vehicles/sensor/data/s{w}", payload) * 25)
+                    sent_counts[w] += 25
+                    s.close()
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    with NativeIngestBridge(stream, partitions=4) as bridge:
+        threads = [threading.Thread(target=churn, args=(w, bridge.port),
+                                    daemon=True) for w in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads), "churn worker hung"
+        assert not errors, errors
+        total = sum(sent_counts)
+        deadline = time.time() + 60
+        while bridge.forwarded() < total and time.time() < deadline:
+            time.sleep(0.02)
+        assert bridge.forwarded() == total, \
+            f"lost {total - bridge.forwarded()} of {total} in close-storm"
