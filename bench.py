@@ -7,13 +7,17 @@ Reference baselines (BASELINE.md):
 - fleet ingest: the full scenario is 100k MQTT clients at 1 msg/10 s ⇒
   ≈10,000 msgs/s fleet-wide steady state (scenario.xml:13-14,48-49).
 
-Seven benches, each a JSON line on stdout (the headline metric is printed
-LAST so line-oriented consumers keep finding it):
+One JSON line per metric on stdout (the headline metric is printed LAST so
+line-oriented consumers keep finding it):
 
   fleet_ingest_msgs_per_sec        raw-socket MQTT fleet → epoll listener →
                                    Kafka bridge → stream topic (L1→L3)
   fleet_ingest_native_msgs_per_sec the same fleet through the C++ ingest
                                    engine (cpp/mqtt_ingest.cc)
+  fleet_ingest_multiproc_msgs_per_sec
+                                   15,000 connections from separate load-
+                                   generator processes into the C++ engine
+                                   (server fd budget only — the scale path)
   wire_train_records_per_sec_per_chip
                                    the SAME train job as the headline, but
                                    over the TCP Kafka wire protocol with the
@@ -29,6 +33,11 @@ LAST so line-oriented consumers keep finding it):
   ksql_pipeline_records_per_sec    the four-object KSQL pipeline's pump rate
   streaming_train_records_per_sec_per_chip
                                    in-process upper bound (no network hop)
+  e2e_platform_records_per_sec     EVERY stage live at once (fleet → MQTT →
+                                   bridge → KSQL → train + serve →
+                                   predictions) at a paced 12k msgs/s
+  e2e_latency_ms                   publish→prediction flow-completion
+                                   latency (p50; p95 alongside)
 
 Statistics: every timed bench runs `IOTML_BENCH_PASSES` warm passes
 (default 7) after one cold pass (XLA compile); the reported value is the
@@ -498,6 +507,437 @@ def bench_fleet_ingest_native():
                             partitions=FLEET_PARTITIONS)
 
 
+# Self-contained load-generator child: stdlib only (run with -S: no site,
+# no sitecustomize, no jax — a child is sockets and bytes).  Owns its slice
+# of the fleet's client sockets so the SERVER process's fd table is the
+# only fd budget that binds, the way the reference's simulator nodes are
+# separate from its HiveMQ nodes (scenario.xml runs the fleet elsewhere).
+_FLEET_CHILD_SRC = r"""
+import base64, resource, socket, struct, sys, time
+port, n, prefix, duration, payload_b64 = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], float(sys.argv[4]),
+    sys.argv[5])
+payload = base64.b64decode(payload_b64)
+soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+
+
+def varlen(x):
+    out = bytearray()
+    while True:
+        b = x % 128
+        x //= 128
+        out.append(b | 0x80 if x else b)
+        if not x:
+            return bytes(out)
+
+
+def mstr(s):
+    b = s.encode()
+    return struct.pack(">H", len(b)) + b
+
+
+def connect_packet(cid):
+    body = mstr("MQTT") + bytes([4, 2]) + struct.pack(">H", 60) + mstr(cid)
+    return b"\x10" + varlen(len(body)) + body
+
+
+def publish_packet(topic, pl):
+    body = mstr(topic) + pl
+    return b"\x30" + varlen(len(body)) + body
+
+
+socks = []
+for i in range(n):
+    cid = f"{prefix}-{i:05d}"
+    s = socket.create_connection(("127.0.0.1", port), timeout=120)
+    s.sendall(connect_packet(cid))
+    buf = b""
+    while len(buf) < 4:
+        chunk = s.recv(4 - len(buf))
+        if not chunk:
+            raise SystemExit(f"EOF before CONNACK for {cid}")
+        buf += chunk
+    assert buf[0] >> 4 == 2, "expected CONNACK"
+    socks.append((s, publish_packet(f"vehicles/sensor/data/{cid}",
+                                    payload) * 8))
+sys.stdout.write("READY\n")
+sys.stdout.flush()
+sys.stdin.readline()  # GO
+t0 = time.time()
+sent = 0
+try:
+    while time.time() - t0 < duration:
+        for s, pkt in socks:
+            s.sendall(pkt)
+            sent += 8
+except OSError as e:
+    sys.stdout.write(f"ERR {e!r}\n")
+sys.stdout.write(f"SENT {sent}\n")
+sys.stdout.flush()
+for s, _ in socks:
+    try:
+        s.close()
+    except OSError:
+        pass
+"""
+
+
+def bench_fleet_ingest_multiproc():
+    """Fleet scale past one process's fd table: load-generator SUBPROCESSES
+    each own a slice of the client sockets (the reference runs its 100k-car
+    simulator on separate nodes, scenario.xml:13-14), so only the server's
+    fd budget binds.  15,000 connections into the C++ ingest engine;
+    delivered_pct counts only messages that reached the stream topic.
+
+    broker_rss_delta_mb here is honest in a way the in-process bench
+    cannot be: the publishers live in other processes, so the sampled RSS
+    is the SERVER's alone."""
+    import base64
+    import subprocess
+
+    from iotml.mqtt.native_ingest import NativeIngestBridge
+
+    n_conns = int(os.environ.get("IOTML_BENCH_FLEET_MP_CONNS", "15000"))
+    n_children = 5
+    duration = float(os.environ.get("IOTML_BENCH_FLEET_SECONDS", "8"))
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < hard:
+        resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+
+    payload_b64 = base64.b64encode(_car_payload()).decode()
+    stream = _fleet_stream()
+
+    def _vm_rss_kb():
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS"):
+                        return int(line.split()[1])
+        except OSError:
+            pass
+        return 0
+
+    per = n_conns // n_children
+    with NativeIngestBridge(stream, partitions=FLEET_PARTITIONS) as bridge:
+        rss0 = _vm_rss_kb()
+        rss_peak = [rss0]
+        rss_stop = threading.Event()
+
+        def _rss_sampler():
+            while not rss_stop.is_set():
+                rss_peak[0] = max(rss_peak[0], _vm_rss_kb())
+                time.sleep(0.1)
+
+        threading.Thread(target=_rss_sampler, daemon=True).start()
+        t_setup = time.perf_counter()
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("PALLAS_AXON", "AXON_", "JAX_"))}
+        children = [
+            subprocess.Popen(
+                [sys.executable, "-S", "-c", _FLEET_CHILD_SRC,
+                 str(bridge.port), str(per), f"ev-{c}", str(duration),
+                 payload_b64],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env,
+                text=True)
+            for c in range(n_children)
+        ]
+        try:
+            for ch in children:
+                line = ch.stdout.readline().strip()
+                if line != "READY":
+                    raise RuntimeError(f"load child failed: {line!r}")
+            setup_s = time.perf_counter() - t_setup
+            live_conns = bridge.ingest.connection_count
+            t0 = time.perf_counter()
+            for ch in children:
+                ch.stdin.write("GO\n")
+                ch.stdin.flush()
+            sent = 0
+            errors = []
+            for ch in children:
+                for line in ch.stdout:
+                    line = line.strip()
+                    if line.startswith("SENT "):
+                        sent += int(line.split()[1])
+                        break
+                    if line.startswith("ERR"):
+                        errors.append(line)
+                ch.wait(timeout=120)
+            elapsed = time.perf_counter() - t0
+            t_drain = time.perf_counter()
+            deadline = time.time() + 180
+            last, last_t = -1, time.time()
+            while bridge.forwarded() < sent and time.time() < deadline:
+                f = bridge.forwarded()
+                if f != last:
+                    last, last_t = f, time.time()
+                elif time.time() - last_t > 10:
+                    break  # no forward progress: stragglers are not coming
+                time.sleep(0.05)
+            drain_s = time.perf_counter() - t_drain
+            forwarded = bridge.forwarded()
+        finally:
+            rss_stop.set()
+            for ch in children:
+                if ch.poll() is None:
+                    ch.kill()
+        in_stream = sum(stream.end_offset("sensor-data", p)
+                        for p in range(FLEET_PARTITIONS))
+        out = dict(value=forwarded / (elapsed + drain_s),
+                   n_conns=live_conns, n_load_procs=n_children,
+                   duration_s=round(elapsed, 2), setup_s=round(setup_s, 2),
+                   drain_s=round(drain_s, 2), sent=sent,
+                   forwarded=forwarded, in_stream_topic=in_stream,
+                   delivered_pct=round(100.0 * forwarded / max(sent, 1), 2),
+                   broker_rss_delta_mb=round(
+                       (rss_peak[0] - rss0) / 1024.0, 1))
+        if errors:
+            out["worker_errors"] = errors[:4]
+        return out
+
+
+def bench_e2e_platform():
+    """THE reference claim, measured: every layer live at once.  The demo
+    the reference actually runs is fleet → HiveMQ → Kafka → KSQL →
+    training AND scoring concurrently, predictions written back
+    (README.md:100-108, scenario.xml:13-14) — not one leg at a time.
+
+    One process hosts the full platform (cli/up.py: MQTT epoll front +
+    bridge, wire broker, four-object KSQL pipeline, registry/connect);
+    paced publishers drive real MQTT at ~1.5× the reference's 10k msgs/s
+    fleet steady state; a trainer continuously fits fixed-size slices
+    from SENSOR_DATA_S_AVRO on the TPU; a scorer continuously drains the
+    same stream through the jit eval and writes np.array2string
+    predictions to model-predictions — all at the same time.
+
+    Latency is flow-completion: marker (published_count, t) pairs are
+    stamped every 250 ms; a marker resolves when the prediction topic's
+    total record count reaches the marker's published count, i.e. when
+    every record published up to t has traversed MQTT → bridge → KSQL →
+    scorer → predictions.  This UPPER-bounds per-record latency (it
+    includes finishing the whole backlog ahead of the marker)."""
+    from iotml.cli.up import Platform
+    from iotml.data.dataset import SensorBatches
+    from iotml.models.autoencoder import CAR_AUTOENCODER
+    from iotml.serve.scorer import StreamScorer
+    from iotml.stream.consumer import StreamConsumer
+    from iotml.stream.producer import OutputSequence
+    from iotml.train.loop import Trainer
+
+    # 12k msgs/s = 1.2× the reference fleet's 10k steady state — the
+    # highest paced rate at which the WHOLE concurrent pipeline (incl.
+    # training) holds flow-completion latency bounded on this box; the
+    # per-leg benches record each stage's isolated headroom above it
+    target_rate = float(os.environ.get("IOTML_BENCH_E2E_RATE", "12000"))
+    window_s = float(os.environ.get("IOTML_BENCH_E2E_SECONDS", "20"))
+    n_conns = 200
+    n_pub_threads = 4
+
+    platform = Platform(retention_messages=30_000).start()
+    stop = threading.Event()
+    err: list = []
+
+    # ---- continuous KSQL pump (the stream-preprocessing stage)
+    def ksql_pump():
+        while not stop.is_set():
+            try:
+                if platform.sql.pump() == 0:
+                    time.sleep(0.02)
+            except Exception as e:  # noqa: BLE001 - surfaced at the end
+                err.append(f"ksql: {e!r}")
+                return
+
+    # ---- continuous training: fixed-size slices from committed offsets
+    # (fixed shape → the scanned/fused fit compiles once, then every
+    # round reuses it — per-round recompiles would serialize the chip)
+    train_stats = {"rounds": 0, "records": 0}
+
+    def train_loop():
+        spec = platform.broker.topic("SENSOR_DATA_S_AVRO")
+        trainer = Trainer(CAR_AUTOENCODER)
+        group = "cardata-autoencoder-e2e"
+        take = 2_000
+        while not stop.is_set():
+            try:
+                consumer = StreamConsumer.from_committed(
+                    platform.broker, "SENSOR_DATA_S_AVRO",
+                    range(spec.partitions), group=group)
+                avail = sum(
+                    platform.broker.end_offset("SENSOR_DATA_S_AVRO", p)
+                    - (platform.broker.committed(
+                        group, "SENSOR_DATA_S_AVRO", p) or 0)
+                    for p in range(spec.partitions))
+                if avail < take:
+                    time.sleep(0.1)
+                    continue
+                batches = SensorBatches(consumer, batch_size=BATCH,
+                                        take=take, only_normal=True)
+                trainer.fit_compiled(batches, epochs=1)
+                consumer.commit()
+                train_stats["rounds"] += 1
+                train_stats["records"] += take
+            except Exception as e:  # noqa: BLE001
+                err.append(f"train: {e!r}")
+                return
+
+    # ---- continuous scoring → model-predictions (the predict pod)
+    def serve_loop(scorer):
+        while not stop.is_set():
+            try:
+                if scorer.score_available() == 0:
+                    time.sleep(0.02)
+            except Exception as e:  # noqa: BLE001
+                err.append(f"serve: {e!r}")
+                return
+
+    # ---- paced MQTT publishers (the fleet at 1.5× reference rate)
+    sent_counts = [0] * n_pub_threads
+    payload = _car_payload()
+    markers: list = []  # (published_count, t_monotonic)
+    measuring = threading.Event()
+
+    def publisher(w):
+        from iotml.mqtt.wire import CONNACK, connect_packet, publish_packet
+
+        conns = []
+        per = n_conns // n_pub_threads
+        try:
+            for i in range(per):
+                cid = f"e2e-{w}-{i:03d}"
+                s = socket.create_connection(
+                    ("127.0.0.1", platform.mqtt.port), timeout=30)
+                s.sendall(connect_packet(cid))
+                buf = b""
+                while len(buf) < 4:
+                    chunk = s.recv(4 - len(buf))
+                    if not chunk:
+                        raise ConnectionError(f"EOF before CONNACK ({cid})")
+                    buf += chunk
+                if buf[0] >> 4 != CONNACK:
+                    raise ConnectionError(f"expected CONNACK, got {buf[0]}")
+                conns.append((s, publish_packet(
+                    f"vehicles/sensor/data/{cid}", payload)))
+            rate = target_rate / n_pub_threads
+            sent = 0
+            t0 = time.perf_counter()
+            while not stop.is_set():
+                for s, pkt in conns:
+                    s.sendall(pkt)
+                    sent += 1
+                sent_counts[w] = sent
+                # pace to the target rate (deadline arithmetic, not a
+                # fixed sleep: sendall stalls must not lower the rate)
+                ahead = sent / rate - (time.perf_counter() - t0)
+                if ahead > 0:
+                    time.sleep(ahead)
+        except OSError as e:
+            if not stop.is_set():
+                err.append(f"publisher {w}: {e!r}")
+        finally:
+            for s, _ in conns:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def predictions_total():
+        spec = platform.broker.topic("model-predictions")
+        return sum(platform.broker.end_offset("model-predictions", p)
+                   for p in range(spec.partitions))
+
+    threads = [threading.Thread(target=ksql_pump, daemon=True)]
+    sc_spec = None
+    try:
+        # scorer needs trained-ish params: init from a tiny local fit
+        from iotml.stream.broker import Broker as _B
+        warm = _fill_broker(_B(), 2000)
+        wc = StreamConsumer(warm, ["SENSOR_DATA_S_AVRO:0:0"])
+        trainer0 = Trainer(CAR_AUTOENCODER)
+        trainer0.fit_compiled(
+            SensorBatches(wc, batch_size=BATCH, only_normal=True), epochs=1)
+        spec = platform.broker.topic("SENSOR_DATA_S_AVRO")
+        sc_spec = [f"SENSOR_DATA_S_AVRO:{p}:0" for p in range(spec.partitions)]
+        scorer = StreamScorer(
+            CAR_AUTOENCODER, trainer0.state.params,
+            SensorBatches(StreamConsumer(platform.broker, sc_spec,
+                                         group="scorer-e2e", eof=False),
+                          batch_size=BATCH),
+            OutputSequence(platform.broker, "model-predictions",
+                           partition=0), threshold=5.0)
+        threads += [threading.Thread(target=train_loop, daemon=True),
+                    threading.Thread(target=serve_loop, args=(scorer,),
+                                     daemon=True)]
+        threads += [threading.Thread(target=publisher, args=(w,),
+                                     daemon=True)
+                    for w in range(n_pub_threads)]
+        for t in threads:
+            t.start()
+        # ---- warmup: first records through every stage (compiles the
+        # scorer's eval + the trainer's fit before the measured window)
+        warm_deadline = time.time() + 120
+        while predictions_total() < 2_000 and time.time() < warm_deadline:
+            if err:
+                raise RuntimeError(err[0])
+            time.sleep(0.1)
+        if predictions_total() < 2_000:
+            raise RuntimeError("e2e warmup: predictions not flowing")
+        # ---- measured window
+        measuring.set()
+        t_win0 = time.perf_counter()
+        sent0 = sum(sent_counts)
+        preds0 = predictions_total()
+        lat_samples: list = []
+        next_marker = time.perf_counter()
+        pending: list = []
+        while time.perf_counter() - t_win0 < window_s:
+            now = time.perf_counter()
+            if now >= next_marker:
+                pending.append((sum(sent_counts), now))
+                next_marker = now + 0.25
+            done_total = predictions_total()
+            while pending and done_total >= pending[0][0]:
+                lat_samples.append(now - pending[0][1])
+                pending.pop(0)
+            time.sleep(0.02)
+        t_win = time.perf_counter() - t_win0
+        sent_win = sum(sent_counts) - sent0
+        preds_win = predictions_total() - preds0
+        # resolve markers still pending (bounded: they measure the tail)
+        tail_deadline = time.time() + 30
+        while pending and time.time() < tail_deadline:
+            done_total = predictions_total()
+            now = time.perf_counter()
+            while pending and done_total >= pending[0][0]:
+                lat_samples.append(now - pending[0][1])
+                pending.pop(0)
+            time.sleep(0.02)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+        platform.stop()
+    if err:
+        raise RuntimeError("; ".join(err[:3]))
+    lat_ms = sorted(x * 1000.0 for x in lat_samples)
+    # None, not NaN: json.dumps(NaN) is not valid JSON and would break
+    # strict line-oriented consumers of the metric lines
+    p50, p95 = _percentiles(lat_ms) if lat_ms else (None, None)
+    return dict(
+        value=preds_win / t_win,
+        window_s=round(t_win, 2),
+        publish_rate_msgs_per_sec=round(sent_win / t_win, 1),
+        predictions_in_window=preds_win,
+        unresolved_markers=len(pending),
+        latency_ms_p50=round(p50, 1) if p50 is not None else None,
+        latency_ms_p95=round(p95, 1) if p95 is not None else None,
+        n_latency_markers=len(lat_ms),
+        train_rounds=train_stats["rounds"],
+        records_trained=train_stats["records"],
+        stages="fleet+mqtt+bridge+ksql+train+serve concurrent",
+    )
+
+
 def main():
     t_all = time.perf_counter()
 
@@ -512,6 +952,11 @@ def main():
     order = [
         ("fleet_ingest_msgs_per_sec", "msgs/s", FLEET_BASELINE_MPS),
         ("fleet_ingest_native_msgs_per_sec", "msgs/s", FLEET_BASELINE_MPS),
+        # 15k connections from SEPARATE load-generator processes (only the
+        # server's fd table binds — the reference's simulator-on-its-own-
+        # nodes shape)
+        ("fleet_ingest_multiproc_msgs_per_sec", "msgs/s",
+         FLEET_BASELINE_MPS),
         ("wire_train_records_per_sec_per_chip", "records/s",
          TRAIN_BASELINE_RPS),
         # no reference twin for long context (its only sequence mechanism
@@ -525,6 +970,12 @@ def main():
         ("ksql_pipeline_records_per_sec", "records/s", FLEET_BASELINE_MPS),
         ("streaming_train_records_per_sec_per_chip", "records/s",
          TRAIN_BASELINE_RPS),
+        # the whole platform live at once: fleet → MQTT → bridge → KSQL →
+        # train + serve concurrently, predictions written back — the
+        # reference's actual demo shape, with publish→prediction
+        # flow-completion latency riding along as fields
+        ("e2e_platform_records_per_sec", "records/s", FLEET_BASELINE_MPS),
+        ("e2e_latency_ms", "ms", None),
     ]
     import gc
 
@@ -547,6 +998,23 @@ def main():
                 bench_fleet_ingest_native)
         except Exception as e:  # no toolchain: the Python front remains
             print(f"# fleet_ingest_native skipped: {e}", file=sys.stderr)
+        try:
+            run("fleet_ingest_multiproc_msgs_per_sec",
+                bench_fleet_ingest_multiproc)
+        except Exception as e:
+            print(f"# fleet_ingest_multiproc skipped: {e}", file=sys.stderr)
+        res = None
+        try:
+            run("e2e_platform_records_per_sec", bench_e2e_platform)
+            res = results["e2e_platform_records_per_sec"]
+        except Exception as e:
+            print(f"# e2e_platform skipped: {e}", file=sys.stderr)
+        if res is not None and res.get("latency_ms_p50") is not None:
+            results["e2e_latency_ms"] = dict(
+                value=res.get("latency_ms_p50"),
+                p95_ms=res.get("latency_ms_p95"),
+                n_markers=res.get("n_latency_markers"),
+                definition="publish→prediction flow completion")
     finally:
         for metric, unit, baseline in order:
             res = results.get(metric)
