@@ -48,7 +48,18 @@ def _dense_config(name: str, units: int, activation: str,
     return cfg
 
 
-def _model_config(input_dim: int, units: list, activity_l1: float) -> str:
+def _model_config(input_dim: int, units: list, activity_l1: float,
+                  style: str = "reference") -> str:
+    """Functional-model config JSON.
+
+    style="reference" reproduces the reference checkpoint's byte layout
+    exactly — including its pre-TF2 single-nested `inbound_nodes`
+    (`[['input_1', 0, 0, {}]]`), which Keras 3 can no longer deserialize
+    (it rejects the reference's own artifacts identically).
+    style="modern" emits the TF2-era triple-nested form
+    (`[[['input_1', 0, 0, {}]]]`) that current Keras' legacy-h5 loader
+    accepts — same weights, same architecture, loadable today."""
+    modern = style == "modern"
     layers = [{
         "name": "input_1", "class_name": "InputLayer",
         "config": {"batch_input_shape": [None, input_dim],
@@ -58,17 +69,19 @@ def _model_config(input_dim: int, units: list, activity_l1: float) -> str:
     prev = "input_1"
     for i, n in enumerate(units):
         name = "dense" if i == 0 else f"dense_{i}"
+        node = [prev, 0, 0, {}]
         layers.append({
             "name": name, "class_name": "Dense",
             "config": _dense_config(name, n, _ACTIVATIONS[i],
                                     activity_l1 if i == 0 else 0.0),
-            "inbound_nodes": [[prev, 0, 0, {}]],
+            "inbound_nodes": [[node]] if modern else [node],
         })
         prev = name
+    io_in, io_out = ["input_1", 0, 0], [prev, 0, 0]
     return json.dumps({"class_name": "Model", "config": {
         "name": "model", "layers": layers,
-        "input_layers": ["input_1", 0, 0],
-        "output_layers": [prev, 0, 0]}})
+        "input_layers": [io_in] if modern else io_in,
+        "output_layers": [io_out] if modern else io_out}})
 
 
 _TRAINING_CONFIG = json.dumps({
@@ -83,12 +96,18 @@ _TRAINING_CONFIG = json.dumps({
 
 
 def autoencoder_params_to_h5(params: dict, path: str,
-                             activity_l1: float = 1e-7) -> str:
+                             activity_l1: float = 1e-7,
+                             style: str = "reference") -> str:
     """Write DenseAutoencoder params as a reference-compatible Keras h5.
 
     `params` is the flax tree {encoder0|encoder1|decoder0|decoder1:
     {kernel, bias}}.  Keras Dense kernels are [in, out] like flax's, so
-    tensors pass through unchanged."""
+    tensors pass through unchanged.
+
+    style: "reference" (default) matches the reference checkpoints'
+    byte layout field-for-field; "modern" differs only in the
+    model_config nesting so CURRENT Keras can `load_model` it (see
+    `_model_config` — verified by tests/test_h5_keras_interop.py)."""
     import h5py
 
     stack = [params[name] for name in _LAYER_ORDER]
@@ -101,7 +120,7 @@ def autoencoder_params_to_h5(params: dict, path: str,
         f.attrs["backend"] = np.bytes_(b"tensorflow")
         f.attrs["keras_version"] = np.bytes_(b"2.2.4-tf")
         f.attrs["model_config"] = np.bytes_(
-            _model_config(input_dim, units, activity_l1).encode())
+            _model_config(input_dim, units, activity_l1, style).encode())
         f.attrs["training_config"] = np.bytes_(_TRAINING_CONFIG.encode())
         mw = f.create_group("model_weights")
         layer_names = ["input_1"] + keras_names
