@@ -98,11 +98,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
         l_s[:] = jnp.zeros_like(l_s)
 
     def compute():
-        q = q_ref[:].astype(jnp.float32) * scale
-        k_blk = k_ref[:].astype(jnp.float32)
+        # QK rides the MXU at the INPUT dtype (bf16 inputs → bf16 systolic
+        # passes, f32 accumulation — exact products, ~4× the f32 rate);
+        # the scale is applied to the f32 scores afterwards so no
+        # precision is spent on it.  P·V stays f32: the probabilities are
+        # f32-precision quantities and the output tolerance pins them.
         v_blk = v_ref[:].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        s = jax.lax.dot_general(q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             qi = jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0) + i * block_q
@@ -199,6 +202,198 @@ def _flash_forward(q, k, v, causal: bool, block_q: int,
     return out, lse
 
 
+def _bwd_common(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
+                scale, causal, block_q, block_k, t_real, i, j):
+    """Shared recompute for both backward kernels: returns (p, ds) f32.
+
+    Matmul dtype policy mirrors the forward: score/dP matmuls run at the
+    input dtype (exact products for bf16, MXU bf16 rate, f32 accumulate);
+    p/ds stay f32 — they are exp-of-f32 quantities the gradient
+    tolerances pin."""
+    s = jax.lax.dot_general(q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    qi = jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0) + i * block_q
+    kj = jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1) + j * block_k
+    mask = kj < t_real
+    if causal:
+        mask = mask & (qi >= kj)
+    p = jnp.where(mask, jnp.exp(s - lse_ref[:]), 0.0)
+    dp = jax.lax.dot_general(do_ref[:], v_ref[:], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[:]) * scale
+    return p, ds
+
+
+def _flash_bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, scale,
+                          causal, block_q, block_k, t_real):
+    """dK/dV: grid (BH, kv_blocks, q_blocks) — for one kv block, stream
+    the q blocks through VMEM accumulating dk/dv in scratch; p never
+    touches HBM (the jnp fallback's bandwidth wall)."""
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+    i = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def compute():
+        p, ds = _bwd_common(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                            scale=scale, causal=causal, block_q=block_q,
+                            block_k=block_k, t_real=t_real, i=i, j=j)
+        do_f = do_ref[:].astype(jnp.float32)
+        q_f = q_ref[:].astype(jnp.float32)
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p, do_f, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds, q_f, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # q blocks strictly before this kv block contribute nothing
+        @pl.when(i * block_q + (block_q - 1) >= j * block_k)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(i == nq - 1)
+    def _emit():
+        dk_ref[:] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                         dq_ref, dq_acc, *, scale, causal, block_q,
+                         block_k, t_real):
+    """dQ: grid (BH, q_blocks, kv_blocks) — one q block accumulates over
+    its (causally relevant) kv blocks."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def compute():
+        _, ds = _bwd_common(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                            scale=scale, causal=causal, block_q=block_q,
+                            block_k=block_k, t_real=t_real, i=i, j=j)
+        k_f = k_ref[:].astype(jnp.float32)
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds, k_f, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(j * block_k <= i * block_q + (block_q - 1))
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(j == nk - 1)
+    def _emit():
+        dq_ref[:] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, do, causal: bool, block_q: int,
+                    block_k: int, interpret: bool):
+    """Pallas flash-attention backward: the standard two-kernel split
+    (dkv sweeping q per kv block; dq sweeping kv per q block — p/ds
+    recomputed blockwise in VMEM, never materialized to HBM)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    # independent backward tile sizes: three [bq, bk] f32 temporaries live
+    # in VMEM at once, so cap them below the forward's
+    bq = min(block_q, 512)
+    bk = min(block_k, 512)
+    Tq = ((T + bq - 1) // bq) * bq
+    Tk = ((T + bk - 1) // bk) * bk
+
+    do_f = do.astype(jnp.float32)
+    # rowwise D_i = sum_d dO_i·O_i (softmax-jacobian diagonal term)
+    delta = jnp.einsum("bqhd,bqhd->bhq", do_f, out.astype(jnp.float32))
+
+    def fold_q(x, pad_value=0.0):
+        x = x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+        return jnp.pad(x, [(0, 0), (0, Tq - T), (0, 0)],
+                       constant_values=pad_value)
+
+    qf = fold_q(q)
+    dof = fold_q(do)
+    kf = jnp.pad(k.transpose(0, 2, 1, 3).reshape(B * H, T, D),
+                 [(0, 0), (0, Tk - T), (0, 0)])
+    vf = jnp.pad(v.transpose(0, 2, 1, 3).reshape(B * H, T, D),
+                 [(0, 0), (0, Tk - T), (0, 0)])
+    # padded q rows: +BIG lse → p = exp(s - BIG) = 0, so they contribute
+    # nothing to dk/dv and their dq rows are sliced off
+    lse_f = jnp.pad(lse.reshape(B * H, T, 1),
+                    [(0, 0), (0, Tq - T), (0, 0)],
+                    constant_values=1e30)
+    delta_f = jnp.pad(delta.reshape(B * H, T, 1),
+                      [(0, 0), (0, Tq - T), (0, 0)])
+
+    q_spec_i = pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0))
+    q_spec_j = pl.BlockSpec((None, bq, D), lambda b, j, i: (b, i, 0))
+    r_spec_i = pl.BlockSpec((None, bq, 1), lambda b, i, j: (b, i, 0))
+    r_spec_j = pl.BlockSpec((None, bq, 1), lambda b, j, i: (b, i, 0))
+    kv_spec_i = pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0))
+    kv_spec_j = pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0))
+
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq,
+        block_k=bk, t_real=T)
+    dk_f, dv_f = pl.pallas_call(
+        dkv_kernel,
+        grid=(B * H, Tk // bk, Tq // bq),
+        in_specs=[q_spec_j, q_spec_j, r_spec_j, r_spec_j,
+                  kv_spec_j, kv_spec_j],
+        out_specs=[pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0)),
+                   pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0))],
+        out_shape=[jax.ShapeDtypeStruct((B * H, Tk, D), k.dtype),
+                   jax.ShapeDtypeStruct((B * H, Tk, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(qf, dof, lse_f, delta_f, kf, vf)
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, scale=scale, causal=causal, block_q=bq,
+        block_k=bk, t_real=T)
+    dq_f = pl.pallas_call(
+        dq_kernel,
+        grid=(B * H, Tq // bq, Tk // bk),
+        in_specs=[q_spec_i, q_spec_i, r_spec_i, r_spec_i,
+                  kv_spec_i, kv_spec_i],
+        out_specs=pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(qf, dof, lse_f, delta_f, kf, vf)
+
+    def unfold(x, Tp):
+        return x.reshape(B, H, Tp, D).transpose(0, 2, 1, 3)[:, :T]
+
+    return unfold(dq_f, Tq), unfold(dk_f, Tk), unfold(dv_f, Tk)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
                     block_k: int = 128, interpret: bool = False):
@@ -209,9 +404,10 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
     block).  `interpret=True` runs the same kernel on CPU for tests.
 
     Differentiable via custom VJP: the forward kernel emits the per-row
-    log-sum-exp; the backward recomputes attention probabilities blockwise
-    in jnp (lax.scan over KV blocks — O(T·block) memory, XLA-fused), the
-    standard flash-attention recompute strategy.
+    log-sum-exp; the backward is the standard two-kernel Pallas split
+    (dK/dV sweeping q blocks per kv block, dQ sweeping kv blocks per q
+    block) with blockwise probability recompute in VMEM — O(T·block)
+    memory and no HBM round trip for the probability matrices.
     """
     out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
     return out
@@ -224,43 +420,8 @@ def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
 
 def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
     q, k, v, out, lse = res
-    B, T, H, D = q.shape
-    scale = 1.0 / math.sqrt(D)
-    qf = q.astype(jnp.float32)
-    do = do.astype(jnp.float32)
-    # rowwise D_i = sum_d dO_i·O_i  (the softmax-jacobian diagonal term)
-    delta = jnp.einsum("bqhd,bqhd->bhq", do, out.astype(jnp.float32))
-
-    nkb = (T + block_k - 1) // block_k
-    Tp = nkb * block_k
-    pad = [(0, 0), (0, Tp - T), (0, 0), (0, 0)]
-    kp = jnp.pad(k.astype(jnp.float32), pad).reshape(B, nkb, block_k, H, D)
-    vp = jnp.pad(v.astype(jnp.float32), pad).reshape(B, nkb, block_k, H, D)
-    kpos_pad = jnp.arange(Tp).reshape(nkb, block_k)
-    qpos = jnp.arange(T)
-
-    def kv_block(dq_acc, blk):
-        k_blk, v_blk, kpos = blk  # [B,block_k,H,D], [block_k]
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk) * scale
-        mask = kpos[None, :] < T  # padding guard
-        if causal:
-            mask = mask & (qpos[:, None] >= kpos[None, :])
-        s = jnp.where(mask[None, None], s, NEG_INF)
-        p = jnp.exp(s - lse[..., None])  # [B,H,Tq,block_k]; 0 where masked
-        dv = jnp.einsum("bhqk,bqhd->bkhd", p, do)
-        dp = jnp.einsum("bqhd,bkhd->bhqk", do, v_blk)
-        ds = p * (dp - delta[..., None]) * scale
-        dq_acc = dq_acc + jnp.einsum("bhqk,bkhd->bqhd", ds, k_blk)
-        dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
-        return dq_acc, (dk, dv)
-
-    dq0 = jnp.zeros((B, T, H, D), jnp.float32)
-    dq, (dks, dvs) = jax.lax.scan(
-        kv_block, dq0,
-        (kp.transpose(1, 0, 2, 3, 4), vp.transpose(1, 0, 2, 3, 4), kpos_pad))
-    dk = dks.transpose(1, 0, 2, 3, 4).reshape(B, Tp, H, D)[:, :T]
-    dv = dvs.transpose(1, 0, 2, 3, 4).reshape(B, Tp, H, D)[:, :T]
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return _flash_backward(q, k, v, out, lse, do, causal, block_q, block_k,
+                           interpret)
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
