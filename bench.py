@@ -836,11 +836,9 @@ def bench_e2e_platform():
                 err.append(f"serve: {e!r}")
                 return
 
-    # ---- paced MQTT publishers (the fleet at 1.5× reference rate)
+    # ---- paced MQTT publishers (the fleet above the reference rate)
     sent_counts = [0] * n_pub_threads
     payload = _car_payload()
-    markers: list = []  # (published_count, t_monotonic)
-    measuring = threading.Event()
 
     def publisher(w):
         from iotml.mqtt.wire import CONNACK, connect_packet, publish_packet
@@ -928,7 +926,6 @@ def bench_e2e_platform():
         if predictions_total() < 2_000:
             raise RuntimeError("e2e warmup: predictions not flowing")
         # ---- measured window
-        measuring.set()
         t_win0 = time.perf_counter()
         sent0 = sum(sent_counts)
         preds0 = predictions_total()
@@ -959,9 +956,14 @@ def bench_e2e_platform():
             time.sleep(0.02)
     finally:
         stop.set()
-        for t in threads:
-            t.join(timeout=15)
-        platform.stop()
+        try:
+            for t in threads:
+                if t.ident is not None:  # a setup failure may leave some
+                    t.join(timeout=15)   # threads created but unstarted
+        finally:
+            platform.stop()  # ALWAYS: a leaked platform (epoll front,
+            #                  servers) would outlive the bench and mask
+            #                  the original error
     if err:
         raise RuntimeError("; ".join(err[:3]))
     lat_ms = sorted(x * 1000.0 for x in lat_samples)

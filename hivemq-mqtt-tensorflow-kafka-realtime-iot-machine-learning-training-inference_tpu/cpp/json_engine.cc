@@ -253,6 +253,14 @@ int64_t iotml_json_decode_batch(
                 bad = true;  // beyond float64-exact int range
                 break;
               }
+              if (t == F_FLOAT && (v > 3.4028234663852886e38 ||
+                                   v < -3.4028234663852886e38)) {
+                // beyond float32 range (incl. strtod's ERANGE infinity):
+                // Python's struct.pack('<f') raises for finite overflow —
+                // the Python leg owns that error semantics
+                bad = true;
+                break;
+              }
               num_row[cols[ci].slot] = v;
               null_row[ci] = 0;
               found |= 1ull << ci;

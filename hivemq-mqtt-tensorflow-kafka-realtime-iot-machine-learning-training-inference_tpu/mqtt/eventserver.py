@@ -358,8 +358,11 @@ class MqttEventServer:
             except (BlockingIOError, InterruptedError):
                 break
             except OSError:
-                self._close(conn)
-                return
+                # error-close (e.g. RST after a burst): frames already
+                # received THIS pass still parse below — same invariant as
+                # the FIN case, the close does not void the data before it
+                eof = True
+                break
             if not data:
                 eof = True
                 break

@@ -529,8 +529,11 @@ class MqttClient:
         while not self._closed.wait(interval_s):
             try:
                 self.ping(timeout=interval_s)
-            except (OSError, TimeoutError):
-                return  # connection gone (or wedged): the reader owns errors
+            except TimeoutError:
+                continue  # one slow PINGRESP (GC pause, loaded box) must
+                #           not permanently disable keepalive
+            except OSError:
+                return  # connection gone: the reader owns errors
 
     def _read_exact(self, n: int) -> bytes:
         return _recv_exact(self._sock, n)

@@ -113,7 +113,10 @@ def _format_rows_native(rows: np.ndarray):
                    offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
                    fallback.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
         if total >= 0:
-            raw = out.tobytes()
+            # slice before copying: cap over-allocates ~4× the formatted
+            # bytes, and tobytes() on the full buffer would memcpy the
+            # slack on every drain of the very hot path this exists for
+            raw = out[:total].tobytes()
             return [
                 raw[offsets[i]:offsets[i + 1]].decode()
                 if not fallback[i] else np.array2string(rows[i])

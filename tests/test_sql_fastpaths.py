@@ -312,3 +312,25 @@ def test_rekey_passthrough_parity_with_trailing_junk():
                      for m in broker.fetch("SENSOR_DATA_S_AVRO_REKEY",
                                            p, 0, 10000)])
     assert outs[0] == outs[1]
+
+
+def test_json_decode_float32_range_guard():
+    """A finite JSON number beyond float32 range in an Avro 'float' column
+    must fall back: the Python leg raises on encode (struct.pack '<f'
+    overflow) and owns that error semantics.  Double columns keep the
+    full float64 range."""
+    from iotml.core.schema import Field, RecordSchema
+
+    schema = RecordSchema(
+        name="F32Rec", namespace="t",
+        fields=(Field("a", "float"), Field("b", "double")))
+    nc = NativeCodec(schema)
+    cases = [
+        (b'{"A": 1.5, "B": 1.5}', 0),        # in range
+        (b'{"A": 3.3e38, "B": 1.0}', 0),     # near float32 max, ok
+        (b'{"A": 3.5e38, "B": 1.0}', 1),     # finite overflow -> python
+        (b'{"A": 1e999, "B": 1.0}', 1),      # strtod infinity -> python
+        (b'{"A": 1.0, "B": 1e300}', 0),      # double keeps its range
+    ]
+    _, _, _, fb = nc.json_decode_batch([c for c, _ in cases], stride=64)
+    assert fb.tolist() == [want for _, want in cases]
