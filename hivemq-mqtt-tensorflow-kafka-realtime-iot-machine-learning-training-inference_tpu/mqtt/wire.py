@@ -18,6 +18,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .broker import MqttBroker
@@ -495,7 +496,13 @@ class MqttClient:
         self._qos2_inbound: set = set()
         self._suback = threading.Event()
         self._suback_codes: List[int] = []
-        self._pingresp = threading.Event()
+        # ping pairing: PINGRESPs are FIFO per connection, so response N
+        # answers request N — counting both sides lets ping() wait for THE
+        # response to ITS request, immune to late responses of abandoned
+        # (timed-out keepalive) requests satisfying a later barrier early
+        self._ping_sent = 0
+        self._ping_rcvd = 0
+        self._ping_cv = threading.Condition()
         self._next_pid = 0
         self._wlock = threading.Lock()
         self._sock.sendall(connect_packet(client_id, protocol_level,
@@ -598,7 +605,9 @@ class MqttClient:
                     self._suback_codes = list(body[pos:])
                     self._suback.set()
                 elif ptype == PINGRESP:
-                    self._pingresp.set()
+                    with self._ping_cv:
+                        self._ping_rcvd += 1
+                        self._ping_cv.notify_all()
         except (ConnectionError, OSError):
             pass
 
@@ -650,14 +659,26 @@ class MqttClient:
         connection's packets in order, a returned ping guarantees every
         prior qos-0 publish on this connection has been fully fanned out —
         the deterministic quiesce barrier the scenario runner uses.
-        Serialized with the auto-keepalive pings so each PINGRESP pairs
-        with exactly one in-flight PINGREQ."""
+
+        Sequence-paired: this waits for the response to ITS OWN request
+        (PINGRESP N answers PINGREQ N on an ordered connection), so a late
+        response to an earlier abandoned request — e.g. a keepalive ping
+        that timed out on a loaded box — can never satisfy the barrier
+        early."""
         with self._ping_lock:
-            self._pingresp.clear()
+            with self._ping_cv:
+                self._ping_sent += 1
+                target = self._ping_sent
             with self._wlock:
                 self._sock.sendall(packet(PINGREQ, 0, b""))
-            if not self._pingresp.wait(timeout):
-                raise TimeoutError("no PINGRESP")
+            deadline = time.monotonic() + timeout
+            with self._ping_cv:
+                while self._ping_rcvd < target:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._ping_cv.wait(remaining):
+                        if self._ping_rcvd >= target:
+                            break
+                        raise TimeoutError("no PINGRESP")
 
     def disconnect(self) -> None:
         self._closed.set()

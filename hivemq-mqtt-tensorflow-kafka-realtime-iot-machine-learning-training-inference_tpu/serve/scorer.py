@@ -63,8 +63,11 @@ class StreamScorer:
     #: an arbitrarily deep backlog (e.g. scoring a retained topic from offset
     #: 0) proceeds in fixed-size super-batches so host+device memory stays
     #: bounded, while a typical drain (≤ this many batches) keeps the
-    #: single-dispatch win.
-    max_super_batches = 64
+    #: single-dispatch win.  128 batches × 100 rows × 18 features is under
+    #: 1 MB on device — the bound exists for pathological backlogs, and at
+    #: 64 the reference-shaped 10k-row drain was paying TWO device round
+    #: trips instead of one.
+    max_super_batches = 128
 
     def __init__(self, model, params, batches: SensorBatches,
                  out: OutputSequence, threshold: Optional[float] = None):
