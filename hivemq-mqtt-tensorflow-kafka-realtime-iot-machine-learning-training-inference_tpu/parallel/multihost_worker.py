@@ -89,15 +89,15 @@ def main(argv=None) -> int:
 
 
 def spawn_rehearsal(steps: int = 6, timeout: float = 420.0,
-                    n_partitions: int = 4):
-    """Spawn the 2-process rehearsal and return (procs, outs).
+                    n_partitions: int = 4, n_procs: int = 2):
+    """Spawn the n-process rehearsal and return (procs, outs).
 
     Shared by tests/test_multihost.py and __graft_entry__'s
     IOTML_DRYRUN_MULTIHOST leg so the two cannot drift: seeds a broker,
     serves it over the Kafka wire, scrubs the child env (no TPU-tunnel
-    sitecustomize, no inherited pod topology), spawns both workers, and
+    sitecustomize, no inherited pod topology), spawns the workers, and
     ALWAYS kills stragglers — a worker that dies early must not leave its
-    peer pinned in the coordinator barrier."""
+    peers pinned in the coordinator barrier."""
     import os
     import socket
     import subprocess
@@ -132,10 +132,11 @@ def spawn_rehearsal(steps: int = 6, timeout: float = 420.0,
     with KafkaWireServer(broker) as srv:
         procs = [subprocess.Popen(
             [sys.executable, "-m", "iotml.parallel.multihost_worker",
-             coord, "2", str(pid), f"127.0.0.1:{srv.port}", "SENSOR",
-             str(n_partitions), str(steps)],
+             coord, str(n_procs), str(pid), f"127.0.0.1:{srv.port}",
+             "SENSOR", str(n_partitions), str(steps)],
             env=env, cwd=repo, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True) for pid in (0, 1)]
+            stderr=subprocess.STDOUT, text=True)
+            for pid in range(n_procs)]
         outs = []
         try:
             for p in procs:

@@ -36,3 +36,20 @@ def test_two_process_multihost_training():
     losses = [re.search(r"loss ([\d.]+)->([\d.]+)", out).groups()
               for out in outs]
     assert losses[0] == losses[1], f"hosts disagree on loss: {losses}"
+
+
+@pytest.mark.slow
+def test_four_process_multihost_training():
+    """The same rehearsal at 4 processes × 2 devices = an 8-device mesh:
+    pins that nothing in the partition assignment, coordinator join, or
+    global-batch assembly is hardwired to a 2-host world."""
+    procs, outs = spawn_rehearsal(steps=4, n_procs=4, n_partitions=4)
+
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, \
+            f"worker {pid} exited {p.returncode}:\n{out}"
+        assert f"MULTIHOST pid={pid}/4 devices=8" in out, out
+
+    losses = {re.search(r"loss ([\d.]+)->([\d.]+)", out).groups()
+              for out in outs}
+    assert len(losses) == 1, f"hosts disagree on loss: {losses}"
