@@ -918,7 +918,7 @@ def bench_e2e_platform():
             t.start()
         # ---- warmup: first records through every stage (compiles the
         # scorer's eval + the trainer's fit before the measured window)
-        warm_deadline = time.time() + 120
+        warm_deadline = time.time() + 240
         while predictions_total() < 2_000 and time.time() < warm_deadline:
             if err:
                 raise RuntimeError(err[0])
@@ -1015,14 +1015,16 @@ def main():
         ("serve_rows_per_sec", "rows/s", TRAIN_BASELINE_RPS),
         # the preprocessing stage must keep pace with fleet ingest
         ("ksql_pipeline_records_per_sec", "records/s", FLEET_BASELINE_MPS),
-        ("streaming_train_records_per_sec_per_chip", "records/s",
-         TRAIN_BASELINE_RPS),
         # the whole platform live at once: fleet → MQTT → bridge → KSQL →
         # train + serve concurrently, predictions written back — the
         # reference's actual demo shape, with publish→prediction
         # flow-completion latency riding along as fields
         ("e2e_platform_records_per_sec", "records/s", FLEET_BASELINE_MPS),
         ("e2e_latency_ms", "ms", None),
+        # the headline stays the LAST printed line (the driver parses the
+        # final JSON line as the headline metric)
+        ("streaming_train_records_per_sec_per_chip", "records/s",
+         TRAIN_BASELINE_RPS),
     ]
     import gc
 
