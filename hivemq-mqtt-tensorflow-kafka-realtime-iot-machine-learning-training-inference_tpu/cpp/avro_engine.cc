@@ -43,6 +43,10 @@ inline int64_t read_varint(const uint8_t* buf, int64_t pos, int64_t end,
   int64_t start = pos;
   while (pos < end) {
     uint8_t b = buf[pos++];
+    // 10th byte: only its lowest bit fits in 64 (the Avro long limit).
+    // Without this check the high payload bits would shift out silently
+    // and a >64-bit varint would validate with a truncated value.
+    if (shift == 63 && (b & 0x7E)) return -1;
     acc |= static_cast<uint64_t>(b & 0x7F) << shift;
     if (!(b & 0x80)) {
       *out = static_cast<int64_t>(acc >> 1) ^ -static_cast<int64_t>(acc & 1);

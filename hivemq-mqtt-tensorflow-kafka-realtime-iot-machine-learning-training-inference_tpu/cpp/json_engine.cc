@@ -37,10 +37,21 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <locale.h>
 
 #include "utf8_check.h"
 
 namespace {
+
+// strtod is LC_NUMERIC-sensitive: under a non-C locale (an embedding app
+// setting de_DE) every fractional token would parse short and silently
+// demote the whole fast path to 0% hit rate.  Pin a C locale once and use
+// strtod_l so number parity with Python's float() holds regardless of the
+// process locale.  Never freed: one per process, alive for its lifetime.
+inline locale_t c_numeric_locale() {
+  static locale_t loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+  return loc;
+}
 
 enum FieldType : int8_t {
   F_FLOAT = 0,
@@ -246,7 +257,12 @@ int64_t iotml_json_decode_batch(
                 break;
               }
               char* tok_end = nullptr;
-              double v = strtod(s, &tok_end);
+              locale_t cloc = c_numeric_locale();
+              // newlocale can fail (ENOMEM): plain strtod is only wrong
+              // under a non-C locale, and a wrong parse trips tok_end !=
+              // p → Python fallback (slow, never incorrect)
+              double v = cloc ? strtod_l(s, &tok_end, cloc)
+                              : strtod(s, &tok_end);
               if (tok_end != p) { bad = true; break; }
               if ((t == F_INT || t == F_LONG) &&
                   (v >= kIntExact || v <= -kIntExact)) {

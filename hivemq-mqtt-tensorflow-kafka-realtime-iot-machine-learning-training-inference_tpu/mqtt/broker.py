@@ -147,19 +147,31 @@ class MqttBroker:
                     pending = old.pending
                     old.pending = []
                 qos2_inbound = old.qos2_inbound
-                if old.will is not None and old.will_delay_s <= 0:
+                if old.will is not None and (old.will_delay_s <= 0
+                                             or clean_start):
+                    # a delayed will survives a non-clean takeover (the new
+                    # connection resumes the session and cancels it,
+                    # §3.1.3.2.2) — but a clean-start connect ENDS the old
+                    # session, and §3.1.2.5 publishes the will at the
+                    # earlier of delay expiry and session end
                     takeover_will = old.will
                 # either way the old connection's will is settled now —
                 # its late teardown must not publish it again
                 old.will = None
             resumed = False
+            entry = self._offline.pop(client_id, None)
             if clean_start:
                 self._tree.unsubscribe_all(client_id)
-                self._offline.pop(client_id, None)
+                if entry is not None and entry[3] is not None:
+                    # the offline session carried a pending delayed will;
+                    # clean-start ends that session rather than resuming
+                    # it, so the will fires NOW (§3.1.2.5: earlier of
+                    # delay expiry and session end) — a crashed device
+                    # re-provisioned clean must still report as dead
+                    due_wills.append(entry[3][0])
                 pending = []
                 qos2_inbound = set()
             else:
-                entry = self._offline.pop(client_id, None)
                 if entry is not None:
                     # reconnect before the will delay fired: cancel it
                     pending = list(entry[0]) + pending

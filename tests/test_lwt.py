@@ -172,6 +172,35 @@ class TestWillDelay:
         QueueClient(broker, "sweeper").disconnect()  # any activity sweeps
         assert ("wills/flaky", b"gone", 0, False) in watcher.messages
 
+    def test_delayed_will_fires_on_clean_start_reconnect(self):
+        """A clean-start CONNECT ends the old session rather than resuming
+        it, so a pending delayed will fires immediately (§3.1.2.5: earlier
+        of delay expiry and session end) — a crashed device re-provisioned
+        clean within the delay window must still report as dead."""
+        broker = MqttBroker()
+        watcher = QueueClient(broker, "watcher")
+        watcher.subscribe("wills/#")
+        sess = broker.connect("flaky", lambda *a: None, clean_start=False,
+                              will=("wills/flaky", b"gone", 0, False),
+                              will_delay_s=30)
+        broker.disconnect("flaky", sess)  # abnormal → will pending 30 s
+        assert watcher.messages == []
+        broker.connect("flaky", lambda *a: None, clean_start=True)
+        assert ("wills/flaky", b"gone", 0, False) in watcher.messages
+
+    def test_delayed_will_fires_on_clean_start_takeover(self):
+        """Clean-start takeover of a LIVE session with a will delay: the
+        old session ends now, so its will publishes now (the non-clean
+        takeover path instead cancels it, §3.1.3.2.2)."""
+        broker = MqttBroker()
+        watcher = QueueClient(broker, "watcher")
+        watcher.subscribe("wills/#")
+        broker.connect("flaky", lambda *a: None, clean_start=False,
+                       will=("wills/flaky", b"dead", 0, False),
+                       will_delay_s=30)
+        broker.connect("flaky", lambda *a: None, clean_start=True)
+        assert ("wills/flaky", b"dead", 0, False) in watcher.messages
+
     def test_delayed_will_fires_on_quiet_broker(self):
         """No connects/publishes after the drop: the timer alone must fire
         the will — a silent fleet is exactly what a will reports."""

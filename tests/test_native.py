@@ -68,6 +68,19 @@ def test_malformed_message_reports_row():
         nat.decode_batch([good, b"\x00\x00\x00\x00\x01\xff"], strip=5)
 
 
+def test_overlong_varint_rejected():
+    """A 10-byte varint whose final byte carries payload past bit 63 must be
+    malformed, not silently truncated to a wrapped value: strict mode is the
+    byte-parity gate for the rekey pass-through, and a varint the Python
+    codec rejects must never validate natively."""
+    nat = native.NativeCodec(KSQL_CAR_SCHEMA)
+    # frame + 9 continuation bytes (payload 0) + final byte 0x7e: bits 1-6
+    # land beyond bit 63.  Pre-fix this decoded as value 0 and "validated".
+    hostile = b"\x00\x00\x00\x00\x01" + b"\x80" * 9 + b"\x7e"
+    with pytest.raises(ValueError, match="row 0"):
+        nat.decode_batch([hostile], strip=5)
+
+
 def test_dataset_native_path_equals_python_path():
     """SensorBatches with and without the engine must emit identical batches."""
     from iotml.data.dataset import SensorBatches
