@@ -234,6 +234,111 @@ def bench_ksql_pipeline():
                 p95_s=round(p95, 3), n_passes=len(walls))
 
 
+# ---------------------------------------------------------- lstm/mnist
+def bench_lstm_train():
+    """The reference's SECOND model family as a captured number: the
+    supervised LSTM next-step predictor (LSTM-TensorFlow-IO-Kafka/
+    cardata-v1.py:165-200 — window(look_back=1, shift=1) + skip, MSE, 5
+    epochs), re-batched from the reference's pathological batch=1 to
+    [64, T, F] windows for the MXU (cli/lstm.py keeps the CLI contract).
+
+    Volume: 10,000 windows per job (the reference job is 1,000 train
+    steps at batch 1 = 1,000 windows; the 10× volume makes the number a
+    throughput, not a dispatch-latency echo — per-window semantics are
+    identical)."""
+    from iotml.cli.lstm import BATCH_SIZE, LOOK_BACK, NB_EPOCH
+    from iotml.data.dataset import SensorBatches
+    from iotml.models.lstm import LSTMSeq2Seq
+    from iotml.stream.broker import Broker
+    from iotml.stream.consumer import StreamConsumer
+    from iotml.train.loop import Trainer
+
+    n_windows = 10_000
+    take = n_windows // BATCH_SIZE
+    broker = _fill_broker(Broker(), n_windows + BATCH_SIZE + LOOK_BACK)
+    model = LSTMSeq2Seq(features=18, look_back=LOOK_BACK)
+
+    def run_job():
+        consumer = StreamConsumer(broker, ["SENSOR_DATA_S_AVRO:0:0"],
+                                  group="cardata-lstm")
+        batches = SensorBatches(consumer, batch_size=BATCH_SIZE,
+                                window=LOOK_BACK, take=take)
+        trainer = Trainer(model, supervised=True)
+        t0 = time.perf_counter()
+        history = trainer.fit_compiled(batches, epochs=NB_EPOCH)
+        return time.perf_counter() - t0, history
+
+    cold_wall, history = run_job()
+    walls = []
+    for _ in range(PASSES):
+        wall, h = run_job()
+        walls.append(wall)
+    p50, p95 = _percentiles(walls)
+    records = history["records"][-1]
+    return dict(value=records / p50, cold_wall_s=round(cold_wall, 2),
+                p50_s=round(p50, 3), p95_s=round(p95, 3),
+                n_passes=len(walls), windows_per_job=records,
+                epochs=NB_EPOCH, batch_size=BATCH_SIZE,
+                look_back=LOOK_BACK,
+                reference_config="1000 steps @ batch 1, 5 epochs "
+                                 "(cardata-v1.py:165-200)",
+                final_loss=round(float(history["loss"][-1]), 6))
+
+
+def bench_mnist_smoke():
+    """The MNIST-over-Kafka smoke config (confluent-tensorflow-io-kafka
+    .py:44-58): images/labels produced to paired topics, zip-consumed,
+    classifier trained — plus the no-Kafka control model on identical
+    data.  The captured value is the streamed path's end-to-end rate
+    (produce → consume → decode → scanned fit); `ingestion_intact` pins
+    that the streamed tensors are byte-identical to the in-memory ones."""
+    from iotml.cli.mnist_smoke import classifier_fit
+    from iotml.data.mnist_stream import MnistBatches, produce_mnist, \
+        synth_mnist
+    from iotml.models.mnist import MNISTBaseline, MNISTClassifier
+    from iotml.stream.broker import Broker
+
+    import numpy as _np
+
+    n, epochs, batch_size = 10_000, 2, 32
+    images, labels = synth_mnist(n)
+
+    def run_job():
+        broker = Broker()
+        t0 = time.perf_counter()
+        produced = produce_mnist(broker, images, labels)
+        batches = list(MnistBatches(broker, batch_size=batch_size))
+        sx = _np.concatenate([b.x[: b.n_valid] for b in batches])
+        sy = _np.concatenate([b.y[: b.n_valid] for b in batches])
+        streamed = classifier_fit(MNISTClassifier(), sx, sy,
+                                  batch_size, epochs)
+        wall = time.perf_counter() - t0
+        intact = bool(len(sx) == produced
+                      and _np.array_equal(sx, images.astype(_np.float32))
+                      and _np.array_equal(sy, labels))
+        return wall, streamed, intact
+
+    cold_wall, streamed, intact = run_job()
+    control = classifier_fit(MNISTBaseline(), images.astype(_np.float32),
+                             labels, batch_size, epochs)
+    walls = []
+    for _ in range(max(3, PASSES // 2)):
+        wall, streamed, ok = run_job()
+        intact = intact and ok
+        walls.append(wall)
+    p50, p95 = _percentiles(walls)
+    return dict(value=n / p50, cold_wall_s=round(cold_wall, 2),
+                p50_s=round(p50, 3), p95_s=round(p95, 3),
+                n_passes=len(walls), n_images=n, epochs=epochs,
+                batch_size=batch_size, ingestion_intact=intact,
+                final_loss=round(float(streamed["loss"][-1]), 6),
+                final_accuracy=round(float(streamed["accuracy"][-1]), 4),
+                control_final_loss=round(float(control["loss"][-1]), 6),
+                reference_config="mnist images+labels over paired Kafka "
+                                 "topics (confluent-tensorflow-io-kafka"
+                                 ".py:44-58)")
+
+
 # ------------------------------------------------------------- longctx
 def bench_long_context():
     """Flash attention at 65,536 tokens, forward+backward — the long-
@@ -255,7 +360,12 @@ def bench_long_context():
 
     on_tpu = jax.default_backend() not in ("cpu",)
     T = 65_536 if on_tpu else 2_048
-    B, H, D = 1, 4, 64
+    # head_dim 128 is the MXU-native head shape (the systolic array is
+    # 128 wide: a D=64 head half-fills the QK contraction and the PV
+    # output dims and CAPS the kernel near 25% MFU — measured, see the
+    # ARCHITECTURE.md roofline; D=128 at the same total width nearly
+    # doubles it).  Modern long-context stacks standardize on 128.
+    B, H, D = 1, 2, 128
     interpret = not on_tpu
     # 1024² blocks: the measured sweet spot on v5e (the 128² default is
     # grid-overhead-bound at this T — ~8× slower)
@@ -632,20 +742,56 @@ def bench_fleet_ingest_multiproc():
     """Fleet scale past one process's fd table: load-generator SUBPROCESSES
     each own a slice of the client sockets (the reference runs its 100k-car
     simulator on separate nodes, scenario.xml:13-14), so only the server's
-    fd budget binds.  15,000 connections into the C++ ingest engine;
-    delivered_pct counts only messages that reached the stream topic.
+    fd budget binds.  18,000 connections into the C++ ingest engine (the
+    practical ceiling under this box's 20,000-fd cap; 100k cannot be
+    opened here — PARITY.md holds the measured per-connection scaling
+    that grounds the extrapolation); delivered_pct counts only messages
+    that reached the stream topic.
 
     broker_rss_delta_mb here is honest in a way the in-process bench
     cannot be: the publishers live in other processes, so the sampled RSS
     is the SERVER's alone."""
+    n_conns = int(os.environ.get("IOTML_BENCH_FLEET_MP_CONNS", "18000"))
+    duration = float(os.environ.get("IOTML_BENCH_FLEET_SECONDS", "8"))
+    return _fleet_multiproc(n_conns, duration)
+
+
+def bench_fleet_soak():
+    """Sustained-load proof: the multi-process fleet held for ≥60 s with
+    the server's RSS sampled once per second.  The reference's brokers
+    run for days behind overload-protection panels
+    (infrastructure/hivemq/hivemq.json); an 8-second burst cannot show a
+    leak — a soak with a flat post-warmup RSS slope can.  Reported:
+    rss_slope_mb_per_min fitted over the post-warmup samples (first 10 s
+    excluded: connection setup + buffer growth), delivered_pct, and the
+    full per-second series' min/max."""
+    n_conns = int(os.environ.get("IOTML_BENCH_FLEET_SOAK_CONNS", "15000"))
+    duration = float(os.environ.get("IOTML_BENCH_FLEET_SOAK_SECONDS", "60"))
+    out = _fleet_multiproc(n_conns, duration, rss_series=True)
+    series = out.pop("rss_series_mb")
+    warm = [s for t, s in series if t >= 10.0]
+    if len(warm) >= 2:
+        import numpy as _np
+
+        ts = _np.array([t for t, s in series if t >= 10.0])
+        ys = _np.array(warm)
+        slope_per_s = float(_np.polyfit(ts, ys, 1)[0])
+        out["rss_slope_mb_per_min"] = round(slope_per_s * 60.0, 3)
+        out["rss_warmup_mb"] = round(series[min(len(series) - 1, 10)][1], 1)
+        out["rss_final_mb"] = round(ys[-1], 1)
+        out["rss_min_mb"] = round(float(ys.min()), 1)
+        out["rss_max_mb"] = round(float(ys.max()), 1)
+        out["n_rss_samples"] = len(series)
+    return out
+
+
+def _fleet_multiproc(n_conns, duration, n_children: int = 5,
+                     rss_series: bool = False):
     import base64
     import subprocess
 
     from iotml.mqtt.native_ingest import NativeIngestBridge
 
-    n_conns = int(os.environ.get("IOTML_BENCH_FLEET_MP_CONNS", "15000"))
-    n_children = 5
-    duration = float(os.environ.get("IOTML_BENCH_FLEET_SECONDS", "8"))
     soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
     if soft < hard:
         resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
@@ -668,11 +814,22 @@ def bench_fleet_ingest_multiproc():
         rss0 = _vm_rss_kb()
         rss_peak = [rss0]
         rss_stop = threading.Event()
+        series: list = []  # (seconds since window start, rss MB)
+        t_series0 = [None]
 
         def _rss_sampler():
+            next_sample = time.perf_counter()
             while not rss_stop.is_set():
-                rss_peak[0] = max(rss_peak[0], _vm_rss_kb())
-                time.sleep(0.1)
+                rss = _vm_rss_kb()
+                rss_peak[0] = max(rss_peak[0], rss)
+                if rss_series and t_series0[0] is not None:
+                    series.append(
+                        (round(time.perf_counter() - t_series0[0], 1),
+                         round((rss - rss0) / 1024.0, 1)))
+                    next_sample += 1.0
+                else:
+                    next_sample += 0.1
+                time.sleep(max(0.0, next_sample - time.perf_counter()))
 
         threading.Thread(target=_rss_sampler, daemon=True).start()
         t_setup = time.perf_counter()
@@ -694,7 +851,12 @@ def bench_fleet_ingest_multiproc():
                     raise RuntimeError(f"load child failed: {line!r}")
             setup_s = time.perf_counter() - t_setup
             live_conns = bridge.ingest.connection_count
+            # all sockets connected, no traffic yet: THIS delta is the
+            # per-connection server memory (the firehose delta below is
+            # dominated by parse/burst buffers, not connections)
+            rss_connected = _vm_rss_kb()
             t0 = time.perf_counter()
+            t_series0[0] = t0  # per-second RSS series starts with the load
             for ch in children:
                 ch.stdin.write("GO\n")
                 ch.stdin.flush()
@@ -736,54 +898,109 @@ def bench_fleet_ingest_multiproc():
                    forwarded=forwarded, in_stream_topic=in_stream,
                    delivered_pct=round(100.0 * forwarded / max(sent, 1), 2),
                    broker_rss_delta_mb=round(
-                       (rss_peak[0] - rss0) / 1024.0, 1))
+                       (rss_peak[0] - rss0) / 1024.0, 1),
+                   rss_connected_mb=round((rss_connected - rss0) / 1024.0,
+                                          1),
+                   rss_per_conn_kb=round((rss_connected - rss0)
+                                         / max(live_conns, 1), 2))
+        if rss_series:
+            out["rss_series_mb"] = series
         if errors:
             out["worker_errors"] = errors[:4]
         return out
 
 
 def bench_e2e_platform():
-    """THE reference claim, measured: every layer live at once.  The demo
-    the reference actually runs is fleet → HiveMQ → Kafka → KSQL →
-    training AND scoring concurrently, predictions written back
-    (README.md:100-108, scenario.xml:13-14) — not one leg at a time.
+    """THE reference claim, measured: every layer live at once, with the
+    model loop CLOSED.  The demo the reference actually runs is fleet →
+    HiveMQ → Kafka → KSQL → training AND scoring concurrently, with the
+    trained model handed from the train Job to the predict pods through a
+    GCS bucket (cardata-v3.py:227-232,255-261, run.sh:16-91) — not one
+    leg at a time, and not a frozen model.
 
-    One process hosts the full platform (cli/up.py: MQTT epoll front +
-    bridge, wire broker, four-object KSQL pipeline, registry/connect);
-    paced publishers drive real MQTT at ~1.5× the reference's 10k msgs/s
-    fleet steady state; a trainer continuously fits fixed-size slices
-    from SENSOR_DATA_S_AVRO on the TPU; a scorer continuously drains the
-    same stream through the jit eval and writes np.array2string
-    predictions to model-predictions — all at the same time.
+    Process shape matches the repo's own deploy manifests
+    (deploy/model-training.yaml / model-predictions.yaml): the main
+    process hosts the platform (cli/up.py: MQTT epoll front + bridge,
+    Kafka wire server, four-object KSQL pipeline) and the paced MQTT
+    fleet; TRAINING runs in a separate OS process on the TPU
+    (`iotml.cli.live train` — persistent consumer, fixed-shape rounds,
+    h5 artifact + pointer flip per round); SCORING runs in another OS
+    process on CPU like the reference's predict pods
+    (`iotml.cli.live score` — hot-swaps weights off the artifact pointer
+    between super-batches, writes np.array2string predictions).  Every
+    prediction in the measured window therefore comes from a model
+    trained on the live stream seconds earlier.
 
-    Latency is flow-completion: marker (published_count, t) pairs are
-    stamped every 250 ms; a marker resolves when the prediction topic's
-    total record count reaches the marker's published count, i.e. when
-    every record published up to t has traversed MQTT → bridge → KSQL →
-    scorer → predictions.  This UPPER-bounds per-record latency (it
-    includes finishing the whole backlog ahead of the marker)."""
+    The fleet publishes VARIED labeled records (failure_rate > 0, the
+    scenario generator's injected failure modes), so detection quality is
+    measured live: the scorer's threshold verdicts — the same verdicts
+    written to the predictions topic — are scored against the stream's
+    injected labels (precision/recall at the stated threshold + a
+    histogram-derived AUC).
+
+    Latency, two ways:
+    - flow-completion (as before): markers of (published_count, t) every
+      250 ms resolve when the predictions topic reaches that count —
+      UPPER-bounds per-record latency (includes backlog drain).
+    - per-record: the bridge stamps every sensor-data record with epoch-ms
+      produce time, the KSQL legs propagate timestamps, a sampler records
+      (partition, offset, timestamp) of SENSOR_DATA_S_AVRO log heads, and
+      the scorer's per-drain consumed-positions (from its stats stream)
+      bound each sampled record's prediction-write time to one drain.
+
+    A rate sweep (IOTML_BENCH_E2E_SWEEP) measures additional paced points
+    after the headline window, turning the "highest sustainable rate"
+    claim into captured data."""
+    import subprocess
+    import tempfile
+
     from iotml.cli.up import Platform
-    from iotml.data.dataset import SensorBatches
-    from iotml.models.autoencoder import CAR_AUTOENCODER
-    from iotml.serve.scorer import StreamScorer
-    from iotml.stream.consumer import StreamConsumer
-    from iotml.stream.producer import OutputSequence
-    from iotml.train.loop import Trainer
+    from iotml.core.schema import KSQL_CAR_SCHEMA
+    from iotml.gen.simulator import FleetGenerator, FleetScenario
+    from iotml.serve.scorer import hist_auc
 
-    # 12k msgs/s = 1.2× the reference fleet's 10k steady state — the
-    # highest paced rate at which the WHOLE concurrent pipeline (incl.
-    # training) holds flow-completion latency bounded on this box; the
-    # per-leg benches record each stage's isolated headroom above it
-    target_rate = float(os.environ.get("IOTML_BENCH_E2E_RATE", "12000"))
+    # 16k msgs/s = 1.6× the reference fleet's 10k steady state — the
+    # highest paced rate at which the whole concurrent platform holds
+    # flow-completion latency bounded on this box (the sweep below records
+    # the evidence: 12k and 20k points ride along every run)
+    headline_rate = float(os.environ.get("IOTML_BENCH_E2E_RATE", "16000"))
     window_s = float(os.environ.get("IOTML_BENCH_E2E_SECONDS", "20"))
+    sweep = [float(r) for r in os.environ.get(
+        "IOTML_BENCH_E2E_SWEEP", "12000,20000").split(",") if r]
+    sweep_window_s = float(os.environ.get("IOTML_BENCH_E2E_SWEEP_SECONDS",
+                                          "10"))
     n_conns = 200
     n_pub_threads = 4
+    failure_rate = 0.03
+    # operating point from the offline threshold protocol
+    # (evaluate/anomaly.py over a trained model's normal-error
+    # distribution): ≈ p99 of normal reconstruction error.  The notebook's
+    # "threshold 5" is the creditcard protocol on unscaled data; the car
+    # stream is normalized, so its operating point lives near 0.4.
+    threshold = float(os.environ.get("IOTML_BENCH_E2E_THRESHOLD", "0.4"))
 
     platform = Platform(retention_messages=30_000).start()
+    # derived KSQL topics are created by the engine (partitions inherited
+    # from sensor-data) with no retention bound; pre-create them bounded so
+    # a ~90 s run cannot grow the log without limit.  The AVRO leg gets a
+    # deeper log: both children cursor it, and a transient scorer stall at
+    # the top sweep rate must not trim offsets out from under the cursor.
+    for t, keep in (("SENSOR_DATA_S", 30_000),
+                    ("SENSOR_DATA_S_AVRO", 60_000),
+                    ("SENSOR_DATA_S_AVRO_REKEY", 30_000)):
+        platform.broker.create_topic(t, partitions=10,
+                                     retention_messages=keep)
+    # the fleet rides the C++ ingest edge (the scale path the fleet
+    # benches establish): on a one-core box the Python epoll front would
+    # spend ~20% of the core parsing 12k msgs/s that the native engine
+    # parses for ~5%, starving the KSQL/train/serve stages
+    from iotml.mqtt.native_ingest import NativeIngestBridge
+
+    ingest = NativeIngestBridge(platform.broker,
+                                partitions=10).start()
     stop = threading.Event()
     err: list = []
 
-    # ---- continuous KSQL pump (the stream-preprocessing stage)
     def ksql_pump():
         while not stop.is_set():
             try:
@@ -793,63 +1010,42 @@ def bench_e2e_platform():
                 err.append(f"ksql: {e!r}")
                 return
 
-    # ---- continuous training: fixed-size slices from committed offsets
-    # (fixed shape → the scanned/fused fit compiles once, then every
-    # round reuses it — per-round recompiles would serialize the chip)
-    train_stats = {"rounds": 0, "records": 0}
-
-    def train_loop():
-        spec = platform.broker.topic("SENSOR_DATA_S_AVRO")
-        trainer = Trainer(CAR_AUTOENCODER)
-        group = "cardata-autoencoder-e2e"
-        take = 2_000
-        while not stop.is_set():
-            try:
-                consumer = StreamConsumer.from_committed(
-                    platform.broker, "SENSOR_DATA_S_AVRO",
-                    range(spec.partitions), group=group)
-                avail = sum(
-                    platform.broker.end_offset("SENSOR_DATA_S_AVRO", p)
-                    - (platform.broker.committed(
-                        group, "SENSOR_DATA_S_AVRO", p) or 0)
-                    for p in range(spec.partitions))
-                if avail < take:
-                    time.sleep(0.1)
-                    continue
-                batches = SensorBatches(consumer, batch_size=BATCH,
-                                        take=take, only_normal=True)
-                trainer.fit_compiled(batches, epochs=1)
-                consumer.commit()
-                train_stats["rounds"] += 1
-                train_stats["records"] += take
-            except Exception as e:  # noqa: BLE001
-                err.append(f"train: {e!r}")
-                return
-
-    # ---- continuous scoring → model-predictions (the predict pod)
-    def serve_loop(scorer):
-        while not stop.is_set():
-            try:
-                if scorer.score_available() == 0:
-                    time.sleep(0.02)
-            except Exception as e:  # noqa: BLE001
-                err.append(f"serve: {e!r}")
-                return
-
-    # ---- paced MQTT publishers (the fleet above the reference rate)
+    # ---- paced MQTT publishers: VARIED labeled payloads (pre-serialized
+    # ticks of a failing-car fleet), rate switchable mid-run for the sweep
+    gen = FleetGenerator(FleetScenario(num_cars=n_conns,
+                                       failure_rate=failure_rate, seed=11))
+    n_failing = int((gen.failing >= 0).sum())
+    tick_payloads = []  # [tick][conn] -> json bytes
+    for _ in range(24):
+        cols = gen.step_columns()
+        tick_payloads.append([json.dumps(
+            gen.row_record(cols, i, KSQL_CAR_SCHEMA)).encode()
+            for i in range(n_conns)])
+    # warmup runs at a LOW rate: the scorer idles until the trainer's
+    # first artifact exists (TPU compile ~30-60 s over the tunnel), and a
+    # full-rate fleet during that wait would build a backlog the
+    # flow-completion markers could never resolve against.  The ramp to
+    # the measured rate happens once the loop is closed and caught up.
+    warmup_rate = float(os.environ.get("IOTML_BENCH_E2E_WARMUP_RATE",
+                                       "3000"))
+    rate_state = {"rate": warmup_rate, "ver": 0}
     sent_counts = [0] * n_pub_threads
-    payload = _car_payload()
 
     def publisher(w):
         from iotml.mqtt.wire import CONNACK, connect_packet, publish_packet
 
         conns = []
         per = n_conns // n_pub_threads
+        # burst: consecutive ticks packed into one sendall per connection
+        # (fewer syscalls per message on the shared core; the per-conn
+        # message stream stays ordered and varied)
+        burst = 4
         try:
             for i in range(per):
-                cid = f"e2e-{w}-{i:03d}"
+                ci = w * per + i
+                cid = f"electric-vehicle-{ci:05d}"
                 s = socket.create_connection(
-                    ("127.0.0.1", platform.mqtt.port), timeout=30)
+                    ("127.0.0.1", ingest.port), timeout=30)
                 s.sendall(connect_packet(cid))
                 buf = b""
                 while len(buf) < 4:
@@ -859,18 +1055,29 @@ def bench_e2e_platform():
                     buf += chunk
                 if buf[0] >> 4 != CONNACK:
                     raise ConnectionError(f"expected CONNACK, got {buf[0]}")
-                conns.append((s, publish_packet(
-                    f"vehicles/sensor/data/{cid}", payload)))
-            rate = target_rate / n_pub_threads
-            sent = 0
+                pkts = [publish_packet(f"vehicles/sensor/data/{cid}",
+                                       tick_payloads[t][ci])
+                        for t in range(len(tick_payloads))]
+                bursts = [b"".join(pkts[(t + j) % len(pkts)]
+                                   for j in range(burst))
+                          for t in range(0, len(pkts), burst)]
+                conns.append((s, bursts))
+            my_ver = -1
+            rate = tick = sent = 0
             t0 = time.perf_counter()
             while not stop.is_set():
-                for s, pkt in conns:
-                    s.sendall(pkt)
-                    sent += 1
-                sent_counts[w] = sent
-                # pace to the target rate (deadline arithmetic, not a
-                # fixed sleep: sendall stalls must not lower the rate)
+                if rate_state["ver"] != my_ver:
+                    # rate switch: restart the pacing clock so the new
+                    # rate applies immediately instead of draining the
+                    # old credit
+                    my_ver = rate_state["ver"]
+                    rate = rate_state["rate"] / n_pub_threads
+                    t0, sent = time.perf_counter(), 0
+                for s, bursts in conns:
+                    s.sendall(bursts[tick % len(bursts)])
+                    sent += burst
+                    sent_counts[w] += burst
+                tick += 1
                 ahead = sent / rate - (time.perf_counter() - t0)
                 if ahead > 0:
                     time.sleep(ahead)
@@ -884,105 +1091,368 @@ def bench_e2e_platform():
                 except OSError:
                     pass
 
+    # ---- per-record timestamp sampler: (partition, offset) → bridge
+    # publish time, read off the AVRO topic's log heads (timestamps
+    # propagate through the KSQL legs from the bridge's produce stamp)
+    ts_samples: dict = {}
+
+    def ts_sampler():
+        while not stop.is_set():
+            try:
+                spec = platform.broker.topic("SENSOR_DATA_S_AVRO")
+                break
+            except KeyError:
+                time.sleep(0.1)
+        while not stop.is_set():
+            for p in range(spec.partitions):
+                off = platform.broker.end_offset("SENSOR_DATA_S_AVRO", p) - 1
+                if off >= 0 and (p, off) not in ts_samples:
+                    msgs = platform.broker.fetch("SENSOR_DATA_S_AVRO", p,
+                                                 off, 1)
+                    if msgs:
+                        ts_samples[(p, off)] = msgs[0].timestamp_ms
+            time.sleep(0.15)
+
+    # ---- children: the deploy manifests' pod separation as real processes
+    artifact_root = tempfile.mkdtemp(prefix="iotml_e2e_artifacts_")
+    repo = os.path.dirname(os.path.abspath(__file__))
+    addr = f"127.0.0.1:{platform.kafka.port}"
+    train_env = dict(os.environ)  # keeps the TPU tunnel: training on chip
+    # APPEND to PYTHONPATH: the tunnel's sitecustomize lives on it, and
+    # replacing it would strand the child with JAX_PLATFORMS=axon but no
+    # axon backend registered
+    train_env["PYTHONPATH"] = repo + os.pathsep + \
+        train_env.get("PYTHONPATH", "")
+    score_env = {k: v for k, v in os.environ.items()
+                 if not k.startswith(("PALLAS_AXON", "AXON_", "JAX_"))}
+    score_env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo})
+
+    train_rounds: list = []   # cumulative stats dicts from the train child
+    drain_stats: list = []    # cumulative stats dicts from the score child
+
+    def reader(proc, sink, tag):
+        try:
+            for line in proc.stdout:
+                if line.startswith("{"):
+                    sink.append(json.loads(line))
+        except Exception as e:  # noqa: BLE001
+            err.append(f"{tag} reader: {e!r}")
+
+    def cum_at(entries, wall, key, default=0):
+        """Last cumulative value at/before `wall` from a stats stream."""
+        val = default
+        for d in entries:
+            if d["t"] <= wall:
+                val = d[key]
+            else:
+                break
+        return val
+
     def predictions_total():
         spec = platform.broker.topic("model-predictions")
         return sum(platform.broker.end_offset("model-predictions", p)
                    for p in range(spec.partitions))
 
-    threads = [threading.Thread(target=ksql_pump, daemon=True)]
-    sc_spec = None
-    try:
-        # scorer needs trained-ish params: init from a tiny local fit
-        from iotml.stream.broker import Broker as _B
-        warm = _fill_broker(_B(), 2000)
-        wc = StreamConsumer(warm, ["SENSOR_DATA_S_AVRO:0:0"])
-        trainer0 = Trainer(CAR_AUTOENCODER)
-        trainer0.fit_compiled(
-            SensorBatches(wc, batch_size=BATCH, only_normal=True), epochs=1)
-        spec = platform.broker.topic("SENSOR_DATA_S_AVRO")
-        sc_spec = [f"SENSOR_DATA_S_AVRO:{p}:0" for p in range(spec.partitions)]
-        scorer = StreamScorer(
-            CAR_AUTOENCODER, trainer0.state.params,
-            SensorBatches(StreamConsumer(platform.broker, sc_spec,
-                                         group="scorer-e2e", eof=False),
-                          batch_size=BATCH),
-            OutputSequence(platform.broker, "model-predictions",
-                           partition=0), threshold=5.0)
-        threads += [threading.Thread(target=train_loop, daemon=True),
-                    threading.Thread(target=serve_loop, args=(scorer,),
-                                     daemon=True)]
-        threads += [threading.Thread(target=publisher, args=(w,),
-                                     daemon=True)
-                    for w in range(n_pub_threads)]
-        for t in threads:
-            t.start()
-        # ---- warmup: first records through every stage (compiles the
-        # scorer's eval + the trainer's fit before the measured window)
-        warm_deadline = time.time() + 240
-        while predictions_total() < 2_000 and time.time() < warm_deadline:
-            if err:
-                raise RuntimeError(err[0])
-            time.sleep(0.1)
-        if predictions_total() < 2_000:
-            raise RuntimeError("e2e warmup: predictions not flowing")
-        # ---- measured window
-        t_win0 = time.perf_counter()
+    def measure_window(win_s):
+        """One paced window: markers + deltas off the children's
+        cumulative stats streams.  Returns the raw point dict."""
+        wall0 = time.time()
+        t0 = time.perf_counter()
         sent0 = sum(sent_counts)
         preds0 = predictions_total()
-        lat_samples: list = []
-        next_marker = time.perf_counter()
+        lat: list = []
         pending: list = []
-        while time.perf_counter() - t_win0 < window_s:
+        next_marker = t0
+        while time.perf_counter() - t0 < win_s:
             now = time.perf_counter()
             if now >= next_marker:
                 pending.append((sum(sent_counts), now))
                 next_marker = now + 0.25
-            done_total = predictions_total()
-            while pending and done_total >= pending[0][0]:
-                lat_samples.append(now - pending[0][1])
+            done = predictions_total()
+            while pending and done >= pending[0][0]:
+                lat.append(now - pending[0][1])
                 pending.pop(0)
+            if err:
+                raise RuntimeError(err[0])
+            for child, tag in ((train_child, "train"),
+                               (score_child, "score")):
+                if child is not None and child.poll() is not None:
+                    raise RuntimeError(
+                        f"{tag} child exited rc={child.returncode} "
+                        f"mid-window; stderr tail: {child_err_tail(child)}")
             time.sleep(0.02)
-        t_win = time.perf_counter() - t_win0
+        t_win = time.perf_counter() - t0
+        wall1 = time.time()
         sent_win = sum(sent_counts) - sent0
         preds_win = predictions_total() - preds0
-        # resolve markers still pending (bounded: they measure the tail)
         tail_deadline = time.time() + 30
         while pending and time.time() < tail_deadline:
-            done_total = predictions_total()
+            done = predictions_total()
             now = time.perf_counter()
-            while pending and done_total >= pending[0][0]:
-                lat_samples.append(now - pending[0][1])
+            while pending and done >= pending[0][0]:
+                lat.append(now - pending[0][1])
                 pending.pop(0)
             time.sleep(0.02)
+        lat_ms = sorted(x * 1000.0 for x in lat)
+        p50, p95 = _percentiles(lat_ms) if lat_ms else (None, None)
+        return dict(wall0=wall0, wall1=wall1, t_win=t_win,
+                    sent_win=sent_win, preds_win=preds_win,
+                    lat_p50=p50, lat_p95=p95, n_markers=len(lat_ms),
+                    unresolved=len(pending))
+
+    def window_deltas(w):
+        """Train/quality deltas for a measured window, off the children's
+        cumulative stats (entries are stamped with the child's wall
+        clock; same box, same epoch)."""
+        trained = sum(r["records"] for r in train_rounds
+                      if w["wall0"] <= r["t"] <= w["wall1"])
+        rounds = sum(1 for r in train_rounds
+                     if w["wall0"] <= r["t"] <= w["wall1"])
+        q0 = cum_at(drain_stats, w["wall0"], "quality", None)
+        q1 = cum_at(drain_stats, w["wall1"], "quality", None)
+        mu0 = cum_at(drain_stats, w["wall0"], "model_updates")
+        mu1 = cum_at(drain_stats, w["wall1"], "model_updates")
+        s0 = cum_at(drain_stats, w["wall0"], "scored")
+        s1 = cum_at(drain_stats, w["wall1"], "scored")
+        out = dict(records_trained=trained, train_rounds=rounds,
+                   model_updates=mu1 - mu0, scored=s1 - s0)
+        if q0 is not None and q1 is not None:
+            q = {k: q1[k] - q0[k] for k in q1}
+            out["quality"] = q
+        h0 = cum_at(drain_stats, w["wall0"], "err_hist", None)
+        h1 = cum_at(drain_stats, w["wall1"], "err_hist", None)
+        if h0 is not None and h1 is not None:
+            import numpy as _np
+
+            anom = _np.array(h1["true"]) - _np.array(h0["true"])
+            norm = _np.array(h1["false"]) - _np.array(h0["false"])
+            auc = hist_auc(anom, norm)
+            if auc is not None:
+                out["auc"] = round(auc, 4)
+        return out
+
+    def per_record_latency(w):
+        """Sampled (partition, offset, publish-ts) joined against the
+        scorer's per-drain consumed positions: the first stats line whose
+        positions cover a sampled record UPPER-bounds its prediction-write
+        time (stats are emitted after the covering drain's flush, at a
+        ≤10 Hz throttle — so the bound is one drain plus up to ~100 ms of
+        stats cadence, still far tighter than flow completion)."""
+        out = []
+        for (p, off), ts in sorted(ts_samples.items()):
+            t_pub = ts / 1000.0
+            if not (w["wall0"] <= t_pub <= w["wall1"]):
+                continue
+            for d in drain_stats:
+                # truncated-drain snapshots report positions ahead of the
+                # flushed predictions: only complete drains upper-bound
+                # the write time
+                if not d.get("drain_complete", True):
+                    continue
+                pos = d.get("positions", {}).get(str(p))
+                if pos is not None and pos > off:
+                    out.append((d["t"] - t_pub) * 1000.0)
+                    break
+        return sorted(out)
+
+    threads = [threading.Thread(target=ksql_pump, daemon=True),
+               threading.Thread(target=ts_sampler, daemon=True)]
+    threads += [threading.Thread(target=publisher, args=(w,), daemon=True)
+                for w in range(n_pub_threads)]
+    train_child = score_child = None
+    stderr_files = []
+    try:
+        stderr_of: dict = {}
+
+        def spawn(cmd, env):
+            f = tempfile.NamedTemporaryFile(mode="w+", prefix="iotml_e2e_",
+                                            suffix=".err", delete=False)
+            stderr_files.append(f)
+            proc = subprocess.Popen(cmd, stdin=subprocess.PIPE,
+                                    stdout=subprocess.PIPE, stderr=f,
+                                    env=env, cwd=repo, text=True)
+            stderr_of[proc] = f.name
+            return proc
+
+        def child_err_tail(child) -> str:
+            """Last ~2 KB of a child's captured stderr, for error text."""
+            path = stderr_of.get(child)
+            if path is None:
+                return ""
+            try:
+                with open(path) as fh:
+                    fh.seek(max(0, os.path.getsize(path) - 2048))
+                    return fh.read().strip()[-2000:]
+            except OSError:
+                return ""
+
+        # 200-batch rounds (20,000 records): the round cadence must keep
+        # up with arrival, and per-round overhead (wire trips, h5 publish)
+        # amortizes over the slice while the artifact pointer still flips
+        # ~1/s — fresh weights reach the scorer many times per window
+        train_child = spawn(
+            [sys.executable, "-m", "iotml.cli.live", "train", addr,
+             "SENSOR_DATA_S_AVRO", artifact_root, "--take-batches", "200",
+             "--group", "cardata-autoencoder-e2e", "--stats",
+             "--max-seconds", "600"], train_env)
+        score_child = spawn(
+            [sys.executable, "-m", "iotml.cli.live", "score", addr,
+             "SENSOR_DATA_S_AVRO", "model-predictions", artifact_root,
+             "--threshold", str(threshold), "--group", "scorer-e2e",
+             "--stats", "--max-seconds", "600",
+             # the first artifact waits on the train child's TPU compile
+             # (~30-60 s over the tunnel) + the first round's data: match
+             # the bench's own 300 s warmup budget, not the CLI default
+             "--wait-model-seconds", "280"], score_env)
+        threads += [
+            threading.Thread(target=reader, args=(train_child, train_rounds,
+                                                  "train"), daemon=True),
+            threading.Thread(target=reader, args=(score_child, drain_stats,
+                                                  "score"), daemon=True)]
+        for t in threads:
+            t.start()
+
+        # ---- warmup: the loop must be CLOSED before measuring (at least
+        # one trained model published, downloaded, and the scorer caught
+        # up to the live stream with it)
+        warm_deadline = time.time() + 300
+        while time.time() < warm_deadline:
+            if err:
+                raise RuntimeError(err[0])
+            for child, tag in ((train_child, "train"),
+                               (score_child, "score")):
+                if child.poll() is not None:
+                    raise RuntimeError(
+                        f"{tag} child exited rc={child.returncode} during "
+                        f"warmup; stderr tail: {child_err_tail(child)}")
+            # lag below a few seconds' worth of the warmup rate = the
+            # scorer has caught the backlog and only the pipeline's
+            # steady in-flight remains (KSQL pump cycles + drain cadence)
+            lag = sum(sent_counts) - predictions_total()
+            if train_rounds and drain_stats and \
+                    drain_stats[-1]["scored"] >= 2_000 and \
+                    lag < max(10_000, 4 * warmup_rate):
+                break
+            time.sleep(0.1)
+        else:
+            raise RuntimeError(
+                f"e2e warmup: loop not closed (train_rounds="
+                f"{len(train_rounds)}, drains={len(drain_stats)}, "
+                f"lag={sum(sent_counts) - predictions_total()})")
+
+        # ---- ramp to the headline rate, then measure; sweep points after
+        rate_state["rate"] = headline_rate
+        rate_state["ver"] += 1
+        time.sleep(2.0)
+        headline = measure_window(window_s)
+        headline_rate_actual = rate_state["rate"]
+        sweep_points = []
+        for r in sweep:
+            rate_state["rate"] = r
+            rate_state["ver"] += 1
+            time.sleep(2.0)  # settle: markers from the old rate resolve
+            wpt = measure_window(sweep_window_s)
+            d = window_deltas(wpt)
+            sweep_points.append(dict(
+                rate=r,
+                records_per_sec=round(wpt["preds_win"] / wpt["t_win"], 1),
+                publish_rate=round(wpt["sent_win"] / wpt["t_win"], 1),
+                latency_ms_p50=round(wpt["lat_p50"], 1)
+                if wpt["lat_p50"] is not None else None,
+                latency_ms_p95=round(wpt["lat_p95"], 1)
+                if wpt["lat_p95"] is not None else None,
+                unresolved_markers=wpt["unresolved"],
+                train_records_per_sec=round(
+                    d["records_trained"] / wpt["t_win"], 1)))
+
+        # ---- clean shutdown: quiesce the fleet/KSQL first (a top-sweep
+        # backlog must drain, not grow, while the children wind down),
+        # then stop the children so they flush their final stats lines
+        stop.set()
+        for child in (train_child, score_child):
+            try:
+                child.stdin.write("STOP\n")
+                child.stdin.flush()
+            except OSError:
+                pass
+        for child, tag in ((train_child, "train"), (score_child, "score")):
+            try:
+                child.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                err.append(f"{tag} child failed to stop in 30s")
     finally:
         stop.set()
         try:
             for t in threads:
-                if t.ident is not None:  # a setup failure may leave some
-                    t.join(timeout=15)   # threads created but unstarted
+                if t.ident is not None:
+                    t.join(timeout=15)
         finally:
-            platform.stop()  # ALWAYS: a leaked platform (epoll front,
-            #                  servers) would outlive the bench and mask
-            #                  the original error
+            for child in (train_child, score_child):
+                if child is not None and child.poll() is None:
+                    child.kill()
+            ingest.stop()
+            platform.stop()  # ALWAYS: a leaked platform would outlive the
+            #                  bench and mask the original error
+            for f in stderr_files:
+                # diagnostics already embedded in any raised error text;
+                # leaving the files behind would accumulate per run
+                f.close()
+                try:
+                    os.unlink(f.name)
+                except OSError:
+                    pass
     if err:
         raise RuntimeError("; ".join(err[:3]))
-    lat_ms = sorted(x * 1000.0 for x in lat_samples)
-    # None, not NaN: json.dumps(NaN) is not valid JSON and would break
-    # strict line-oriented consumers of the metric lines
-    p50, p95 = _percentiles(lat_ms) if lat_ms else (None, None)
-    return dict(
-        value=preds_win / t_win,
-        window_s=round(t_win, 2),
-        publish_rate_msgs_per_sec=round(sent_win / t_win, 1),
-        predictions_in_window=preds_win,
-        unresolved_markers=len(pending),
-        latency_ms_p50=round(p50, 1) if p50 is not None else None,
-        latency_ms_p95=round(p95, 1) if p95 is not None else None,
-        n_latency_markers=len(lat_ms),
-        train_rounds=train_stats["rounds"],
-        records_trained=train_stats["records"],
-        stages="fleet+mqtt+bridge+ksql+train+serve concurrent",
+
+    d = window_deltas(headline)
+    pr = per_record_latency(headline)
+    q = d.get("quality")
+    out = dict(
+        value=headline["preds_win"] / headline["t_win"],
+        window_s=round(headline["t_win"], 2),
+        publish_rate_msgs_per_sec=round(
+            headline["sent_win"] / headline["t_win"], 1),
+        target_rate=headline_rate_actual,
+        predictions_in_window=headline["preds_win"],
+        unresolved_markers=headline["unresolved"],
+        latency_ms_p50=round(headline["lat_p50"], 1)
+        if headline["lat_p50"] is not None else None,
+        latency_ms_p95=round(headline["lat_p95"], 1)
+        if headline["lat_p95"] is not None else None,
+        n_latency_markers=headline["n_markers"],
+        train_rounds=d["train_rounds"],
+        records_trained=d["records_trained"],
+        train_records_per_sec=round(
+            d["records_trained"] / headline["t_win"], 1),
+        model_updates=d["model_updates"],
+        n_failing_cars=n_failing,
+        stages="fleet+mqtt+bridge+ksql(main) | train(tpu proc) | "
+               "serve(cpu proc), model loop closed via artifact store",
     )
+    if pr:
+        pr50, pr95 = _percentiles(pr)
+        out["per_record_latency_ms_p50"] = round(pr50, 1)
+        out["per_record_latency_ms_p95"] = round(pr95, 1)
+        out["n_per_record_samples"] = len(pr)
+    if q is not None:
+        prec = q["tp"] / max(q["tp"] + q["fp"], 1)
+        rec = q["tp"] / max(q["tp"] + q["fn"], 1)
+        out["_quality"] = dict(
+            value=d.get("auc", 0.0) or 0.0,
+            threshold=threshold,
+            precision=round(prec, 4), recall=round(rec, 4),
+            f1=round(2 * prec * rec / max(prec + rec, 1e-9), 4),
+            tp=q["tp"], fp=q["fp"], fn=q["fn"], tn=q["tn"],
+            anomalies_in_window=q["tp"] + q["fn"],
+            n_failing_cars=n_failing,
+            definition="live per-record verdicts (written to the "
+                       "predictions topic) vs injected labels; value=AUC "
+                       "from live error histograms")
+    if sweep_points:
+        out["_sweep"] = dict(value=float(len(sweep_points)),
+                             points=sweep_points,
+                             headline_rate=headline_rate_actual)
+    return out
 
 
 def main():
@@ -999,13 +1469,23 @@ def main():
     order = [
         ("fleet_ingest_msgs_per_sec", "msgs/s", FLEET_BASELINE_MPS),
         ("fleet_ingest_native_msgs_per_sec", "msgs/s", FLEET_BASELINE_MPS),
-        # 15k connections from SEPARATE load-generator processes (only the
+        # 18k connections from SEPARATE load-generator processes (only the
         # server's fd table binds — the reference's simulator-on-its-own-
-        # nodes shape)
+        # nodes shape; 18k ≈ this box's 20k-fd practical ceiling)
         ("fleet_ingest_multiproc_msgs_per_sec", "msgs/s",
          FLEET_BASELINE_MPS),
+        # the same fleet held for ≥60 s with per-second server RSS: the
+        # sustained-load story behind the reference's overload panels
+        # (hivemq.json) as a captured slope instead of prose
+        ("fleet_soak_msgs_per_sec", "msgs/s", FLEET_BASELINE_MPS),
         ("wire_train_records_per_sec_per_chip", "records/s",
          TRAIN_BASELINE_RPS),
+        # the reference's second model family: supervised LSTM windows
+        # (cardata-v1.py) and the MNIST-over-Kafka smoke — no published
+        # reference rates for either (vs_baseline 0), final-loss fields
+        # carry the quality evidence
+        ("lstm_train_windows_per_sec_per_chip", "windows/s", None),
+        ("mnist_stream_images_per_sec", "images/s", None),
         # no reference twin for long context (its only sequence mechanism
         # is an LSTM at look_back=1): vs_baseline deliberately 0
         ("flash_attention_fwd_bwd_tokens_per_sec", "tokens/s", None),
@@ -1015,11 +1495,18 @@ def main():
         ("serve_rows_per_sec", "rows/s", TRAIN_BASELINE_RPS),
         # the preprocessing stage must keep pace with fleet ingest
         ("ksql_pipeline_records_per_sec", "records/s", FLEET_BASELINE_MPS),
-        # the whole platform live at once: fleet → MQTT → bridge → KSQL →
-        # train + serve concurrently, predictions written back — the
-        # reference's actual demo shape, with publish→prediction
-        # flow-completion latency riding along as fields
+        # the whole platform live at once: fleet → MQTT → bridge → KSQL
+        # in the main process, training in a TPU child process, scoring in
+        # a CPU child process (the deploy manifests' pod separation), the
+        # model loop closed through the artifact store — the reference's
+        # actual demo shape, with publish→prediction latency, live
+        # detection quality, and a paced-rate sweep riding along
         ("e2e_platform_records_per_sec", "records/s", FLEET_BASELINE_MPS),
+        # live anomaly-detection quality: the scorer's threshold verdicts
+        # (the ones written to the predictions topic) scored against the
+        # generator's injected failure labels; value is the live AUC
+        ("e2e_detection_quality", "auc", None),
+        ("e2e_rate_sweep", "points", None),
         ("e2e_latency_ms", "ms", None),
         # the headline stays the LAST printed line (the driver parses the
         # final JSON line as the headline metric)
@@ -1038,6 +1525,8 @@ def main():
     try:
         run("streaming_train_records_per_sec_per_chip", bench_train_inproc)
         run("wire_train_records_per_sec_per_chip", bench_train_wire)
+        run("lstm_train_windows_per_sec_per_chip", bench_lstm_train)
+        run("mnist_stream_images_per_sec", bench_mnist_smoke)
         run("flash_attention_fwd_bwd_tokens_per_sec", bench_long_context)
         run("serve_rows_per_sec", bench_serve)
         run("ksql_pipeline_records_per_sec", bench_ksql_pipeline)
@@ -1052,18 +1541,36 @@ def main():
                 bench_fleet_ingest_multiproc)
         except Exception as e:
             print(f"# fleet_ingest_multiproc skipped: {e}", file=sys.stderr)
+        try:
+            run("fleet_soak_msgs_per_sec", bench_fleet_soak)
+        except Exception as e:
+            print(f"# fleet_soak skipped: {e}", file=sys.stderr)
         res = None
         try:
             run("e2e_platform_records_per_sec", bench_e2e_platform)
             res = results["e2e_platform_records_per_sec"]
         except Exception as e:
             print(f"# e2e_platform skipped: {e}", file=sys.stderr)
+        if res is not None:
+            quality = res.pop("_quality", None)
+            if quality is not None:
+                results["e2e_detection_quality"] = quality
+            sweep_res = res.pop("_sweep", None)
+            if sweep_res is not None:
+                results["e2e_rate_sweep"] = sweep_res
         if res is not None and res.get("latency_ms_p50") is not None:
-            results["e2e_latency_ms"] = dict(
+            lat_line = dict(
                 value=res.get("latency_ms_p50"),
                 p95_ms=res.get("latency_ms_p95"),
                 n_markers=res.get("n_latency_markers"),
-                definition="publish→prediction flow completion")
+                definition="publish→prediction flow completion; "
+                           "per_record_* = sampled true per-record "
+                           "latency (bridge stamp → prediction drain)")
+            for k in ("per_record_latency_ms_p50",
+                      "per_record_latency_ms_p95", "n_per_record_samples"):
+                if res.get(k) is not None:
+                    lat_line[k] = res[k]
+            results["e2e_latency_ms"] = lat_line
     finally:
         for metric, unit, baseline in order:
             res = results.get(metric)

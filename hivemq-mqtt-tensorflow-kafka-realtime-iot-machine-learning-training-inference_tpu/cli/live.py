@@ -1,0 +1,155 @@
+"""Long-lived train / score services over the Kafka wire — the continuous
+twin of the batch CLIs.
+
+The reference runs training as a restarted Job and prediction as a
+restarted Deployment (`run.sh:16-91`, python-scripts/README.md:24-26 calls
+the restart loop out as "not an ideal architecture").  These entry points
+are the long-lived form, one process each, matching the deploy manifests'
+pod separation (`deploy/model-training.yaml`, `deploy/model-predictions.yaml`):
+
+    python -m iotml.cli.live train  <servers> <topic> <artifact_root>
+    python -m iotml.cli.live score  <servers> <topic> <result_topic> <artifact_root>
+
+Both connect over the real Kafka wire protocol (native C++ client when
+built, pure-Python fallback).  `--stats` prints one JSON line per round /
+drain on stdout for an orchestrating process; both exit cleanly when stdin
+closes or receives a STOP line (the supervisor contract), or after
+`--max-seconds`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+
+def _wire_broker(servers: str, sasl: str):
+    user, pw = (sasl.split(":", 1) if sasl else (None, None))
+    try:
+        from ..stream.native_kafka import NativeKafkaBroker
+
+        return NativeKafkaBroker(servers, sasl_username=user,
+                                 sasl_password=pw)
+    except Exception:
+        from ..stream.kafka_wire import KafkaWireBroker
+
+        return KafkaWireBroker(servers, sasl_username=user, sasl_password=pw)
+
+
+def _stopper(max_seconds: float):
+    """stop() that trips on stdin EOF / a STOP line / the deadline."""
+    ev = threading.Event()
+
+    def watch_stdin():
+        for line in sys.stdin:
+            if line.strip() == "STOP":
+                break
+        ev.set()
+
+    threading.Thread(target=watch_stdin, daemon=True).start()
+    deadline = time.time() + max_seconds if max_seconds else None
+    return lambda: ev.is_set() or (deadline is not None
+                                   and time.time() > deadline)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m iotml.cli.live",
+        description="continuous train/score services over the Kafka wire")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    tr = sub.add_parser("train", help="continuous trainer → artifacts")
+    tr.add_argument("servers")
+    tr.add_argument("topic")
+    tr.add_argument("artifact_root")
+    tr.add_argument("--model-name", default="cardata-live.h5")
+    tr.add_argument("--group", default="cardata-live-train")
+    tr.add_argument("--take-batches", type=int, default=20)
+    tr.add_argument("--batch-size", type=int, default=100)
+    tr.add_argument("--epochs-per-round", type=int, default=1)
+
+    sc = sub.add_parser("score", help="continuous scorer with hot-swap")
+    sc.add_argument("servers")
+    sc.add_argument("topic")
+    sc.add_argument("result_topic")
+    sc.add_argument("artifact_root")
+    sc.add_argument("--model-name", default="cardata-live.h5")
+    sc.add_argument("--group", default="cardata-live-score")
+    sc.add_argument("--threshold", type=float, default=5.0)
+    sc.add_argument("--batch-size", type=int, default=100)
+    sc.add_argument("--wait-model-seconds", type=float, default=120.0)
+
+    for p in (tr, sc):
+        p.add_argument("--sasl", default=None, metavar="USER:PASS")
+        p.add_argument("--stats", action="store_true",
+                       help="print one JSON line per round/drain")
+        p.add_argument("--max-seconds", type=float, default=0.0,
+                       help="exit after this long (0 = until stdin closes)")
+        p.add_argument("--wait-topic-seconds", type=float, default=60.0,
+                       help="wait this long for the input topic to appear")
+
+    args = ap.parse_args(argv)
+    broker = _wire_broker(args.servers, args.sasl)
+    stop = _stopper(args.max_seconds)
+
+    # the input topic may be created by an upstream stage (the KSQL CSAS
+    # materializes SENSOR_DATA_S_AVRO only once records flow): wait for it
+    deadline = time.time() + args.wait_topic_seconds
+    while True:
+        try:
+            refresh = getattr(broker, "refresh_topic", None)
+            if (refresh(args.topic) if refresh is not None
+                    else broker.topic(args.topic)) is not None:
+                break
+        except KeyError:
+            pass
+        if stop() or time.time() > deadline:
+            print(f"topic {args.topic} not available after "
+                  f"{args.wait_topic_seconds}s")
+            return 1
+        time.sleep(0.1)
+
+    def emit(stats: dict) -> None:
+        if args.stats:
+            print(json.dumps(stats), flush=True)
+
+    from ..train.artifacts import ArtifactStore
+
+    store = ArtifactStore(args.artifact_root)
+    if args.cmd == "train":
+        from ..train.live import ContinuousTrainer
+
+        svc = ContinuousTrainer(broker, args.topic, store,
+                                model_name=args.model_name, group=args.group,
+                                batch_size=args.batch_size,
+                                take_batches=args.take_batches,
+                                epochs_per_round=args.epochs_per_round)
+        print(f"live train: {args.topic} rounds of "
+              f"{args.take_batches}x{args.batch_size} -> "
+              f"{args.artifact_root}/{args.model_name}", flush=True)
+        rounds = svc.run(stop=stop, on_round=emit)
+        print(f"live train done: {rounds} rounds, "
+              f"{svc.records_trained} records, last loss {svc.last_loss}",
+              flush=True)
+    else:
+        from ..serve.live import LiveScorer
+
+        svc = LiveScorer(broker, args.topic, args.result_topic, store,
+                         model_name=args.model_name, group=args.group,
+                         threshold=args.threshold,
+                         batch_size=args.batch_size)
+        artifact = svc.wait_for_model(args.wait_model_seconds)
+        print(f"live score: model {artifact} loaded; "
+              f"{args.topic} -> {args.result_topic}", flush=True)
+        n = svc.run(stop=stop, on_drain=emit)
+        q = svc.scorer.quality
+        print(f"live score done: {n} rows, {svc.model_updates} model "
+              f"updates, quality {q}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

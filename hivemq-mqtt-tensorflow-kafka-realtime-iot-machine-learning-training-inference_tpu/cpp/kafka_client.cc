@@ -295,6 +295,108 @@ bool request(Client* c, int16_t api, int16_t version, const Writer& body,
 
 extern "C" {
 
+// ------------------------------------------------ standalone msgset codec
+// MessageSet v1 encode/decode WITHOUT a connection handle: the wire
+// SERVER's hot loops (kafka_wire.py fetch responses / produce requests)
+// were pure-Python per-record Writer/Reader + crc32 — at tens of
+// thousands of records/s through the platform process that cost a large
+// slice of its core.  Same wire bytes as the Python codec (the oracle);
+// kafka_wire.py falls back to it whenever these return an error.
+
+// Encode n records (columnar) into out_buf.  offsets may be NULL (all 0,
+// the client-produce convention).  Returns bytes written, or -(needed)
+// when out_cap is too small (caller re-calls with a bigger buffer).
+int64_t iotml_msgset_encode(const uint8_t* values, const int64_t* val_off,
+                            const uint8_t* keys, const int64_t* key_off,
+                            const uint8_t* key_null,
+                            const int64_t* timestamps,
+                            const int64_t* offsets, int64_t n,
+                            uint8_t* out_buf, int64_t out_cap) {
+  Writer w;
+  w.buf.reserve(static_cast<size_t>(
+      n * 34 + (n ? val_off[n] : 0) + (keys && n ? key_off[n] : 0)));
+  for (int64_t i = 0; i < n; ++i) {
+    Writer body;
+    body.i8(1);  // magic 1
+    body.i8(0);  // attributes
+    body.i64(timestamps ? timestamps[i] : 0);
+    if (keys && !(key_null && key_null[i])) {
+      body.bytes(keys + key_off[i],
+                 static_cast<int32_t>(key_off[i + 1] - key_off[i]));
+    } else {
+      body.bytes(nullptr, -1);
+    }
+    body.bytes(values + val_off[i],
+               static_cast<int32_t>(val_off[i + 1] - val_off[i]));
+    w.i64(offsets ? offsets[i] : 0);
+    w.i32(static_cast<int32_t>(body.buf.size() + 4));
+    w.u32(crc32(body.buf.data(), body.buf.size()));
+    w.raw(body.buf.data(), body.buf.size());
+  }
+  int64_t total = static_cast<int64_t>(w.buf.size());
+  if (total > out_cap) return -total;
+  if (total) memcpy(out_buf, w.buf.data(), total);
+  return total;
+}
+
+// Decode up to max_n records into columnar outputs.  Returns the record
+// count; -1 on CRC mismatch / malformed framing (caller falls back to the
+// Python decoder for its exact error semantics); -2 when the caller's
+// key/value capacity is too small.  A truncated trailing record is
+// dropped, matching the Python decoder (Kafka fetch responses may carry
+// partial tails).  Null keys set key_null=1; null values decode as empty
+// with val_null=1 so the caller can preserve None-ness.
+int64_t iotml_msgset_decode(const uint8_t* buf, int64_t len, int64_t max_n,
+                            int64_t* offsets, int64_t* ts,
+                            int64_t* key_off, uint8_t* key_null,
+                            uint8_t* keys, int64_t keys_cap,
+                            int64_t* val_off, uint8_t* val_null,
+                            uint8_t* values, int64_t values_cap) {
+  Reader r(buf, static_cast<size_t>(len));
+  int64_t n = 0;
+  int64_t kpos = 0, vpos = 0;
+  key_off[0] = 0;
+  val_off[0] = 0;
+  while (r.pos + 12 <= static_cast<size_t>(len) && n < max_n) {
+    int64_t offset = r.i64();
+    int32_t size = r.i32();
+    if (size < 0 || r.pos + static_cast<size_t>(size) >
+                        static_cast<size_t>(len)) {
+      break;  // partial trailing message
+    }
+    size_t end = r.pos + size;
+    uint32_t crc = r.u32();
+    if (crc32(buf + r.pos, end - r.pos) != crc) return -1;
+    int8_t magic = r.i8();
+    r.i8();  // attributes (no compression in this subset)
+    int64_t t = magic >= 1 ? r.i64() : 0;
+    const uint8_t* kp;
+    int32_t kn = r.bytes(&kp);
+    const uint8_t* vp;
+    int32_t vn = r.bytes(&vp);
+    if (r.fail) return -1;
+    r.pos = end;
+    if (kn > 0 && kpos + kn > keys_cap) return -2;
+    if (vn > 0 && vpos + vn > values_cap) return -2;
+    offsets[n] = offset;
+    ts[n] = t;
+    key_null[n] = kn < 0;
+    if (kn > 0) {
+      memcpy(keys + kpos, kp, kn);
+      kpos += kn;
+    }
+    key_off[n + 1] = kpos;
+    val_null[n] = vn < 0;
+    if (vn > 0) {
+      memcpy(values + vpos, vp, vn);
+      vpos += vn;
+    }
+    val_off[n + 1] = vpos;
+    ++n;
+  }
+  return n;
+}
+
 // Connect (optionally SASL/PLAIN-authenticating, the reference cluster's
 // mandatory mechanism — gcp.yaml:29-32).  Returns an opaque handle or NULL.
 void* iotml_kafka_connect(const char* host, int32_t port,

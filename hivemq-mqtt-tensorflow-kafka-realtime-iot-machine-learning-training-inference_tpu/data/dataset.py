@@ -107,6 +107,12 @@ class SensorBatches:
         # the offset-slice, cardata-v3.py:274), not once per drain — a
         # continuous scorer re-entering __iter__ must not re-skip new data.
         self._skipped = 0
+        # Rows still wanted by the current bounded iteration (None =
+        # unbounded): `take` callers must not poll past what they will
+        # batch — over-polled rows would advance the consumer cursor and
+        # be skipped for good.  Updated by __iter__ between chunks, read
+        # by the poll loop to cap each fetch.
+        self._need_rows: Optional[int] = None
         # Native (C++) columnar decode when the engine is built; the pure
         # codec is the fallback and the test oracle.
         self._native = None
@@ -135,6 +141,13 @@ class SensorBatches:
         obs_metrics.records_consumed.inc(len(xs))
         return xs, np.asarray(labels)
 
+    def _poll_limit(self) -> int:
+        """Per-poll fetch cap: the configured chunk, bounded by what the
+        current iteration still needs (see _need_rows)."""
+        if self._need_rows is None:
+            return self.poll_chunk
+        return max(1, min(self.poll_chunk, self._need_rows))
+
     def _decoded_chunks(self):
         """Yield (xs [n, F] float32 normalized, labels [n] str) per poll."""
         label_f = self.schema.label_field
@@ -145,12 +158,12 @@ class SensorBatches:
             # per-message Python objects.
             while True:
                 num, lab = self.consumer.poll_decoded(
-                    self._native, strip=5, max_messages=self.poll_chunk)
+                    self._native, strip=5, max_messages=self._poll_limit())
                 if len(num) == 0:
                     return
                 yield self._emit_chunk(num, self._native_labels(lab, len(num)))
         while True:
-            msgs = self.consumer.poll(self.poll_chunk)
+            msgs = self.consumer.poll(self._poll_limit())
             if not msgs:
                 return
             n = len(msgs)
@@ -213,34 +226,49 @@ class SensorBatches:
                 lab[n_valid:] = ""
             return Batch(x, n_valid, 0, lab)  # first_index patched by caller
 
-        for chunk in self._filtered_chunks():
-            parts.append(chunk)
-            have += len(chunk[0])
-            if have < B:
-                continue
-            xs, labels = assemble()
-            lo = 0
-            while len(xs) - lo >= B:
-                if self._skipped < self.skip:
-                    self._skipped += 1
-                else:
-                    b = emit(xs, labels, lo)
-                    b.first_index = index
-                    yield b
-                    emitted += 1
-                    index += B
-                    if self.take and emitted >= self.take:
-                        return
-                lo += B
-            if lo < len(xs):
-                parts = [(xs[lo:], labels[lo:])]
-                have = len(xs) - lo
-        if have and self.pad_tail and self._skipped >= self.skip and \
-                (not self.take or emitted < self.take):
-            xs, labels = assemble()
-            b = emit(xs, labels, 0)
-            b.first_index = index
-            yield b
+        chunks = self._filtered_chunks()
+        try:
+            while True:
+                if self.take:
+                    # cap polling at what this bounded iteration can still
+                    # batch: rows polled past the `take` boundary would
+                    # advance the cursor and be lost to the caller
+                    needed = (self.take - emitted
+                              + max(self.skip - self._skipped, 0))
+                    self._need_rows = needed * B - have
+                try:
+                    chunk = next(chunks)
+                except StopIteration:
+                    break
+                parts.append(chunk)
+                have += len(chunk[0])
+                if have < B:
+                    continue
+                xs, labels = assemble()
+                lo = 0
+                while len(xs) - lo >= B:
+                    if self._skipped < self.skip:
+                        self._skipped += 1
+                    else:
+                        b = emit(xs, labels, lo)
+                        b.first_index = index
+                        yield b
+                        emitted += 1
+                        index += B
+                        if self.take and emitted >= self.take:
+                            return
+                    lo += B
+                if lo < len(xs):
+                    parts = [(xs[lo:], labels[lo:])]
+                    have = len(xs) - lo
+            if have and self.pad_tail and self._skipped >= self.skip and \
+                    (not self.take or emitted < self.take):
+                xs, labels = assemble()
+                b = emit(xs, labels, 0)
+                b.first_index = index
+                yield b
+        finally:
+            self._need_rows = None
 
     def _windowed_iter(self) -> Iterator[Batch]:
         """Sliding windows x=[B,T,F] with next-step targets y=[B,1,F].
@@ -248,39 +276,96 @@ class SensorBatches:
         Reproduces dataset.window(look_back, shift=1) zipped with
         dataset.skip(look_back) (reference LSTM cardata-v1.py:184-190): the
         window starting at record i is paired with record i+look_back.
+
+        Vectorized: windows materialize per decoded CHUNK via a strided
+        view + one transpose-copy, not a Python ring per row — the row
+        loop was the LSTM ingest bottleneck (10k windows ≈ seconds of
+        pure interpreter time).
         """
+        from numpy.lib.stride_tricks import sliding_window_view
+
         T = self.window
-        F = self.schema.num_sensors
         B = self.batch_size
-        ring: list = []
-        xs = np.zeros((B, T, F), np.float32)
-        ys = np.zeros((B, 1, F), np.float32)
-        fill = 0
+        carry = None          # last T rows: windows spanning chunk joints
+        pend_x: list = []     # [n, T, F] window chunks awaiting batching
+        pend_y: list = []     # [n, 1, F]
+        have = 0
         emitted = 0
         index = 0
-        for x, _y in self._filtered_rows():
-            ring.append(x)
-            if len(ring) < T + 1:
-                continue
-            xs[fill] = np.stack(ring[:T])
-            ys[fill] = ring[T][None]
-            ring.pop(0)
-            fill += 1
-            if fill == B:
-                if self._skipped < self.skip:
-                    self._skipped += 1
-                else:
-                    yield Batch(xs.copy(), B, index, y=ys.copy())
-                    emitted += 1
-                    index += B
-                    if self.take and emitted >= self.take:
-                        return
-                fill = 0
-        if fill and self.pad_tail and self._skipped >= self.skip and \
-                (not self.take or emitted < self.take):
-            xs[fill:] = 0.0
-            ys[fill:] = 0.0
-            yield Batch(xs.copy(), fill, index, y=ys.copy())
+
+        def emit(wx, wy, lo):
+            n_valid = min(B, len(wx) - lo)
+            x = np.zeros((B, T, wx.shape[2]), np.float32)
+            y = np.zeros((B, 1, wx.shape[2]), np.float32)
+            x[:n_valid] = wx[lo:lo + n_valid]
+            y[:n_valid] = wy[lo:lo + n_valid]
+            return Batch(x, n_valid, 0, y=y)
+
+        chunks = self._filtered_chunks()
+        try:
+            while True:
+                if self.take:
+                    needed = (self.take - emitted
+                              + max(self.skip - self._skipped, 0))
+                    # rows already in `carry` count toward the T lookahead
+                    # a window needs — re-adding the full T every chunk
+                    # would over-poll (and so permanently skip, for
+                    # cursor-resuming callers) up to T-1 rows per round
+                    covered = 0 if carry is None else len(carry)
+                    self._need_rows = needed * B - have + max(T - covered,
+                                                              0)
+                try:
+                    xs, _labels = next(chunks)
+                except StopIteration:
+                    break
+                buf = xs.astype(np.float32, copy=False)
+                if carry is not None and len(carry):
+                    buf = np.concatenate([carry, buf])
+                n_w = len(buf) - T  # windows with a next-step target
+                if n_w <= 0:
+                    carry = buf
+                    continue
+                # [n_w, T, F]: strided view (axis order [n, F, T]) then one
+                # transpose-copy; y is the row T steps after each window
+                wins = np.ascontiguousarray(
+                    sliding_window_view(buf, T, axis=0)[:n_w]
+                    .transpose(0, 2, 1))
+                ys = buf[T: T + n_w][:, None, :]
+                carry = buf[n_w:]
+                pend_x.append(wins)
+                pend_y.append(ys)
+                have += n_w
+                if have < B:
+                    continue
+                wx = np.concatenate(pend_x) if len(pend_x) > 1 else pend_x[0]
+                wy = np.concatenate(pend_y) if len(pend_y) > 1 else pend_y[0]
+                pend_x, pend_y = [], []
+                have = 0
+                lo = 0
+                while len(wx) - lo >= B:
+                    if self._skipped < self.skip:
+                        self._skipped += 1
+                    else:
+                        b = emit(wx, wy, lo)
+                        b.first_index = index
+                        yield b
+                        emitted += 1
+                        index += B
+                        if self.take and emitted >= self.take:
+                            return
+                    lo += B
+                if lo < len(wx):
+                    pend_x, pend_y = [wx[lo:]], [wy[lo:]]
+                    have = len(wx) - lo
+            if have and self.pad_tail and self._skipped >= self.skip and \
+                    (not self.take or emitted < self.take):
+                wx = np.concatenate(pend_x) if len(pend_x) > 1 else pend_x[0]
+                wy = np.concatenate(pend_y) if len(pend_y) > 1 else pend_y[0]
+                b = emit(wx, wy, 0)
+                b.first_index = index
+                yield b
+        finally:
+            self._need_rows = None
 
     # --------------------------------------------------------- epoch API
     def reset(self):

@@ -98,12 +98,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
         l_s[:] = jnp.zeros_like(l_s)
 
     def compute():
-        # QK rides the MXU at the INPUT dtype (bf16 inputs → bf16 systolic
-        # passes, f32 accumulation — exact products, ~4× the f32 rate);
-        # the scale is applied to the f32 scores afterwards so no
-        # precision is spent on it.  P·V stays f32: the probabilities are
-        # f32-precision quantities and the output tolerance pins them.
-        v_blk = v_ref[:].astype(jnp.float32)
+        # EVERY matmul rides the MXU at the INPUT dtype (bf16 inputs →
+        # bf16 systolic passes at ~4× the f32 rate, f32 ACCUMULATION
+        # always).  QK's bf16 products are exact (inputs are bf16); the
+        # scale is applied to the f32 scores afterwards.  P is computed in
+        # f32 (softmax stability) then cast to the input dtype for P·V —
+        # the standard flash-attention trade: an f32 P·V matmul runs at ¼
+        # the MXU rate and capped this kernel's whole-step MFU at ~33%
+        # (see ARCHITECTURE.md roofline); the bf16 P rounding (~3 decimal
+        # digits) is below the bf16 output's own quantization.
         s = jax.lax.dot_general(q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -121,7 +124,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
         m_s[:] = m_new
         l_s[:] = l * corr + jnp.sum(p, axis=1, keepdims=True)
         acc[:] = acc[:] * corr + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[:], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -247,13 +250,14 @@ def _flash_bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         p, ds = _bwd_common(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                             scale=scale, causal=causal, block_q=block_q,
                             block_k=block_k, t_real=t_real, i=i, j=j)
-        do_f = do_ref[:].astype(jnp.float32)
-        q_f = q_ref[:].astype(jnp.float32)
+        # p/ds cast to the input dtype: bf16 MXU passes with f32
+        # accumulation (see the forward's dtype-policy note + the
+        # ARCHITECTURE.md roofline — f32 operand matmuls were the MFU cap)
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
-            p, do_f, (((0,), (0,)), ((), ())),
+            p.astype(do_ref.dtype), do_ref[:], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
-            ds, q_f, (((0,), (0,)), ((), ())),
+            ds.astype(q_ref.dtype), q_ref[:], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -289,9 +293,8 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         _, ds = _bwd_common(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                             scale=scale, causal=causal, block_q=block_q,
                             block_k=block_k, t_real=t_real, i=i, j=j)
-        k_f = k_ref[:].astype(jnp.float32)
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
-            ds, k_f, (((1,), (0,)), ((), ())),
+            ds.astype(k_ref.dtype), k_ref[:], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:

@@ -1,0 +1,147 @@
+"""Long-lived scorer with live model hot-swap off the artifact store.
+
+The reference's predict Deployment downloads a fixed GCS model name at pod
+start and scores until restarted (`cardata-v3.py:255-274`,
+`run.sh:16-91` restarts it after each training job so new weights take
+effect).  `LiveScorer` is that loop without the restart: it polls the
+`{model_name}.latest` pointer a `train.live.ContinuousTrainer` flips after
+every round, downloads the new immutable blob, and swaps params between
+super-batches — predictions keep flowing, in order, across the swap
+(`StreamScorer.set_params`).
+
+Detection quality rides along: batches keep the stream's
+`failure_occurred` labels, so the threshold verdicts written to the
+predictions topic are scored live into a confusion matrix
+(`StreamScorer.quality`) — the streaming notebook's offline protocol
+(threshold → confusion matrix, cells 21-26) as a live metric.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+from typing import Callable, Optional
+
+from ..data.dataset import SensorBatches
+from ..stream.consumer import StreamConsumer
+from ..stream.producer import OutputSequence
+from ..train.artifacts import ArtifactStore
+from .scorer import StreamScorer
+
+
+class LiveScorer:
+    """Continuous scoring with pointer-driven weight hot-swap."""
+
+    def __init__(self, broker, topic: str, result_topic: str,
+                 store: ArtifactStore, model_name: str = "cardata-live.h5",
+                 model=None, threshold: Optional[float] = 5.0,
+                 group: str = "cardata-live-score", batch_size: int = 100,
+                 out_partition: Optional[int] = 0):
+        if model is None:
+            from ..models.autoencoder import CAR_AUTOENCODER
+
+            model = CAR_AUTOENCODER
+        self.broker = broker
+        self.store = store
+        self.model_name = model_name
+        self.model = model
+        parts = range(broker.topic(topic).partitions)
+        consumer = StreamConsumer.from_committed(broker, topic, parts,
+                                                 group=group, eof=False)
+        batches = SensorBatches(consumer, batch_size=batch_size,
+                                keep_labels=True)
+        out = OutputSequence(broker, result_topic, partition=out_partition)
+        # params are loaded by wait_for_model(); scoring before that would
+        # write garbage predictions from random init
+        self.scorer = StreamScorer(model, None, batches, out,
+                                   threshold=threshold)
+        self._current_artifact: Optional[str] = None
+        self.model_updates = 0
+
+    # ----------------------------------------------------------- weights
+    def _load(self, artifact: str) -> None:
+        from ..models.h5_import import autoencoder_params_from_h5
+
+        with tempfile.TemporaryDirectory(prefix="iotml_swap_") as tmp:
+            local = os.path.join(tmp, "model.h5")
+            self.store.download(artifact, local)
+            params = autoencoder_params_from_h5(local)
+        self.scorer.set_params(params)
+        self._current_artifact = artifact
+        self.model_updates += 1
+
+    def maybe_swap(self) -> bool:
+        """Poll the pointer; swap when it names a new immutable blob."""
+        latest = self.store.get_text(f"{self.model_name}.latest")
+        if latest is None or latest == self._current_artifact:
+            return False
+        self._load(latest)
+        return True
+
+    def wait_for_model(self, timeout_s: float = 60.0) -> str:
+        """Block until the trainer publishes the first model (the predict
+        pod's download-at-start, made explicit)."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if self.maybe_swap():
+                return self._current_artifact
+            time.sleep(0.05)
+        raise TimeoutError(f"no artifact at {self.model_name}.latest "
+                           f"after {timeout_s}s")
+
+    # ------------------------------------------------------------- serve
+    def run(self, stop: Optional[Callable[[], bool]] = None,
+            max_drains: Optional[int] = None,
+            poll_interval_s: float = 0.02,
+            on_drain: Optional[Callable[[dict], None]] = None) -> int:
+        """Score until `stop()`; returns rows scored.  Calls `on_drain`
+        with a stats snapshot after every non-empty drain (the live CLI
+        prints these as JSON lines for the orchestrating process)."""
+        if self.scorer.params is None:
+            self.wait_for_model()
+        scored0 = self.scorer.scored
+        drains = 0
+        last_emit = 0.0
+        try:
+            while (stop is None or not stop()) and \
+                    (max_drains is None or drains < max_drains):
+                self.maybe_swap()
+                # bounded drain: under sustained overload an unbounded
+                # drain would never return and this loop would stop
+                # polling for new weights / the stop signal
+                n = self.scorer.score_available(max_rows=50_000)
+                if n == 0:
+                    time.sleep(poll_interval_s)
+                    continue
+                drains += 1
+                # stats are cumulative, so a consumer only needs them at
+                # its own cadence: throttle to 10 Hz so tiny frequent
+                # drains don't spend the core serializing stats lines
+                if on_drain is not None and \
+                        time.time() - last_emit >= 0.1:
+                    last_emit = time.time()
+                    on_drain(self.stats())
+        finally:
+            # final snapshot: the cumulative counters up to the stop point
+            if on_drain is not None and drains:
+                on_drain(self.stats())
+        return self.scorer.scored - scored0
+
+    def stats(self) -> dict:
+        return {
+            "t": time.time(),
+            # False while a max_rows-truncated drain is suspended: the
+            # consumer positions then run ahead of the flushed
+            # predictions, so position-based joins (per-record latency)
+            # must only trust complete-drain snapshots
+            "drain_complete": self.scorer._resume is None,
+            "scored": self.scorer.scored,
+            "quality": dict(self.scorer.quality),
+            "err_hist": {k: v.tolist()
+                         for k, v in self.scorer.err_hist.items()},
+            "model_updates": self.model_updates,
+            "artifact": self._current_artifact,
+            "positions": {f"{p}": off for _, p, off
+                          in self.scorer.batches.consumer.positions()},
+        }

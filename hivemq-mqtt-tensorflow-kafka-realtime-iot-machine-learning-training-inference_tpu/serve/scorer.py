@@ -34,6 +34,24 @@ def format_prediction(row: np.ndarray) -> str:
     return np.array2string(row)
 
 
+#: log-spaced reconstruction-error bucket edges for live quality histograms
+#: (64 buckets spanning 1e-4..1e2 + one overflow bucket)
+ERR_BUCKETS = np.geomspace(1e-4, 1e2, 65)
+
+
+def hist_auc(anom: np.ndarray, normal: np.ndarray) -> Optional[float]:
+    """ROC AUC from per-label score histograms (midpoint tie handling).
+
+    Buckets ascend in score; AUC = P(score_anom > score_normal) with ties
+    counted half — the rank-sum estimator over binned errors."""
+    a_tot, n_tot = int(anom.sum()), int(normal.sum())
+    if not a_tot or not n_tot:
+        return None
+    n_below = np.concatenate([[0], np.cumsum(normal)[:-1]])
+    wins = float(np.sum(anom * (n_below + normal / 2.0)))
+    return wins / (a_tot * n_tot)
+
+
 class StreamScorer:
     """Score an input stream continuously; write ordered predictions back.
 
@@ -78,8 +96,34 @@ class StreamScorer:
         self.threshold = threshold
         self._eval = make_eval_step(model)
         self.scored = 0
+        #: suspended (iterator, index_base) of a max_rows-truncated drain
+        self._resume = None
+        #: confusion counts of the threshold verdicts against stream labels
+        #: (batches built with keep_labels=True): live detection quality —
+        #: the notebook's offline protocol (threshold / confusion matrix,
+        #: streaming notebook cells 21-26) running against the predictions
+        #: actually being written.  Padding rows are excluded; rows without
+        #: a label (empty string) count as negatives, matching the training
+        #: filter's reading of the label field.
+        self.quality = {"tp": 0, "fp": 0, "fn": 0, "tn": 0}
+        #: per-label reconstruction-error histograms (log buckets): enough
+        #: to recover threshold-free quality (AUC, any operating point)
+        #: from a live run without retaining per-row errors
+        self.err_hist = {"true": np.zeros(len(ERR_BUCKETS) + 1, np.int64),
+                         "false": np.zeros(len(ERR_BUCKETS) + 1, np.int64)}
 
-    def score_available(self) -> int:
+    def set_params(self, params) -> None:
+        """Hot-swap model weights; takes effect at the next super-batch.
+
+        The handoff the reference performs by restarting its predict pod
+        with a fresh GCS download (cardata-v3.py:255-261) — a long-lived
+        scorer swaps in place instead.  The jit eval traces params as
+        arguments, so same-shaped params reuse the compiled program, and
+        the swap cannot drop or reorder output: the OutputSequence index
+        stream is untouched."""
+        self.params = params
+
+    def score_available(self, max_rows: Optional[int] = None) -> int:
         """Drain whatever is currently in the stream; returns rows scored.
 
         Each super-batch is ONE device dispatch: up to max_super_batches
@@ -87,26 +131,47 @@ class StreamScorer:
         a dispatch per 100-row batch — per-dispatch link latency dominates a
         model this small, so a typical drain costs one round trip instead of
         one per batch, and a deep backlog costs ceil(S/cap) round trips with
-        bounded memory."""
-        base = self.scored  # batch.first_index restarts per drain; rebase globally
-        it = iter(self.batches)
+        bounded memory.
+
+        `max_rows` bounds ONE call: when producers outpace the scorer, an
+        unbounded drain never returns and the caller's control loop
+        (hot-swap polling, stop flags) starves.  A bounded call that still
+        had data keeps its iterator SUSPENDED — the batcher's buffered
+        rows stay queued, the next call resumes exactly where it stopped,
+        and offsets only commit once the drain truly reaches the stream
+        end (committing at the truncation point would persist the cursor
+        past polled-but-unscored rows and silently drop them)."""
+        start = self.scored
+        if self._resume is not None:
+            # continue the truncated drain: same iterator, same index base
+            it, it_base = self._resume
+            self._resume = None
+        else:
+            # batch.first_index restarts per iterator; rebase globally
+            it, it_base = iter(self.batches), self.scored
         while True:
             bs = list(itertools.islice(it, self.max_super_batches))
             if not bs:
                 break
-            self._score_super_batch(bs, base)
+            self._score_super_batch(bs, it_base)
             # flush per super-batch: indices are monotone so the ordered
             # flush is preserved and host memory stays bounded by one
             # super-batch of formatted predictions
             self.out.flush()
-        # offsets commit once per drain, AFTER every polled row was scored:
-        # the consumer cursor runs ahead of the scored rows inside the
-        # batcher's poll/filter buffers, so a mid-drain commit would record
-        # offsets for rows not yet scored and lose them on crash-resume.
-        # A crash mid-drain therefore redoes the drain from the previous
-        # commit (at-least-once), never skips data.
-        self.batches.consumer.commit()
-        return self.scored - base
+            if max_rows is not None and self.scored - start >= max_rows:
+                self._resume = (it, it_base)
+                break
+        if self._resume is None:
+            # offsets commit once per COMPLETED drain, AFTER every polled
+            # row was scored: the consumer cursor runs ahead of the scored
+            # rows inside the batcher's poll/filter buffers, so an earlier
+            # commit would record offsets for rows not yet scored and lose
+            # them on crash-resume.  A crash mid-drain therefore redoes
+            # the drain from the previous commit (at-least-once), never
+            # skips data; under sustained overload (every call truncated)
+            # commits simply wait for the first completed drain.
+            self.batches.consumer.commit()
+        return self.scored - start
 
     def _score_super_batch(self, bs, base: int) -> None:
         xs = np.stack([b.x for b in bs])   # [S, B, ...] (F, or T×F windowed)
@@ -139,6 +204,19 @@ class StreamScorer:
         mi = 0
         for k, b in enumerate(bs):
             err = errs[k]
+            if self.threshold is not None and b.labels is not None \
+                    and b.n_valid:
+                flag = err[: b.n_valid] > self.threshold
+                truth = b.labels[: b.n_valid] == "true"
+                self.quality["tp"] += int(np.sum(flag & truth))
+                self.quality["fp"] += int(np.sum(flag & ~truth))
+                self.quality["fn"] += int(np.sum(~flag & truth))
+                self.quality["tn"] += int(np.sum(~flag & ~truth))
+                buckets = np.searchsorted(ERR_BUCKETS, err[: b.n_valid])
+                for lab, sel in (("true", truth), ("false", ~truth)):
+                    if np.any(sel):
+                        self.err_hist[lab] += np.bincount(
+                            buckets[sel], minlength=len(ERR_BUCKETS) + 1)
             for i in range(b.n_valid):
                 idx = base + b.first_index + i
                 msg = msgs[mi]

@@ -134,9 +134,45 @@ class _Reader:
 
 
 # ---------------------------------------------------------- message sets
-def encode_message_set(entries: List[Tuple[int, Optional[bytes],
-                                           Optional[bytes], int]]) -> bytes:
-    """entries: [(offset, key, value, timestamp_ms)] → MessageSet v1 bytes."""
+# Native (C++) codec for the hot directions: the pure-Python loops below
+# are the oracle and the fallback, but at platform rates (two consumers +
+# a producer through one wire server = tens of thousands of records/s)
+# the per-record Writer/Reader + crc32 work was a large slice of the
+# server process's core.  Loaded lazily; byte parity is pinned by
+# tests/test_kafka_wire.py.
+_NATIVE_LIB = None
+_NATIVE_TRIED = False
+
+
+def _native_lib():
+    global _NATIVE_LIB, _NATIVE_TRIED
+    if not _NATIVE_TRIED:
+        _NATIVE_TRIED = True
+        try:
+            import ctypes
+
+            from .native import load
+
+            lib = load()
+            if lib is not None:
+                c = ctypes
+                i64p = c.POINTER(c.c_int64)
+                u8p = c.POINTER(c.c_uint8)
+                lib.iotml_msgset_encode.restype = c.c_int64
+                lib.iotml_msgset_encode.argtypes = [
+                    c.c_char_p, i64p, c.c_char_p, i64p, u8p, i64p, i64p,
+                    c.c_int64, u8p, c.c_int64]
+                lib.iotml_msgset_decode.restype = c.c_int64
+                lib.iotml_msgset_decode.argtypes = [
+                    c.c_char_p, c.c_int64, c.c_int64, i64p, i64p, i64p,
+                    u8p, u8p, c.c_int64, i64p, u8p, u8p, c.c_int64]
+                _NATIVE_LIB = lib
+        except Exception:
+            _NATIVE_LIB = None
+    return _NATIVE_LIB
+
+
+def _encode_message_set_py(entries) -> bytes:
     out = _Writer()
     for offset, key, value, ts in entries:
         body = _Writer()
@@ -148,11 +184,67 @@ def encode_message_set(entries: List[Tuple[int, Optional[bytes],
     return bytes(out.buf)
 
 
-def decode_message_set(buf: bytes) -> List[Tuple[int, Optional[bytes],
-                                                 Optional[bytes], int]]:
-    """MessageSet v1 bytes → [(offset, key, value, timestamp_ms)].  A
-    truncated trailing entry (Kafka allows partial final messages in fetch
-    responses) is dropped."""
+def columnar_kvt(kvt_entries):
+    """[(key, value, ts)] → (values, voff, keys, koff, knull, ts) arrays —
+    the columnar layout both native produce paths (the C++ client's
+    produce_many and the server-side msgset encoder) hand to the C ABI.
+    keys/koff/knull are None when every key is None (callers pass NULL
+    pointers, the all-unkeyed fast case)."""
+    import numpy as np
+
+    n = len(kvt_entries)
+    values = b"".join(v for _, v, _ in kvt_entries)
+    voff = np.zeros((n + 1,), np.int64)
+    np.cumsum([len(v) for _, v, _ in kvt_entries], out=voff[1:])
+    ts = np.asarray([t for _, _, t in kvt_entries], np.int64)
+    if not any(k is not None for k, _, _ in kvt_entries):
+        return values, voff, None, None, None, ts
+    keys = b"".join(k or b"" for k, _, _ in kvt_entries)
+    koff = np.zeros((n + 1,), np.int64)
+    np.cumsum([len(k or b"") for k, _, _ in kvt_entries], out=koff[1:])
+    knull = np.asarray([1 if k is None else 0 for k, _, _ in kvt_entries],
+                       np.uint8)
+    return values, voff, keys, koff, knull, ts
+
+
+def encode_message_set(entries: List[Tuple[int, Optional[bytes],
+                                           Optional[bytes], int]]) -> bytes:
+    """entries: [(offset, key, value, timestamp_ms)] → MessageSet v1 bytes."""
+    lib = _native_lib()
+    # a null VALUE has no native representation on the encode side (the
+    # server never stores them); fall back for exactness
+    if lib is None or not entries or \
+            any(v is None for _, _, v, _ in entries):
+        return _encode_message_set_py(entries)
+    import ctypes
+
+    import numpy as np
+
+    n = len(entries)
+    values, voff, keys, koff, knull, ts = columnar_kvt(
+        [(k, v, t) for _, k, v, t in entries])
+    offs = np.asarray([o for o, _, _, _ in entries], np.int64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    if keys is None:
+        kargs = (None, None, None)
+        keys_len = 0
+    else:
+        kargs = (ctypes.c_char_p(keys), koff.ctypes.data_as(i64p),
+                 knull.ctypes.data_as(u8p))
+        keys_len = len(keys)
+    cap = len(values) + keys_len + 40 * n
+    out = ctypes.create_string_buffer(cap)
+    rc = lib.iotml_msgset_encode(
+        ctypes.c_char_p(values), voff.ctypes.data_as(i64p), *kargs,
+        ts.ctypes.data_as(i64p), offs.ctypes.data_as(i64p), n,
+        ctypes.cast(out, u8p), cap)
+    if rc < 0:
+        return _encode_message_set_py(entries)
+    return out.raw[:rc]
+
+
+def _decode_message_set_py(buf: bytes):
     out = []
     r = _Reader(buf)
     while r.pos + 12 <= len(buf):
@@ -172,6 +264,47 @@ def decode_message_set(buf: bytes) -> List[Tuple[int, Optional[bytes],
         r.pos = end
         out.append((offset, key, value, ts))
     return out
+
+
+def decode_message_set(buf: bytes) -> List[Tuple[int, Optional[bytes],
+                                                 Optional[bytes], int]]:
+    """MessageSet v1 bytes → [(offset, key, value, timestamp_ms)].  A
+    truncated trailing entry (Kafka allows partial final messages in fetch
+    responses) is dropped."""
+    lib = _native_lib()
+    if lib is None or len(buf) < 26:
+        return _decode_message_set_py(buf)
+    import ctypes
+
+    import numpy as np
+
+    max_n = len(buf) // 26 + 1  # 26 = min bytes per v1 record
+    offs = np.zeros((max_n,), np.int64)
+    ts = np.zeros((max_n,), np.int64)
+    koff = np.zeros((max_n + 1,), np.int64)
+    knull = np.zeros((max_n,), np.uint8)
+    voff = np.zeros((max_n + 1,), np.int64)
+    vnull = np.zeros((max_n,), np.uint8)
+    keys = ctypes.create_string_buffer(max(len(buf), 1))
+    values = ctypes.create_string_buffer(max(len(buf), 1))
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    rc = lib.iotml_msgset_decode(
+        ctypes.c_char_p(buf), len(buf), max_n,
+        offs.ctypes.data_as(i64p), ts.ctypes.data_as(i64p),
+        koff.ctypes.data_as(i64p), knull.ctypes.data_as(u8p),
+        ctypes.cast(keys, u8p), len(buf),
+        voff.ctypes.data_as(i64p), vnull.ctypes.data_as(u8p),
+        ctypes.cast(values, u8p), len(buf))
+    if rc < 0:
+        # CRC/framing errors fall back so the Python decoder raises its
+        # exact error text (the wire contract tests pin it)
+        return _decode_message_set_py(buf)
+    kraw = keys.raw
+    vraw = values.raw
+    return [(int(offs[i]), None if knull[i] else kraw[koff[i]:koff[i + 1]],
+             None if vnull[i] else vraw[voff[i]:voff[i + 1]], int(ts[i]))
+            for i in range(rc)]
 
 
 def _req_header(api_key: int, api_version: int, corr: int,
@@ -789,9 +922,13 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                         presp.append((pid, ERR_UNKNOWN_TOPIC, -1))
                         continue
                     base = broker.end_offset(tname, pid)
-                    for _, key, value, ts in entries:
-                        broker.produce(tname, value or b"", key=key,
-                                       partition=pid, timestamp_ms=ts)
+                    # bulk append under one broker lock — the per-message
+                    # produce loop was a per-record cost in the server's
+                    # hottest handler
+                    broker.produce_many(
+                        tname, [(key, value or b"", ts)
+                                for _, key, value, ts in entries],
+                        partition=pid)
                     presp.append((pid, ERR_NONE, base))
                 resp.append((tname, presp))
             w.array(resp, lambda wr, t: (wr.string(t[0]), wr.array(

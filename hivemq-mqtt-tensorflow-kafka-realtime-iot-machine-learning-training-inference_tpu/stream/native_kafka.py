@@ -183,20 +183,18 @@ class NativeKafkaBroker(ProducePartitionMixin):
                 by_part.setdefault(p, []).append((key, value, ts))
             last = -1
             for p, ents in sorted(by_part.items()):
-                values = b"".join(v for _, v, _ in ents)
-                voff = np.zeros((len(ents) + 1,), np.int64)
-                np.cumsum([len(v) for _, v, _ in ents], out=voff[1:])
-                if any(k is not None for k, _, _ in ents):
-                    keys = b"".join(k or b"" for k, _, _ in ents)
-                    koff = np.zeros((len(ents) + 1,), np.int64)
-                    np.cumsum([len(k or b"") for k, _, _ in ents], out=koff[1:])
-                    knull = np.asarray([1 if k is None else 0
-                                        for k, _, _ in ents], np.uint8)
-                    kargs = (ctypes.c_char_p(keys), koff.ctypes.data_as(_i64p),
-                             knull.ctypes.data_as(_u8p))
-                else:
+                # shared columnar layout (kafka_wire.columnar_kvt): one
+                # definition of the (values, offsets, key-null) C ABI for
+                # both native produce paths
+                from .kafka_wire import columnar_kvt
+
+                values, voff, keys, koff, knull, ts = columnar_kvt(ents)
+                if keys is None:
                     kargs = (None, None, None)
-                ts = np.asarray([t for _, _, t in ents], np.int64)
+                else:
+                    kargs = (ctypes.c_char_p(keys),
+                             koff.ctypes.data_as(_i64p),
+                             knull.ctypes.data_as(_u8p))
                 base = _check(self._lib.iotml_kafka_produce(
                     self._h, topic.encode(), p, ctypes.c_char_p(values),
                     voff.ctypes.data_as(_i64p), *kargs,

@@ -51,8 +51,17 @@ class OutputSequence:
             if hi - lo + 1 != len(idxs):
                 missing = set(range(lo, hi + 1)) - set(idxs)
                 raise ValueError(f"output sequence has gaps at {sorted(missing)[:8]}...")
-        for i in idxs:
-            self.broker.produce(self.topic, self._buf[i], partition=self.partition)
+        produce_many = getattr(self.broker, "produce_many", None)
+        if produce_many is not None:
+            # one batched call: over the Kafka wire a per-message produce
+            # is a round trip each — a drain's flush would cost thousands
+            # of them.  Order within the batch is preserved by contract.
+            produce_many(self.topic, [(None, self._buf[i], 0) for i in idxs],
+                         partition=self.partition)
+        else:
+            for i in idxs:
+                self.broker.produce(self.topic, self._buf[i],
+                                    partition=self.partition)
         n = len(idxs)
         self._buf.clear()
         return n

@@ -14,6 +14,7 @@ from __future__ import annotations
 import os
 import shutil
 import tempfile
+from typing import Optional
 
 
 class ArtifactStore:
@@ -55,6 +56,38 @@ class ArtifactStore:
         if self._gcs:
             return self._bucket.blob(os.path.join(self._prefix, name)).exists()
         return os.path.exists(os.path.join(self.root, name))
+
+    # --------------------------------------------------- pointer blobs
+    # The continuous train→serve handoff (train.live / serve.live) flips a
+    # tiny "latest" pointer after each immutable versioned model upload —
+    # the reference's predict pods re-download a fixed GCS name on restart
+    # (cardata-v3.py:255-261); a long-lived scorer instead polls the
+    # pointer and hot-swaps.  Text writes must be atomic so a reader never
+    # sees a half-copied name.
+    def put_text(self, name: str, text: str) -> None:
+        if self._gcs:
+            blob = self._bucket.blob(os.path.join(self._prefix, name))
+            blob.upload_from_string(text)  # GCS object writes are atomic
+            return
+        dst = os.path.join(self.root, name)
+        os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+        tmp = dst + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            fh.write(text)
+        os.replace(tmp, dst)  # atomic within a filesystem
+
+    def get_text(self, name: str) -> Optional[str]:
+        """Pointer read; None while the pointer does not exist yet."""
+        if self._gcs:
+            blob = self._bucket.blob(os.path.join(self._prefix, name))
+            if not blob.exists():
+                return None
+            return blob.download_as_bytes().decode()
+        try:
+            with open(os.path.join(self.root, name)) as fh:
+                return fh.read()
+        except FileNotFoundError:
+            return None
 
     # ------------------------------------------------- checkpoint trees
     def upload_tree(self, local_dir: str, name: str) -> str:

@@ -41,13 +41,23 @@ class TrainState:
 
     @classmethod
     def create(cls, model, rng, sample_x, tx: Optional[optax.GradientTransformation] = None,
-               learning_rate: float = 1e-3):
+               learning_rate: float = 1e-3, tx_key=None):
         """Init params from a sample batch. lr 1e-3 = Keras Adam default
-        (what `optimizer='adam'` means in the reference)."""
+        (what `optimizer='adam'` means in the reference).
+
+        Params AND optimizer state init under ONE jit (cached per
+        (model, optimizer)): flax's eager init executes the full forward
+        op-by-op and optax's init is an eager zeros-op per param leaf —
+        over a TPU tunnel each eager op is a network round trip, which
+        made a fresh recurrent Trainer cost seconds before training at
+        all.  `tx_key` is the hashable cache descriptor when the caller
+        built the optimizer itself (a fresh optax object per Trainer
+        would otherwise defeat the cache by identity)."""
         tx = tx or optax.adam(learning_rate)
-        params = model.init(rng, jnp.asarray(sample_x))["params"]
+        init = jitted_state_init(model, tx, tx_key=tx_key)
+        params, opt_state = init(rng, jnp.asarray(sample_x))
         return cls(step=jnp.zeros((), jnp.int32), params=params,
-                   opt_state=tx.init(params), apply_fn=model.apply, tx=tx)
+                   opt_state=opt_state, apply_fn=model.apply, tx=tx)
 
 
 def _masked_mse(pred, target, mask):
@@ -138,6 +148,7 @@ def make_scanned_fit(model, tx, supervised: bool = False):
 _CACHE_LIMIT = 8
 _SCANNED_CACHE: OrderedDict = OrderedDict()
 _EVAL_CACHE: OrderedDict = OrderedDict()
+_INIT_CACHE: OrderedDict = OrderedDict()
 
 
 def _lru_get(cache, key, make):
@@ -149,6 +160,34 @@ def _lru_get(cache, key, make):
     else:
         cache.move_to_end(key)
     return fn
+
+
+def adam_cached(learning_rate: float) -> optax.GradientTransformation:
+    """One optax.adam object per learning rate.
+
+    `TrainState.tx` is a static (non-pytree) field, and a fresh
+    `optax.adam(lr)` builds fresh init/update closures that compare
+    UNEQUAL to the last one — so every fresh Trainer used to retrace and
+    recompile the scanned fit (~4 s on a TPU tunnel) even though the
+    program was identical.  Sharing the object makes the static field
+    compare equal and the compile cache hit."""
+    return _lru_get(_INIT_CACHE, ("adam-tx", learning_rate),
+                    lambda: optax.adam(learning_rate))
+
+
+def jitted_state_init(model, tx, tx_key=None):
+    """jit-compiled (params, opt_state) init, cached per (model, tx)."""
+    key = (model, tx_key if tx_key is not None else id(tx))
+
+    def make():
+        @jax.jit
+        def init(rng, x):
+            params = model.init(rng, x)["params"]
+            return params, tx.init(params)
+
+        return init
+
+    return _lru_get(_INIT_CACHE, key, make)
 
 
 def scanned_fit_cached(model, tx, supervised: bool, tx_key=None):
@@ -184,14 +223,15 @@ class Trainer:
         # optimizer ourselves (a user-supplied tx is keyed by identity)
         self._tx_key = ("adam", learning_rate) if tx is None else None
         self.learning_rate = learning_rate
-        self.tx = tx or optax.adam(learning_rate)
+        self.tx = tx or adam_cached(learning_rate)
         self.supervised = supervised
         self.state: Optional[TrainState] = None
         self._step = None
 
     def _ensure_state(self, sample_x):
         if self.state is None:
-            self.state = TrainState.create(self.model, self.rng, sample_x, tx=self.tx)
+            self.state = TrainState.create(self.model, self.rng, sample_x,
+                                           tx=self.tx, tx_key=self._tx_key)
             self._step = make_train_step(self.model, self.tx, self.supervised)
 
     def fit(self, batches, epochs: int = 1, verbose: bool = False,
