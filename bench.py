@@ -1015,6 +1015,10 @@ def bench_e2e_platform():
     gen = FleetGenerator(FleetScenario(num_cars=n_conns,
                                        failure_rate=failure_rate, seed=11))
     n_failing = int((gen.failing >= 0).sum())
+    failing_keys = {f"vehicles/sensor/data/electric-vehicle-{i:05d}"
+                    for i, m in enumerate(gen.failing) if m >= 0}
+    strong_keys = {f"vehicles/sensor/data/electric-vehicle-{i:05d}"
+                   for i, m in enumerate(gen.failing) if m == 1}
     tick_payloads = []  # [tick][conn] -> json bytes
     for _ in range(24):
         cols = gen.step_columns()
@@ -1299,6 +1303,10 @@ def bench_e2e_platform():
             [sys.executable, "-m", "iotml.cli.live", "score", addr,
              "SENSOR_DATA_S_AVRO", "model-predictions", artifact_root,
              "--threshold", str(threshold), "--group", "scorer-e2e",
+             # live-trained models carry a higher noise floor than the
+             # offline envelope's 0.38 (1 epoch/round continuous): the
+             # car-alert bar sits above the live healthy band
+             "--car-threshold", "0.45",
              "--stats", "--max-seconds", "600",
              # the first artifact waits on the train child's TPU compile
              # (~30-60 s over the tunnel) + the first round's data: match
@@ -1348,6 +1356,17 @@ def bench_e2e_platform():
         headline_rate_actual = rate_state["rate"]
         sweep_points = []
         for r in sweep:
+            # drain the previous window's backlog at the warmup rate so
+            # each sweep point is an independent measurement (a 20k point
+            # starting on a 16k window's backlog would measure backlog
+            # drain, not the paced rate)
+            rate_state["rate"] = warmup_rate
+            rate_state["ver"] += 1
+            drain_deadline = time.time() + 60
+            while time.time() < drain_deadline and \
+                    sum(sent_counts) - predictions_total() > \
+                    4 * warmup_rate:
+                time.sleep(0.1)
             rate_state["rate"] = r
             rate_state["ver"] += 1
             time.sleep(2.0)  # settle: markers from the old rate resolve
@@ -1448,6 +1467,19 @@ def bench_e2e_platform():
             definition="live per-record verdicts (written to the "
                        "predictions topic) vs injected labels; value=AUC "
                        "from live error histograms")
+        # car-LEVEL detection: which injected failing cars the live
+        # CarHealthDetector named (serve/carhealth.py; strong modes are
+        # the documented detection envelope, precision must be 1.0)
+        ch = cum_at(drain_stats, headline["wall1"], "carhealth", None)
+        if ch is not None:
+            alerted = set(ch.get("cars_alerted", []))
+            out["_quality"].update(
+                cars_alerted=sorted(alerted),
+                car_threshold=ch.get("threshold"),
+                car_true_alerts=len(alerted & failing_keys),
+                car_false_alerts=len(alerted - failing_keys),
+                strong_mode_cars=len(strong_keys),
+                strong_mode_detected=len(alerted & strong_keys))
     if sweep_points:
         out["_sweep"] = dict(value=float(len(sweep_points)),
                              points=sweep_points,

@@ -79,6 +79,10 @@ def main(argv=None) -> int:
     sc.add_argument("--model-name", default="cardata-live.h5")
     sc.add_argument("--group", default="cardata-live-score")
     sc.add_argument("--threshold", type=float, default=5.0)
+    sc.add_argument("--car-threshold", default="0.38",
+                    help="per-car EMA alert level, or 'auto' "
+                         "(fleet-quantile calibration; needs a stable "
+                         "model)")
     sc.add_argument("--batch-size", type=int, default=100)
     sc.add_argument("--wait-model-seconds", type=float, default=120.0)
 
@@ -137,9 +141,12 @@ def main(argv=None) -> int:
     else:
         from ..serve.live import LiveScorer
 
+        car_th = args.car_threshold if args.car_threshold == "auto" \
+            else float(args.car_threshold)
         svc = LiveScorer(broker, args.topic, args.result_topic, store,
                          model_name=args.model_name, group=args.group,
                          threshold=args.threshold,
+                         car_threshold=car_th,
                          batch_size=args.batch_size)
         artifact = svc.wait_for_model(args.wait_model_seconds)
         print(f"live score: model {artifact} loaded; "

@@ -714,13 +714,18 @@ int64_t iotml_kafka_take(void* h, uint8_t* values, int64_t* val_offsets,
 // iotml_decode_batch).  *next_offset receives the cursor after the last
 // decoded message.  Returns rows decoded (0 = clean EOF/empty poll), or a
 // negative error (decode failures surface as -(row + 1) - 2000).
-int64_t iotml_kafka_fetch_decode(void* h, const char* topic,
-                                 int32_t partition, int64_t offset,
-                                 const int8_t* types, const uint8_t* nullable,
-                                 int64_t n_fields, int64_t strip,
-                                 double* out_numeric, char* out_labels,
-                                 int64_t label_stride, int64_t max_rows,
-                                 int64_t* next_offset) {
+// fetch_decode, optionally with per-message KEYS: when out_keys is
+// non-null, each message's key is copied alongside the decode
+// (key_stride bytes per row, zero-padded, truncated at stride-1).  The
+// key is the record's routing identity (the MQTT topic → car id through
+// the bridge/KSQL legs), which per-entity consumers (car-health
+// detection) need alongside the decoded features.
+int64_t iotml_kafka_fetch_decode_keys(
+    void* h, const char* topic, int32_t partition, int64_t offset,
+    const int8_t* types, const uint8_t* nullable, int64_t n_fields,
+    int64_t strip, double* out_numeric, char* out_labels,
+    int64_t label_stride, char* out_keys, int64_t key_stride,
+    int64_t max_rows, int64_t* next_offset) {
   Client* c = static_cast<Client*>(h);
   int64_t n = iotml_kafka_fetch(h, topic, partition, offset, max_rows);
   if (n <= 0) {
@@ -738,6 +743,15 @@ int64_t iotml_kafka_fetch_decode(void* h, const char* topic,
     memcpy(blob.data() + pos, c->staged[i].value.data(),
            c->staged[i].value.size());
     pos += (int64_t)c->staged[i].value.size();
+    if (out_keys) {
+      char* krow = out_keys + i * key_stride;
+      memset(krow, 0, key_stride);
+      if (!c->staged[i].key_null) {
+        int64_t kn = (int64_t)c->staged[i].key.size();
+        if (kn > key_stride - 1) kn = key_stride - 1;
+        memcpy(krow, c->staged[i].key.data(), kn);
+      }
+    }
   }
   offs[n] = pos;
   int64_t rc = iotml_decode_batch(blob.data(), offs.data(), n, types,
@@ -747,6 +761,21 @@ int64_t iotml_kafka_fetch_decode(void* h, const char* topic,
   *next_offset = c->staged[n - 1].offset + 1;
   c->staged.clear();
   return rc;
+}
+
+// Keyless form: one implementation, keys skipped.
+int64_t iotml_kafka_fetch_decode(void* h, const char* topic,
+                                 int32_t partition, int64_t offset,
+                                 const int8_t* types, const uint8_t* nullable,
+                                 int64_t n_fields, int64_t strip,
+                                 double* out_numeric, char* out_labels,
+                                 int64_t label_stride, int64_t max_rows,
+                                 int64_t* next_offset) {
+  return iotml_kafka_fetch_decode_keys(h, topic, partition, offset, types,
+                                       nullable, n_fields, strip,
+                                       out_numeric, out_labels,
+                                       label_stride, nullptr, 0, max_rows,
+                                       next_offset);
 }
 
 // OffsetCommit v2, simple-consumer style (generation -1, empty member).

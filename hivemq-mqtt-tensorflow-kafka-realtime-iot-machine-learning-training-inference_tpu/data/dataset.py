@@ -46,6 +46,7 @@ class Batch:
     first_index: int
     labels: Optional[np.ndarray] = None  # object array of strings, if kept
     y: Optional[np.ndarray] = None  # supervised target (windowed/LSTM path)
+    keys: Optional[np.ndarray] = None  # [B] S-bytes message keys, if kept
 
     @property
     def mask(self) -> np.ndarray:
@@ -81,6 +82,7 @@ class SensorBatches:
                  window: Optional[int] = None,
                  pad_tail: bool = True,
                  keep_labels: bool = False,
+                 keep_keys: bool = False,
                  poll_chunk: int = 4096,
                  cache: bool = False):
         self.consumer = consumer
@@ -94,6 +96,12 @@ class SensorBatches:
         self.window = window
         self.pad_tail = pad_tail
         self.keep_labels = keep_labels
+        # keep_keys threads each record's MESSAGE KEY (the car's routing
+        # identity: MQTT topic → bridge key → KSQL pass-through) into
+        # Batch.keys — what per-entity consumers (car-health detection)
+        # join on.  Batched path only; the windowed path has no per-row
+        # key semantics (a window spans records).
+        self.keep_keys = keep_keys
         self.poll_chunk = poll_chunk
         # cache=True decodes the stream once and replays batches from host
         # memory on later epochs.  The reference re-reads Kafka every epoch
@@ -134,12 +142,12 @@ class SensorBatches:
                 if self._label_col is not None
                 else np.full((n,), "", object))
 
-    def _emit_chunk(self, num: np.ndarray, labels) -> tuple:
+    def _emit_chunk(self, num: np.ndarray, labels, keys=None) -> tuple:
         """Shared tail of every decode path: normalize + account."""
         xs = self.normalizer.np(num)
         self.records_seen += len(xs)
         obs_metrics.records_consumed.inc(len(xs))
-        return xs, np.asarray(labels)
+        return xs, np.asarray(labels), keys
 
     def _poll_limit(self) -> int:
         """Per-poll fetch cap: the configured chunk, bounded by what the
@@ -149,24 +157,36 @@ class SensorBatches:
         return max(1, min(self.poll_chunk, self._need_rows))
 
     def _decoded_chunks(self):
-        """Yield (xs [n, F] float32 normalized, labels [n] str) per poll."""
+        """Yield (xs [n, F] float32 normalized, labels [n] str,
+        keys [n] bytes | None) per poll."""
         label_f = self.schema.label_field
+        fused_attr = "fetch_decode_keys" if self.keep_keys \
+            else "fetch_decode"
         if self._native is not None and \
-                getattr(self.consumer.broker, "fetch_decode", None) is not None:
+                getattr(self.consumer.broker, fused_attr, None) is not None:
             # Fully-native path: broker-side fetch + framing strip + Avro
             # decode in one C++ call (NativeKafkaBroker.fetch_decode) — no
             # per-message Python objects.
             while True:
-                num, lab = self.consumer.poll_decoded(
-                    self._native, strip=5, max_messages=self._poll_limit())
+                res = self.consumer.poll_decoded(
+                    self._native, strip=5, max_messages=self._poll_limit(),
+                    with_keys=self.keep_keys)
+                num, lab = res[0], res[1]
                 if len(num) == 0:
                     return
-                yield self._emit_chunk(num, self._native_labels(lab, len(num)))
+                yield self._emit_chunk(num,
+                                       self._native_labels(lab, len(num)),
+                                       res[2] if self.keep_keys else None)
         while True:
             msgs = self.consumer.poll(self._poll_limit())
             if not msgs:
                 return
             n = len(msgs)
+            keys = None
+            if self.keep_keys:
+                # [:63]: match the native path's stride-1 truncation
+                keys = np.asarray([(m.key or b"")[:63] for m in msgs],
+                                  dtype="S64")
             if self._native is not None:
                 num, lab = self._native.decode_batch(
                     [m.value for m in msgs], strip=5)
@@ -177,18 +197,20 @@ class SensorBatches:
                 num = self.codec.sensor_matrix(cols)  # [n, F] float64
                 labels = cols[label_f] if label_f \
                     else np.full((n,), "", object)
-            yield self._emit_chunk(num, labels)
+            yield self._emit_chunk(num, labels, keys)
 
     def _filtered_chunks(self):
-        for xs, labels in self._decoded_chunks():
+        for xs, labels, keys in self._decoded_chunks():
             if self.only_normal:
                 keep = labels == "false"
                 xs, labels = xs[keep], labels[keep]
+                if keys is not None:
+                    keys = keys[keep]
             if len(xs):
-                yield xs, labels
+                yield xs, labels, keys
 
     def _filtered_rows(self):
-        for xs, labels in self._filtered_chunks():
+        for xs, labels, _keys in self._filtered_chunks():
             for i in range(len(xs)):
                 yield xs[i], labels[i]
 
@@ -197,7 +219,7 @@ class SensorBatches:
             yield from self._windowed_iter()
             return
         B = self.batch_size
-        parts: list = []  # pending (xs, labels) chunks
+        parts: list = []  # pending (xs, labels, keys) chunks
         have = 0
         emitted = 0
         # index counts post-skip rows only, matching the reference's
@@ -207,13 +229,18 @@ class SensorBatches:
 
         def assemble():
             nonlocal parts, have
-            xs = np.concatenate([p[0] for p in parts]) if len(parts) > 1 else parts[0][0]
-            labels = np.concatenate([p[1] for p in parts]) if len(parts) > 1 else parts[0][1]
+            if len(parts) > 1:
+                xs = np.concatenate([p[0] for p in parts])
+                labels = np.concatenate([p[1] for p in parts])
+                keys = np.concatenate([p[2] for p in parts]) \
+                    if parts[0][2] is not None else None
+            else:
+                xs, labels, keys = parts[0]
             parts = []
             have = 0
-            return xs, labels
+            return xs, labels, keys
 
-        def emit(xs, labels, lo):
+        def emit(xs, labels, keys, lo):
             n_valid = min(B, len(xs) - lo)
             x = xs[lo:lo + n_valid].astype(np.float32, copy=True)
             if n_valid < B:
@@ -224,7 +251,12 @@ class SensorBatches:
                 lab = np.empty((B,), object)
                 lab[:n_valid] = labels[lo:lo + n_valid]
                 lab[n_valid:] = ""
-            return Batch(x, n_valid, 0, lab)  # first_index patched by caller
+            ks = None
+            if keys is not None:
+                ks = np.zeros((B,), keys.dtype)
+                ks[:n_valid] = keys[lo:lo + n_valid]
+            return Batch(x, n_valid, 0, lab,
+                         keys=ks)  # first_index patched by caller
 
         chunks = self._filtered_chunks()
         try:
@@ -244,13 +276,13 @@ class SensorBatches:
                 have += len(chunk[0])
                 if have < B:
                     continue
-                xs, labels = assemble()
+                xs, labels, keys = assemble()
                 lo = 0
                 while len(xs) - lo >= B:
                     if self._skipped < self.skip:
                         self._skipped += 1
                     else:
-                        b = emit(xs, labels, lo)
+                        b = emit(xs, labels, keys, lo)
                         b.first_index = index
                         yield b
                         emitted += 1
@@ -259,12 +291,13 @@ class SensorBatches:
                             return
                     lo += B
                 if lo < len(xs):
-                    parts = [(xs[lo:], labels[lo:])]
+                    parts = [(xs[lo:], labels[lo:],
+                              keys[lo:] if keys is not None else None)]
                     have = len(xs) - lo
             if have and self.pad_tail and self._skipped >= self.skip and \
                     (not self.take or emitted < self.take):
-                xs, labels = assemble()
-                b = emit(xs, labels, 0)
+                xs, labels, keys = assemble()
+                b = emit(xs, labels, keys, 0)
                 b.first_index = index
                 yield b
         finally:
@@ -315,7 +348,7 @@ class SensorBatches:
                     self._need_rows = needed * B - have + max(T - covered,
                                                               0)
                 try:
-                    xs, _labels = next(chunks)
+                    xs, _labels, _keys = next(chunks)
                 except StopIteration:
                     break
                 buf = xs.astype(np.float32, copy=False)

@@ -38,6 +38,12 @@ class FleetScenario:
     interval_s: float = 5.0
     ramp_up_s: float = 5.0
     failure_rate: float = 0.01  # fraction of cars that develop a failure
+    #: (min_tick, max_tick): failing cars develop their failure at a
+    #: uniform-random tick in this range instead of from birth — the
+    #: realistic predictive-maintenance shape (a healthy car drifts into
+    #: a fault), and what per-car baseline/drift detection needs.  None
+    #: keeps the from-birth behavior.
+    failure_onset_ticks: Optional[tuple] = None
     seed: int = 7
 
     @classmethod
@@ -67,6 +73,13 @@ class FleetGenerator:
         self.failing = np.full(n, -1, np.int32)
         fail_cars = rng.random(n) < scenario.failure_rate
         self.failing[fail_cars] = rng.integers(0, 3, fail_cars.sum())
+        # onset tick per failing car (0 = from birth)
+        self.onset = np.zeros(n, np.int64)
+        if scenario.failure_onset_ticks is not None:
+            lo, hi = scenario.failure_onset_ticks
+            self.onset[fail_cars] = rng.integers(lo, hi + 1,
+                                                 fail_cars.sum())
+        self.tick = 0
         self.t = 0.0
 
     # ----------------------------------------------------------- columns
@@ -93,8 +106,10 @@ class FleetGenerator:
         tires = self.tire_base[idx] + rng.normal(0, 0.5, (n, 4))
         accel = np.abs(rng.normal(0.5, 0.8, (n, 4)))
 
-        # failure modes perturb the physics and set the label
-        failing = self.failing[idx]
+        # failure modes perturb the physics and set the label — only once
+        # a car's onset tick has passed (default: from birth)
+        failing = np.where(self.onset[idx] <= self.tick,
+                           self.failing[idx], -1)
         lab = failing >= 0
         m0 = failing == 0  # engine failure: vibration spike
         vibration[m0] *= rng.uniform(2.0, 4.0, m0.sum())
@@ -127,6 +142,7 @@ class FleetGenerator:
             "failure_occurred": np.where(lab, "true", "false"),
         }
         self.t += s.interval_s
+        self.tick += 1
         return cols
 
     def sensor_matrix(self, cols: dict) -> np.ndarray:

@@ -37,7 +37,9 @@ class LiveScorer:
                  store: ArtifactStore, model_name: str = "cardata-live.h5",
                  model=None, threshold: Optional[float] = 5.0,
                  group: str = "cardata-live-score", batch_size: int = 100,
-                 out_partition: Optional[int] = 0):
+                 out_partition: Optional[int] = 0,
+                 carhealth_topic: Optional[str] = "car-health",
+                 car_threshold=0.38):
         if model is None:
             from ..models.autoencoder import CAR_AUTOENCODER
 
@@ -49,13 +51,22 @@ class LiveScorer:
         parts = range(broker.topic(topic).partitions)
         consumer = StreamConsumer.from_committed(broker, topic, parts,
                                                  group=group, eof=False)
+        carhealth = None
+        if carhealth_topic is not None:
+            from .carhealth import CarHealthDetector
+
+            carhealth = CarHealthDetector(threshold=car_threshold)
+            broker.create_topic(carhealth_topic)
         batches = SensorBatches(consumer, batch_size=batch_size,
-                                keep_labels=True)
+                                keep_labels=True,
+                                keep_keys=carhealth is not None)
         out = OutputSequence(broker, result_topic, partition=out_partition)
         # params are loaded by wait_for_model(); scoring before that would
         # write garbage predictions from random init
         self.scorer = StreamScorer(model, None, batches, out,
-                                   threshold=threshold)
+                                   threshold=threshold,
+                                   carhealth=carhealth,
+                                   carhealth_topic=carhealth_topic)
         self._current_artifact: Optional[str] = None
         self.model_updates = 0
 
@@ -144,4 +155,6 @@ class LiveScorer:
             "artifact": self._current_artifact,
             "positions": {f"{p}": off for _, p, off
                           in self.scorer.batches.consumer.positions()},
+            "carhealth": (self.scorer.carhealth.summary()
+                          if self.scorer.carhealth is not None else None),
         }

@@ -88,12 +88,19 @@ class StreamScorer:
     max_super_batches = 128
 
     def __init__(self, model, params, batches: SensorBatches,
-                 out: OutputSequence, threshold: Optional[float] = None):
+                 out: OutputSequence, threshold: Optional[float] = None,
+                 carhealth=None, carhealth_topic: Optional[str] = None):
         self.model = model
         self.params = params
         self.batches = batches
         self.out = out
         self.threshold = threshold
+        #: optional per-car detector (serve.carhealth.CarHealthDetector):
+        #: fed each scored batch's (keys, per-row errors) when the batch
+        #: source keeps keys; alert transitions publish to
+        #: `carhealth_topic` on the output broker (the digital-twin feed)
+        self.carhealth = carhealth
+        self.carhealth_topic = carhealth_topic
         self._eval = make_eval_step(model)
         self.scored = 0
         #: suspended (iterator, index_base) of a max_rows-truncated drain
@@ -217,6 +224,13 @@ class StreamScorer:
                     if np.any(sel):
                         self.err_hist[lab] += np.bincount(
                             buckets[sel], minlength=len(ERR_BUCKETS) + 1)
+            if self.carhealth is not None and b.keys is not None \
+                    and b.n_valid:
+                trans = self.carhealth.update(b.keys[: b.n_valid],
+                                              err[: b.n_valid])
+                if trans and self.carhealth_topic is not None:
+                    self.carhealth.publish_transitions(
+                        self.out.broker, self.carhealth_topic, trans)
             for i in range(b.n_valid):
                 idx = base + b.first_index + i
                 msg = msgs[mi]

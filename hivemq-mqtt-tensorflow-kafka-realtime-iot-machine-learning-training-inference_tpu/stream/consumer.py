@@ -95,16 +95,21 @@ class StreamConsumer:
                 attempts = 0  # progress was made; give others another chance
         return out
 
-    def poll_decoded(self, codec, strip: int = 5, max_messages: int = 4096):
+    def poll_decoded(self, codec, strip: int = 5, max_messages: int = 4096,
+                     with_keys: bool = False):
         """Fused native poll: fetch + framing strip + Avro decode in one
         C++ call per partition (broker `fetch_decode`, the KafkaDataset-
         equivalent hot path).  Returns (numeric [n, F] float64, labels
-        [n, S] bytes) or None when this broker has no native decode path;
+        [n, S] bytes) — with `with_keys`, (numeric, labels, keys [n]
+        bytes) — or None when this broker has no native decode path (for
+        with_keys that includes brokers without `fetch_decode_keys`);
         n == 0 signals the same end-of-poll as an empty `poll()`."""
-        fd = getattr(self.broker, "fetch_decode", None)
+        fd = getattr(self.broker,
+                     "fetch_decode_keys" if with_keys else "fetch_decode",
+                     None)
         if fd is None:
             return None
-        nums, labs = [], []
+        nums, labs, keys = [], [], []
         got = 0
         n = len(self._cursors)
         attempts = 0
@@ -113,21 +118,26 @@ class StreamConsumer:
             self._rr += 1
             attempts += 1
             topic, part, off = cur
-            numeric, labels, next_off = fd(topic, part, off, codec,
-                                           strip=strip,
-                                           max_rows=max_messages - got)
+            res = fd(topic, part, off, codec, strip=strip,
+                     max_rows=max_messages - got)
+            numeric, labels = res[0], res[1]
+            next_off = res[-1]
             if len(numeric):
                 cur[2] = next_off
                 nums.append(numeric)
                 labs.append(labels)
+                if with_keys:
+                    keys.append(res[2])
                 got += len(numeric)
                 attempts = 0
         if not nums:
             from .native import LABEL_STRIDE
 
-            return (np.zeros((0, codec.n_numeric)),
-                    np.zeros((0, codec.n_strings), f"S{LABEL_STRIDE}"))
-        return np.concatenate(nums), np.concatenate(labs)
+            empty = (np.zeros((0, codec.n_numeric)),
+                     np.zeros((0, codec.n_strings), f"S{LABEL_STRIDE}"))
+            return empty + (np.zeros((0,), "S1"),) if with_keys else empty
+        out = (np.concatenate(nums), np.concatenate(labs))
+        return out + (np.concatenate(keys),) if with_keys else out
 
     def at_end(self) -> bool:
         return all(off >= self.broker.end_offset(t, p)

@@ -345,15 +345,20 @@ class GroupConsumer:
         self._ensure_membership()
         return self._sc.poll(max_messages)
 
-    def poll_decoded(self, codec, strip: int = 5, max_messages: int = 4096):
+    def poll_decoded(self, codec, strip: int = 5, max_messages: int = 4096,
+                     with_keys: bool = False):
         """StreamConsumer-compatible fused native poll over the *assigned*
         partitions (see consumer.StreamConsumer.poll_decoded); lets
         SensorBatches/StreamScorer run group-elastic without code changes."""
-        if getattr(self.broker, "fetch_decode", None) is None:
+        fd = getattr(self.broker,
+                     "fetch_decode_keys" if with_keys else "fetch_decode",
+                     None)
+        if fd is None:
             return None
         self._ensure_membership()
         return self._sc.poll_decoded(codec, strip=strip,
-                                     max_messages=max_messages)
+                                     max_messages=max_messages,
+                                     with_keys=with_keys)
 
     def at_end(self) -> bool:
         return self._sc.at_end()

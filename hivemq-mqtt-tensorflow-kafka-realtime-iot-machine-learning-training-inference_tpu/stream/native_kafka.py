@@ -77,6 +77,11 @@ def _sig(lib) -> None:
                          c.POINTER(c.c_int8), _u8p, c.c_int64, c.c_int64,
                          c.POINTER(c.c_double), c.c_char_p, c.c_int64,
                          c.c_int64, _i64p],
+        "fetch_decode_keys": [c.c_void_p, c.c_char_p, c.c_int32, c.c_int64,
+                              c.POINTER(c.c_int8), _u8p, c.c_int64,
+                              c.c_int64, c.POINTER(c.c_double), c.c_char_p,
+                              c.c_int64, c.c_char_p, c.c_int64, c.c_int64,
+                              _i64p],
         "commit": [c.c_void_p, c.c_char_p, c.c_char_p, c.c_int32, c.c_int64],
         "committed": [c.c_void_p, c.c_char_p, c.c_char_p, c.c_int32],
     }
@@ -264,6 +269,46 @@ class NativeKafkaBroker(ProducePartitionMixin):
                 raise KeyError(topic)
             n = _check(rc, f"fetch_decode({topic}:{partition}@{offset})")
             return (numeric[:n], labels[:n, : codec.n_strings],
+                    int(next_off.value))
+
+    #: bytes per row for message keys in fetch_decode_keys (MQTT-topic
+    #: keys like "vehicles/sensor/data/electric-vehicle-00042" fit with
+    #: room; longer keys truncate at stride-1, zero-padded)
+    KEY_STRIDE = 64
+
+    def fetch_decode_keys(self, topic: str, partition: int, offset: int,
+                          codec: NativeCodec, strip: int = 5,
+                          max_rows: int = 4096
+                          ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                     int]:
+        """`fetch_decode` + per-message keys: (numeric [n, F], labels
+        [n, S], keys [n] S{KEY_STRIDE} bytes, next_offset).  The key is
+        the record's routing identity (car id via the MQTT-topic key) —
+        what per-entity consumers (car-health detection) join on."""
+        with self._lock:
+            numeric = np.empty((max_rows, codec.n_numeric), np.float64)
+            labels = np.zeros((max_rows, max(codec.n_strings, 1)),
+                              f"S{LABEL_STRIDE}")
+            keys = np.zeros((max_rows,), f"S{self.KEY_STRIDE}")
+            next_off = ctypes.c_int64(offset)
+            rc = self._lib.iotml_kafka_fetch_decode_keys(
+                self._h, topic.encode(), partition, ctypes.c_int64(offset),
+                codec.types.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+                codec.nullable.ctypes.data_as(_u8p),
+                ctypes.c_int64(codec.n_fields), ctypes.c_int64(strip),
+                numeric.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                labels.ctypes.data_as(ctypes.c_char_p),
+                ctypes.c_int64(LABEL_STRIDE),
+                keys.ctypes.data_as(ctypes.c_char_p),
+                ctypes.c_int64(self.KEY_STRIDE),
+                ctypes.c_int64(max_rows), ctypes.byref(next_off))
+            if rc <= -2000:
+                raise ValueError(
+                    f"malformed Avro message at row {-(rc + 2000) - 1}")
+            if rc == -1003:
+                raise KeyError(topic)
+            n = _check(rc, f"fetch_decode_keys({topic}:{partition}@{offset})")
+            return (numeric[:n], labels[:n, : codec.n_strings], keys[:n],
                     int(next_off.value))
 
     # ------------------------------------------------------------- offsets

@@ -196,6 +196,47 @@ def test_group_elastic_sensorbatches_pipeline():
     assert len(c1.assignment) == 10
 
 
+def test_group_consumer_fused_native_path_over_wire():
+    """GroupConsumer + SensorBatches over a NATIVE wire broker must take
+    the fused fetch_decode branch (with and without keep_keys) — the
+    in-process Broker has no fetch_decode, so only a wire-backed test
+    exercises the kwarg pass-through the fused branch relies on."""
+    import numpy as np
+    import pytest
+
+    from iotml.data.dataset import SensorBatches
+    from iotml.gen.simulator import FleetGenerator, FleetScenario
+    from iotml.stream import native
+    from iotml.stream.kafka_wire import KafkaWireServer
+
+    if native.load() is None:
+        pytest.skip("native engine not built")
+    from iotml.stream.native_kafka import NativeKafkaBroker
+
+    b = Broker()
+    gen = FleetGenerator(FleetScenario(num_cars=50, failure_rate=0.0))
+    total = gen.publish(b, "SENSOR_DATA_S_AVRO", n_ticks=20, partitions=4)
+    with KafkaWireServer(b) as srv:
+        client = NativeKafkaBroker(f"127.0.0.1:{srv.port}")
+        try:
+            coord = GroupCoordinator(client, "scorers-wire",
+                                     session_timeout_s=5.0)
+            c1 = GroupConsumer(coord, ["SENSOR_DATA_S_AVRO"])
+            rows = sum(batch.n_valid
+                       for batch in SensorBatches(c1, batch_size=100))
+            assert rows == total
+            # keys variant over the same group machinery
+            c1.seek_to_start()
+            kb = SensorBatches(c1, batch_size=100, keep_keys=True)
+            batches = list(kb)
+            assert sum(bt.n_valid for bt in batches) == total
+            ks = np.concatenate([bt.keys[: bt.n_valid] for bt in batches])
+            assert set(np.unique(ks)) == {
+                f"electric-vehicle-{i:05d}".encode() for i in range(50)}
+        finally:
+            client.close()
+
+
 def test_two_members_alternating_polls_converge(broker):
     """Regression: a rejoin with an unchanged subscription must not bump the
     generation, else two alternating pollers livelock in perpetual mutual
