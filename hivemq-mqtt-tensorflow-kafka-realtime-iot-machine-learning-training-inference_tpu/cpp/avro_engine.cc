@@ -344,6 +344,6 @@ int64_t iotml_encode_batch(const double* numeric, const char* labels,
 // 4 = + iotml_json_decode_batch (batch JSON → columnar, json_engine.cc)
 //     + iotml_encode_batch_nulls (null-bitmap encode);
 // 5 = + iotml_format_rows_f32/f64 (batch np.array2string, fmt_engine.cc)
-int64_t iotml_engine_version() { return 5; }
+int64_t iotml_engine_version() { return 6; }
 
 }  // extern "C"

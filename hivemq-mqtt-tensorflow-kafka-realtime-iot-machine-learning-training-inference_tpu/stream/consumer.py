@@ -172,5 +172,15 @@ class StreamConsumer:
         return [tuple(c) for c in self._cursors]
 
     def commit(self):
+        commit_many = getattr(self.broker, "commit_many", None)
+        if commit_many is not None:
+            # one request per topic instead of one per partition — over
+            # the wire each commit is a round trip into the broker process
+            by_topic: dict = {}
+            for t, p, off in self._cursors:
+                by_topic.setdefault(t, []).append((p, off))
+            for t, entries in by_topic.items():
+                commit_many(self.group, t, entries)
+            return
         for t, p, off in self._cursors:
             self.broker.commit(self.group, t, p, off)

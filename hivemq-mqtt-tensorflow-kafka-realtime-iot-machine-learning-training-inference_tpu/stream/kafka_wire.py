@@ -610,6 +610,13 @@ class KafkaWireBroker(ProducePartitionMixin):
                 return None if off < 0 else off
         return None
 
+    def commit_many(self, group: str, topic: str, entries) -> None:
+        """Commit [(partition, next_offset), ...] of one topic in ONE
+        OffsetCommit request (StreamConsumer.commit's fast path) —
+        delegates to the fenced path with the simple-consumer generation."""
+        self.commit_fenced(group, -1, "",
+                           [(topic, p, off) for p, off in entries])
+
     def commit_fenced(self, group: str, generation: int, member_id: str,
                       positions) -> bool:
         """Generation-fenced OffsetCommit (v2 carries generation+member).

@@ -42,7 +42,7 @@ def _build() -> bool:
         return False
 
 
-ENGINE_VERSION = 5  # must match iotml_engine_version() in avro_engine.cc
+ENGINE_VERSION = 6  # must match iotml_engine_version() in avro_engine.cc
 
 
 def _stale() -> bool:

@@ -83,6 +83,8 @@ def _sig(lib) -> None:
                               c.c_int64, c.c_char_p, c.c_int64, c.c_int64,
                               _i64p],
         "commit": [c.c_void_p, c.c_char_p, c.c_char_p, c.c_int32, c.c_int64],
+        "commit_many": [c.c_void_p, c.c_char_p, c.c_char_p,
+                        c.POINTER(c.c_int32), _i64p, c.c_int64],
         "committed": [c.c_void_p, c.c_char_p, c.c_char_p, c.c_int32],
     }
     for name, argtypes in sigs.items():
@@ -331,6 +333,21 @@ class NativeKafkaBroker(ProducePartitionMixin):
             _check(self._lib.iotml_kafka_commit(
                 self._h, group.encode(), topic.encode(), partition,
                 ctypes.c_int64(next_offset)), f"commit({group},{topic})")
+
+    def commit_many(self, group: str, topic: str, entries) -> None:
+        """Commit [(partition, next_offset), ...] of one topic in ONE wire
+        request (the per-partition loop cost a round trip each)."""
+        entries = list(entries)
+        if not entries:
+            return
+        with self._lock:
+            parts = np.asarray([p for p, _ in entries], np.int32)
+            offs = np.asarray([o for _, o in entries], np.int64)
+            _check(self._lib.iotml_kafka_commit_many(
+                self._h, group.encode(), topic.encode(),
+                parts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                offs.ctypes.data_as(_i64p), len(entries)),
+                f"commit_many({group},{topic})")
 
     def committed(self, group: str, topic: str,
                   partition: int) -> Optional[int]:

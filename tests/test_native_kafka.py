@@ -194,3 +194,36 @@ def test_concurrent_producer_and_consumer_share_one_client(served):
         t.join(timeout=60)
     assert not errors
     assert client.end_offset("cc", 0) == n
+
+
+def test_commit_many_single_request_roundtrip():
+    """Multi-partition offsets commit in ONE wire request and read back
+    identically via committed() — from the native client, the Python wire
+    client, and the StreamConsumer.commit() fast path over each."""
+    from iotml.stream.kafka_wire import KafkaWireBroker
+
+    broker = Broker()
+    broker.create_topic("T", partitions=4)
+    for p in range(4):
+        for i in range(5):
+            broker.produce("T", f"v{p}{i}".encode(), partition=p)
+    with KafkaWireServer(broker) as srv:
+        clients = [NativeKafkaBroker(f"127.0.0.1:{srv.port}"),
+                   KafkaWireBroker(f"127.0.0.1:{srv.port}")]
+        try:
+            for j, client in enumerate(clients):
+                g = f"g{j}"
+                client.commit_many(g, "T", [(p, p + 1) for p in range(4)])
+                assert [client.committed(g, "T", p)
+                        for p in range(4)] == [1, 2, 3, 4]
+                # the consumer's commit() groups cursors into this path
+                c = StreamConsumer(client, [f"T:{p}:0" for p in range(4)],
+                                   group=f"gc{j}")
+                while c.poll(100):
+                    pass
+                c.commit()
+                assert [client.committed(f"gc{j}", "T", p)
+                        for p in range(4)] == [5, 5, 5, 5]
+        finally:
+            for client in clients:
+                client.close()
