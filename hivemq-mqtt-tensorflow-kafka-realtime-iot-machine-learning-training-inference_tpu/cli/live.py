@@ -33,7 +33,13 @@ def _wire_broker(servers: str, sasl: str):
 
         return NativeKafkaBroker(servers, sasl_username=user,
                                  sasl_password=pw)
-    except Exception:
+    except Exception as e:
+        # The fallback exists for boxes without the C++ engine; anything
+        # else (bad SASL, unreachable host) will fail again in the pure
+        # client with less context — say why we fell back.
+        print(json.dumps({"event": "native_kafka_fallback",
+                          "error": f"{type(e).__name__}: {e}"}),
+              file=sys.stderr, flush=True)
         from ..stream.kafka_wire import KafkaWireBroker
 
         return KafkaWireBroker(servers, sasl_username=user, sasl_password=pw)

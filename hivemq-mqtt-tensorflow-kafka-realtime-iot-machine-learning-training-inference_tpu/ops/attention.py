@@ -25,8 +25,67 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 NEG_INF = -1e30
+
+
+def _causal_tiles(nq: int, nk: int, block_q: int, block_k: int,
+                  order: str) -> tuple:
+    """Enumerate the LIVE causal tiles as (i_map, j_map) int32 arrays.
+
+    The dense grid pays DMA + a grid step for every (i, j) tile and
+    `pl.when`s away the strictly-future half — measured at ≈½ a computed
+    tile each (ARCHITECTURE.md roofline lever 2).  Feeding these maps
+    through scalar prefetch makes the grid exactly the lower triangle:
+    skipped tiles stop existing instead of being masked.
+
+    order="row": row-major (i outer) — forward and dQ, whose scratch
+    accumulates along j within one q row.  order="col": column-major
+    (j outer) — dK/dV, whose scratch accumulates along i within one kv
+    column.  Columns entirely in the future of every query keep one dead
+    diagonal tile so their dk/dv output block is still zero-written.
+
+    Cost bound: the maps hold ~nq·nk/2 int32 pairs (vectorized numpy —
+    no Python loop), shipped through scalar prefetch.  At the benched
+    long-context shape (T=65,536, 1024² tiles) that is 2,080 tiles =
+    16 KB; callers picking tiny blocks at huge T pay O((T/block)²)
+    map memory, which `_flash_forward` caps (falls back to the dense
+    grid past _TRI_TILE_CAP) so the prefetch stream can never outgrow
+    SMEM-class storage."""
+    if order == "row":
+        jmax = np.minimum(nk - 1, (np.arange(nq, dtype=np.int64) * block_q
+                                   + block_q - 1) // block_k)
+        counts = jmax + 1
+        im = np.repeat(np.arange(nq, dtype=np.int64), counts)
+        # j runs 0..jmax within each row: global arange minus the row start
+        starts = np.repeat(np.cumsum(counts) - counts, counts)
+        jm = np.arange(counts.sum(), dtype=np.int64) - starts
+    else:
+        imin = np.minimum(nq - 1, (np.arange(nk, dtype=np.int64) * block_k)
+                          // block_q)
+        counts = nq - imin
+        jm = np.repeat(np.arange(nk, dtype=np.int64), counts)
+        starts = np.repeat(np.cumsum(counts) - counts, counts)
+        im = np.arange(counts.sum(), dtype=np.int64) - starts + \
+            np.repeat(imin, counts)
+    return (im.astype(np.int32), jm.astype(np.int32))
+
+
+#: triangular-grid cap: above this many live tiles the scalar-prefetch
+#: maps (2 × 4 B × tiles, × 3 kernels) would outgrow SMEM-class storage —
+#: fall back to the dense grid, which has O(1) grid metadata.  65,536
+#: tiles = 512 KB of maps; every practical (T, block) pairing for this
+#: framework sits far below it (65,536 tokens at 1024² → 2,080 tiles;
+#: 128² blocks stay under the cap to T = 46k).
+_TRI_TILE_CAP = 65_536
+
+
+def _tri_tile_count(nq: int, nk: int, block_q: int, block_k: int) -> int:
+    """Live-tile count of the causal triangle (row order; col is equal)."""
+    jmax = np.minimum(nk - 1, (np.arange(nq, dtype=np.int64) * block_q
+                               + block_q - 1) // block_k)
+    return int((jmax + 1).sum())
 
 
 def attention_reference(q, k, v, causal: bool = True,
@@ -144,6 +203,60 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
         lse_ref[:] = jnp.where(l == 0.0, NEG_INF, m_s[:] + jnp.log(safe_l))
 
 
+def _flash_kernel_tri(im_ref, jm_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      acc, m_s, l_s, *, scale: float, block_q: int,
+                      block_k: int, nk: int):
+    """Causal flash forward on the TRIANGULAR grid: the grid's second
+    axis walks only the live lower-triangle tiles (row-major), with the
+    (i, j) tile coordinates arriving via scalar prefetch.  Strictly-future
+    tiles no longer exist, so they pay neither their K/V DMA nor a grid
+    step (the dense grid's `pl.when` skip still paid both — measured at
+    ≈½ a computed tile, ARCHITECTURE.md roofline lever 2)."""
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(1)
+    i = im_ref[t]
+    j = jm_ref[t]
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    # same math + dtype policy as _flash_kernel (see its comment): bf16
+    # systolic passes, f32 accumulation, f32 softmax, P cast for P·V
+    s = jax.lax.dot_general(q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    qi = jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0) + i * block_q
+    kj = jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1) + j * block_k
+    s = jnp.where(qi >= kj, s, NEG_INF)
+    m = m_s[:]
+    l = l_s[:]
+    m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+    alive = m_new > NEG_INF / 2
+    corr = jnp.where(alive, jnp.exp(m - m_new), 0.0)
+    p = jnp.where(alive, jnp.exp(s - m_new), 0.0)
+    m_s[:] = m_new
+    l_s[:] = l * corr + jnp.sum(p, axis=1, keepdims=True)
+    acc[:] = acc[:] * corr + jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    # last live tile of this q row = the diagonal block
+    jmax = jnp.minimum(nk - 1, (i * block_q + block_q - 1) // block_k)
+
+    @pl.when(j == jmax)
+    def _emit():
+        lf = l_s[:]
+        safe_l = jnp.where(lf == 0.0, 1.0, lf)
+        o_ref[:] = (acc[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[:] = jnp.where(lf == 0.0, NEG_INF,
+                               m_s[:] + jnp.log(safe_l))
+
+
 def _flash_forward(q, k, v, causal: bool, block_q: int,
                    block_k: int, interpret: bool):
     """Run the Pallas kernel; returns (out [B,T,H,D], lse [B,H,T])."""
@@ -173,33 +286,71 @@ def _flash_forward(q, k, v, causal: bool, block_q: int,
     kf = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
     vf = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
 
-    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k)
-    out, lse = pl.pallas_call(
-        kernel,
-        grid=(B * H, Tq // block_q, Tk // block_k),
-        in_specs=[
-            pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Tq, 1), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_q, D), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-        ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
-        interpret=interpret,
-    )(qf, kf, vf)
+    out_shape = [
+        jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        jax.ShapeDtypeStruct((B * H, Tq, 1), jnp.float32),
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((block_q, D), jnp.float32),
+        pltpu.VMEM((block_q, 1), jnp.float32),
+        pltpu.VMEM((block_q, 1), jnp.float32),
+    ]
+    tri = causal and _tri_tile_count(Tq // block_q, Tk // block_k,
+                                     block_q, block_k) <= _TRI_TILE_CAP
+    if tri:
+        # triangular grid: only live tiles exist (see _flash_kernel_tri)
+        im, jm = _causal_tiles(Tq // block_q, Tk // block_k,
+                               block_q, block_k, "row")
+        kernel = functools.partial(_flash_kernel_tri, scale=scale,
+                                   block_q=block_q, block_k=block_k,
+                                   nk=Tk // block_k)
+        out, lse = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(B * H, len(im)),
+                in_specs=[
+                    pl.BlockSpec((None, block_q, D),
+                                 lambda b, t, im, jm: (b, im[t], 0)),
+                    pl.BlockSpec((None, block_k, D),
+                                 lambda b, t, im, jm: (b, jm[t], 0)),
+                    pl.BlockSpec((None, block_k, D),
+                                 lambda b, t, im, jm: (b, jm[t], 0)),
+                ],
+                out_specs=[
+                    pl.BlockSpec((None, block_q, D),
+                                 lambda b, t, im, jm: (b, im[t], 0)),
+                    pl.BlockSpec((None, block_q, 1),
+                                 lambda b, t, im, jm: (b, im[t], 0)),
+                ],
+                scratch_shapes=scratch_shapes,
+            ),
+            out_shape=out_shape,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")),
+            interpret=interpret,
+        )(jnp.asarray(im), jnp.asarray(jm), qf, kf, vf)
+    else:
+        kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                                   block_q=block_q, block_k=block_k)
+        out, lse = pl.pallas_call(
+            kernel,
+            grid=(B * H, Tq // block_q, Tk // block_k),
+            in_specs=[
+                pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
+            ],
+            out_shape=out_shape,
+            scratch_shapes=scratch_shapes,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+            interpret=interpret,
+        )(qf, kf, vf)
     out = out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)[:, :T]
     lse = lse.reshape(B, H, Tq)[:, :, :T]
     return out, lse
@@ -309,6 +460,71 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         dq_ref[:] = dq_acc[:].astype(dq_ref.dtype)
 
 
+def _flash_bwd_dkv_kernel_tri(im_ref, jm_ref, q_ref, do_ref, lse_ref,
+                              delta_ref, k_ref, v_ref, dk_ref, dv_ref,
+                              dk_acc, dv_acc, *, scale, block_q, block_k,
+                              t_real, nq):
+    """dK/dV on the triangular grid: column-major live tiles (the scratch
+    accumulates q blocks within one kv column).  A column entirely in the
+    future of every query keeps one dead diagonal tile whose mask zeroes
+    p/ds, so its dk/dv block is still zero-written (see _causal_tiles)."""
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(1)
+    i = im_ref[t]
+    j = jm_ref[t]
+    imin = jnp.minimum(nq - 1, (j * block_k) // block_q)
+
+    @pl.when(i == imin)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    p, ds = _bwd_common(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        scale=scale, causal=True, block_q=block_q,
+                        block_k=block_k, t_real=t_real, i=i, j=j)
+    dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+        p.astype(do_ref.dtype), do_ref[:], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+        ds.astype(q_ref.dtype), q_ref[:], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(i == nq - 1)
+    def _emit():
+        dk_ref[:] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel_tri(im_ref, jm_ref, q_ref, do_ref, lse_ref,
+                             delta_ref, k_ref, v_ref, dq_ref, dq_acc, *,
+                             scale, block_q, block_k, t_real, nk):
+    """dQ on the triangular grid: row-major live tiles (one q block
+    accumulates its causally-relevant kv blocks)."""
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(1)
+    i = im_ref[t]
+    j = jm_ref[t]
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    _, ds = _bwd_common(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        scale=scale, causal=True, block_q=block_q,
+                        block_k=block_k, t_real=t_real, i=i, j=j)
+    dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+        ds.astype(k_ref.dtype), k_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    jmax = jnp.minimum(nk - 1, (i * block_q + block_q - 1) // block_k)
+
+    @pl.when(j == jmax)
+    def _emit():
+        dq_ref[:] = dq_acc[:].astype(dq_ref.dtype)
+
+
 # backward tile cap: 1024² measured fastest on v5e (the three [bq, bk]
 # f32 temporaries fit VMEM; 2048² fails to compile) — sweep in PARITY
 _BWD_CAP = 1024
@@ -360,40 +576,92 @@ def _flash_backward(q, k, v, out, lse, do, causal: bool, block_q: int,
     kv_spec_i = pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0))
     kv_spec_j = pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0))
 
-    dkv_kernel = functools.partial(
-        _flash_bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq,
-        block_k=bk, t_real=T)
-    dk_f, dv_f = pl.pallas_call(
-        dkv_kernel,
-        grid=(B * H, Tk // bk, Tq // bq),
-        in_specs=[q_spec_j, q_spec_j, r_spec_j, r_spec_j,
-                  kv_spec_j, kv_spec_j],
-        out_specs=[pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0)),
-                   pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0))],
-        out_shape=[jax.ShapeDtypeStruct((B * H, Tk, D), k.dtype),
-                   jax.ShapeDtypeStruct((B * H, Tk, D), v.dtype)],
-        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
-                        pltpu.VMEM((bk, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
-        interpret=interpret,
-    )(qf, dof, lse_f, delta_f, kf, vf)
+    dkv_out_shape = [jax.ShapeDtypeStruct((B * H, Tk, D), k.dtype),
+                     jax.ShapeDtypeStruct((B * H, Tk, D), v.dtype)]
+    dkv_scratch = [pltpu.VMEM((bk, D), jnp.float32),
+                   pltpu.VMEM((bk, D), jnp.float32)]
+    tri = causal and _tri_tile_count(Tq // bq, Tk // bk,
+                                     bq, bk) <= _TRI_TILE_CAP
+    if tri:
+        imc, jmc = _causal_tiles(Tq // bq, Tk // bk, bq, bk, "col")
+        dkv_kernel = functools.partial(
+            _flash_bwd_dkv_kernel_tri, scale=scale, block_q=bq,
+            block_k=bk, t_real=T, nq=Tq // bq)
+        q_tri = pl.BlockSpec((None, bq, D),
+                             lambda b, t, im, jm: (b, im[t], 0))
+        r_tri = pl.BlockSpec((None, bq, 1),
+                             lambda b, t, im, jm: (b, im[t], 0))
+        kv_tri = pl.BlockSpec((None, bk, D),
+                              lambda b, t, im, jm: (b, jm[t], 0))
+        dk_f, dv_f = pl.pallas_call(
+            dkv_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(B * H, len(imc)),
+                in_specs=[q_tri, q_tri, r_tri, r_tri, kv_tri, kv_tri],
+                out_specs=[kv_tri, kv_tri],
+                scratch_shapes=dkv_scratch,
+            ),
+            out_shape=dkv_out_shape,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")),
+            interpret=interpret,
+        )(jnp.asarray(imc), jnp.asarray(jmc), qf, dof, lse_f, delta_f,
+          kf, vf)
+    else:
+        dkv_kernel = functools.partial(
+            _flash_bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq,
+            block_k=bk, t_real=T)
+        dk_f, dv_f = pl.pallas_call(
+            dkv_kernel,
+            grid=(B * H, Tk // bk, Tq // bq),
+            in_specs=[q_spec_j, q_spec_j, r_spec_j, r_spec_j,
+                      kv_spec_j, kv_spec_j],
+            out_specs=[pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0)),
+                       pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0))],
+            out_shape=dkv_out_shape,
+            scratch_shapes=dkv_scratch,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+            interpret=interpret,
+        )(qf, dof, lse_f, delta_f, kf, vf)
 
-    dq_kernel = functools.partial(
-        _flash_bwd_dq_kernel, scale=scale, causal=causal, block_q=bq,
-        block_k=bk, t_real=T)
-    dq_f = pl.pallas_call(
-        dq_kernel,
-        grid=(B * H, Tq // bq, Tk // bk),
-        in_specs=[q_spec_i, q_spec_i, r_spec_i, r_spec_i,
-                  kv_spec_i, kv_spec_i],
-        out_specs=pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
-        interpret=interpret,
-    )(qf, dof, lse_f, delta_f, kf, vf)
+    if tri:
+        imr, jmr = _causal_tiles(Tq // bq, Tk // bk, bq, bk, "row")
+        dq_kernel = functools.partial(
+            _flash_bwd_dq_kernel_tri, scale=scale, block_q=bq,
+            block_k=bk, t_real=T, nk=Tk // bk)
+        dq_f = pl.pallas_call(
+            dq_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(B * H, len(imr)),
+                in_specs=[q_tri, q_tri, r_tri, r_tri, kv_tri, kv_tri],
+                out_specs=q_tri,
+                scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+            ),
+            out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")),
+            interpret=interpret,
+        )(jnp.asarray(imr), jnp.asarray(jmr), qf, dof, lse_f, delta_f,
+          kf, vf)
+    else:
+        dq_kernel = functools.partial(
+            _flash_bwd_dq_kernel, scale=scale, causal=causal, block_q=bq,
+            block_k=bk, t_real=T)
+        dq_f = pl.pallas_call(
+            dq_kernel,
+            grid=(B * H, Tq // bq, Tk // bk),
+            in_specs=[q_spec_i, q_spec_i, r_spec_i, r_spec_i,
+                      kv_spec_i, kv_spec_i],
+            out_specs=pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+            scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+            interpret=interpret,
+        )(qf, dof, lse_f, delta_f, kf, vf)
 
     def unfold(x, Tp):
         return x.reshape(B, H, Tp, D).transpose(0, 2, 1, 3)[:, :T]

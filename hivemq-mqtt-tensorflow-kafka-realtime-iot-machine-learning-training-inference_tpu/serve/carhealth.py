@@ -95,7 +95,9 @@ class CarHealthDetector:
     def update(self, keys: np.ndarray, errs: np.ndarray) -> list:
         """Fold one scored batch's (keys [n] bytes, per-row errors [n])
         into the per-car state; returns this call's alert transitions as
-        [(key, state, ema)].  Vectorized per distinct car: a batch holds
+        [(t, key, state, ema)] — the same 4-tuples recorded in
+        self.transitions, so publishing them downstream carries the
+        transition's own timestamp.  Vectorized per distinct car: a batch holds
         many rows of few cars, so the group-by does the heavy lifting in
         numpy and the Python loop runs per CAR, not per row."""
         if len(keys) == 0:
@@ -129,12 +131,12 @@ class CarHealthDetector:
                         e > self.threshold:
                     self.alerted[k] = now
                     self.transitions.append((now, k, "ALERT", e))
-                    out.append((k, "ALERT", e))
+                    out.append((now, k, "ALERT", e))
                     self._m_alerts.inc()
             elif e < self.threshold * self.clear_ratio:
                 del self.alerted[k]
                 self.transitions.append((now, k, "CLEAR", e))
-                out.append((k, "CLEAR", e))
+                out.append((now, k, "CLEAR", e))
         self._m_active.set(len(self.alerted))
         return out
 
@@ -163,11 +165,10 @@ class CarHealthDetector:
         """Emit alert transitions as keyed JSON records (the digital-twin
         feed: key = car key, value = {car, state, ema, t}).  Pass the
         return value of update() to publish just that batch's
-        transitions."""
-        if transitions is not None:  # update()'s 3-tuples: stamp fresh
-            trans = [(time.time(), k, s, e) for k, s, e in transitions]
-        else:  # None: replay the full recorded history
-            trans = list(self.transitions)
+        transitions; the published `t` is the transition's recorded
+        timestamp (identical to self.transitions), never re-stamped."""
+        trans = (list(transitions) if transitions is not None
+                 else list(self.transitions))
         n = 0
         for t, k, s, e in trans:
             broker.produce(topic, json.dumps(

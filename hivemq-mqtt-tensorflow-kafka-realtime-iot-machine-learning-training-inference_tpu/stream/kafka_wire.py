@@ -613,9 +613,13 @@ class KafkaWireBroker(ProducePartitionMixin):
     def commit_many(self, group: str, topic: str, entries) -> None:
         """Commit [(partition, next_offset), ...] of one topic in ONE
         OffsetCommit request (StreamConsumer.commit's fast path) —
-        delegates to the fenced path with the simple-consumer generation."""
-        self.commit_fenced(group, -1, "",
-                           [(topic, p, off) for p, off in entries])
+        delegates to the fenced path with the simple-consumer generation.
+        Mirrors commit(): raises if the server fences the request, so a
+        future server-side semantics change cannot silently drop offsets
+        (today the server never fences generation -1)."""
+        if not self.commit_fenced(group, -1, "",
+                                  [(topic, p, off) for p, off in entries]):
+            raise RuntimeError(f"batched offset commit {topic} fenced")
 
     def commit_fenced(self, group: str, generation: int, member_id: str,
                       positions) -> bool:

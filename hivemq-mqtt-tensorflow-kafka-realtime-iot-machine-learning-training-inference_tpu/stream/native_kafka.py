@@ -99,7 +99,17 @@ class NativeKafkaBroker(ProducePartitionMixin):
     def __init__(self, servers: str, client_id: str = "iotml-native",
                  sasl_username: Optional[str] = None,
                  sasl_password: Optional[str] = None,
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0,
+                 key_stride: Optional[int] = None):
+        #: bytes per row reserved for message keys in fetch_decode_keys;
+        #: raise it where per-entity consumers join on keys longer than
+        #: the MQTT-topic defaults (a truncated key aliases two cars).
+        #: None → the class default KEY_STRIDE (single source of truth)
+        if key_stride is not None:
+            self.KEY_STRIDE = int(key_stride)
+        #: rows whose key filled the stride (possibly truncated by the
+        #: engine — the engine writes at most stride-1 bytes)
+        self.keys_maybe_truncated = 0
         lib = load()
         if lib is None:
             raise RuntimeError("native stream engine unavailable")
@@ -273,9 +283,10 @@ class NativeKafkaBroker(ProducePartitionMixin):
             return (numeric[:n], labels[:n, : codec.n_strings],
                     int(next_off.value))
 
-    #: bytes per row for message keys in fetch_decode_keys (MQTT-topic
-    #: keys like "vehicles/sensor/data/electric-vehicle-00042" fit with
-    #: room; longer keys truncate at stride-1, zero-padded)
+    #: default bytes per row for message keys in fetch_decode_keys
+    #: (MQTT-topic keys like "vehicles/sensor/data/electric-vehicle-00042"
+    #: fit with room; longer keys truncate at stride-1, zero-padded —
+    #: pass key_stride= at construction to widen)
     KEY_STRIDE = 64
 
     def fetch_decode_keys(self, topic: str, partition: int, offset: int,
@@ -310,6 +321,23 @@ class NativeKafkaBroker(ProducePartitionMixin):
             if rc == -1003:
                 raise KeyError(topic)
             n = _check(rc, f"fetch_decode_keys({topic}:{partition}@{offset})")
+            # A key that fills the stride was possibly truncated by the
+            # engine (it writes at most stride-1 bytes): two distinct car
+            # keys sharing a stride-1-byte prefix would alias into one
+            # detector entity — surface that instead of staying silent.
+            nt = int(np.sum(np.char.str_len(keys[:n])
+                            >= self.KEY_STRIDE - 1))
+            if nt:
+                if not self.keys_maybe_truncated:
+                    import warnings
+
+                    warnings.warn(
+                        f"{nt} message key(s) filled KEY_STRIDE-1="
+                        f"{self.KEY_STRIDE - 1} bytes and may be truncated"
+                        " (keys sharing that prefix alias); construct"
+                        " NativeKafkaBroker with a larger key_stride=",
+                        RuntimeWarning, stacklevel=2)
+                self.keys_maybe_truncated += nt
             return (numeric[:n], labels[:n, : codec.n_strings], keys[:n],
                     int(next_off.value))
 

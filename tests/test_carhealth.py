@@ -31,7 +31,7 @@ def test_detector_alerts_after_min_records_and_clears_with_hysteresis():
     assert out == [] and d.alerted == {}
     out = d.update(np.array([bad] * 5 + [good] * 20, "S16"),
                    np.concatenate([np.full(5, 9.0), np.full(20, 0.1)]))
-    assert [(k, s) for k, s, _ in out] == [(bad, "ALERT")]
+    assert [(k, s) for _, k, s, _ in out] == [(bad, "ALERT")]
     assert bad in d.alerted and good not in d.alerted
     # recovery: EMA must fall below threshold*clear_ratio, not just the
     # threshold (hysteresis)
@@ -40,7 +40,7 @@ def test_detector_alerts_after_min_records_and_clears_with_hysteresis():
     cleared = []
     for _ in range(8):
         cleared += d.update(np.array([bad], "S16"), np.array([0.0]))
-    assert [(k, s) for k, s, _ in cleared] == [(bad, "CLEAR")]
+    assert [(k, s) for _, k, s, _ in cleared] == [(bad, "CLEAR")]
     assert d.alerted == {}
     assert [s for _, _, s, _ in d.transitions] == ["ALERT", "CLEAR"]
 
@@ -50,11 +50,33 @@ def test_detector_ignores_keyless_rows_and_groups_vectorized():
     keys = np.array([b"", b"a", b"b", b"a", b""], "S8")
     errs = np.array([9.0, 0.9, 0.1, 0.8, 9.0])
     out = d.update(keys, errs)
-    assert sorted(k for k, s, _ in out) == [b"a"]
+    assert sorted(k for _, k, s, _ in out) == [b"a"]
     assert b"" not in d.ema
     # alpha=1.0 → EMA == last value per car, folded in order
     assert d.ema[b"a"] == pytest.approx(0.8)
     assert d.ema[b"b"] == pytest.approx(0.1)
+
+
+def test_published_transition_carries_recorded_timestamp():
+    """The alert record's `t` is the transition's own timestamp — the
+    same value recorded in detector.transitions, never re-stamped at
+    publish time (an operator correlating the twin feed against the
+    detector's history must see one time, not two)."""
+    d = CarHealthDetector(threshold=0.5, alpha=1.0, min_records=1)
+    out = d.update(np.array([b"car-x"], "S16"), np.array([9.0]))
+    assert len(out) == 1 and out[0] == d.transitions[0]
+
+    class _Rec:
+        def __init__(self):
+            self.msgs = []
+
+        def produce(self, topic, value, key=None):
+            self.msgs.append((topic, value, key))
+
+    rec = _Rec()
+    d.publish_transitions(rec, "car-health", out)
+    payload = json.loads(rec.msgs[0][1])
+    assert payload["t"] == d.transitions[0][0]
 
 
 # ----------------------------------------------- end-to-end with a model
