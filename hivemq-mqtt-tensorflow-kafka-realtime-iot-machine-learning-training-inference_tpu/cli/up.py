@@ -78,13 +78,36 @@ class Platform:
         self.registry_server = SchemaRegistryServer(self.registry, host=host,
                                                     port=registry_port)
 
-        self.sql = SqlEngine(self.broker, registry=self.registry)
+        # trusted_passthrough: the platform's REKEY leg reads the AVRO leg
+        # this same engine encodes in-process, so re-validating every
+        # pass-through payload would only re-check the engine's own
+        # encoder output (external producers still validate — the flag
+        # narrows itself to engine-produced sources)
+        self.sql = SqlEngine(self.broker, registry=self.registry,
+                             trusted_passthrough=True)
         install_reference_pipeline(self.sql)
         self.ksql = KsqlServer(self.sql, host=host, port=ksql_port)
 
         self.connect_worker = ConnectWorker(self.broker)
         self.connect = ConnectServer(self.connect_worker, host=host,
                                      port=connect_port)
+        # digital twin for car health (the reference's MongoDB sink on the
+        # car stream, mongodb-connector-configmap.yaml:6-23): the
+        # per-car failure detector publishes keyed alert records onto
+        # `car-health` (serve/carhealth.py) and this sink upserts them by
+        # car id — the operator looks up a car and sees its latest state
+        # (control center surfaces the active alerts; ConnectServer's
+        # driver thread pumps the sink continuously once started)
+        from ..connect import DocumentStoreSink
+
+        self.broker.create_topic("car-health",
+                                 retention_messages=retention_messages)
+        self.car_twin = DocumentStoreSink(id_field="car")
+        self.connect.register_sink(
+            "car-health-twin", self.car_twin, ["car-health"],
+            kind="DocumentStoreSink",
+            config={"connector.class": "DocumentStoreSink",
+                    "topics": "car-health", "document.id.field": "car"})
 
         self.mqtt_broker = MqttBroker()
         self.bridge = KafkaBridge(self.mqtt_broker, self.broker,

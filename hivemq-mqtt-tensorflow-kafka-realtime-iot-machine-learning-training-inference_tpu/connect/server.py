@@ -142,6 +142,24 @@ class ConnectServer(RestServer):
             self.worker.add_sink(name, sink, topics)
         return cls
 
+    # ----------------------------------------------------- registration
+    def register_sink(self, name: str, connector, topics, kind: str,
+                      config: Optional[dict] = None,
+                      transforms=()) -> None:
+        """Register an ALREADY-CONSTRUCTED sink under the server's own
+        bookkeeping (config/kind/count, under the lock) — the
+        programmatic twin of the REST create path, for hosts that wire a
+        connector instance directly (cli/up.py's car-health twin) rather
+        than describing one by config."""
+        with self._lock:
+            if name in self._configs:
+                raise ValueError(f"connector {name} already exists")
+            self.worker.add_sink(name, connector, topics,
+                                 transforms=transforms)
+            self._configs[name] = dict(config or {})
+            self._kinds[name] = kind
+            self._counts[name] = 0
+
     # ------------------------------------------------------------- routes
     def _list(self, m, body):
         with self._lock:

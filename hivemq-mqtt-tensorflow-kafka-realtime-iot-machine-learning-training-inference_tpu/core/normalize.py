@@ -91,4 +91,13 @@ class Normalizer:
 # The default normalizer used across the framework (reference parity mode).
 CAR_NORMALIZER = Normalizer(CAR_SCHEMA, parity=True)
 
+# Full normalization: the four reference-TODO fields carry signal instead
+# of being zeroed.  This is the DETECTION-grade normalizer — the battery
+# failure mode's entire signature (voltage sag + current spike) lives in
+# two fields the parity normalizer masks to 0, so a parity-normalized
+# model is structurally blind to it (measured: battery faults move
+# aggregate reconstruction MSE by only ~2%).  The live services accept
+# either; the reference-contract CLIs stay on parity.
+FULL_NORMALIZER = Normalizer(CAR_SCHEMA, parity=False)
+
 normalize = jax.jit(lambda x: CAR_NORMALIZER(x))

@@ -46,7 +46,7 @@ class ControlCenter(RestServer):
         connectors = sorted(p.connect._configs)
         from .metrics import default_registry
         metrics = default_registry.collect()
-        return {
+        out = {
             "endpoints": p.endpoints(),
             "topics": topics,
             "ksql": {"queries": queries, "sources": streams},
@@ -54,6 +54,23 @@ class ControlCenter(RestServer):
             "mqtt_sessions": p.mqtt_broker.session_count(),
             "metrics": metrics,
         }
+        # car-health digital twin (the predictive-maintenance surface):
+        # active alerts by car, latest state from the twin sink
+        twin = getattr(p, "car_twin", None)
+        if twin is not None:
+            # snapshot the dict first: the ConnectServer driver thread
+            # upserts concurrently and a live generator would raise
+            # "dict changed size during iteration"
+            docs = list(twin.docs.values())
+            alerts = sorted(
+                (d for d in docs if d.get("state") == "ALERT"),
+                key=lambda d: d.get("t", 0), reverse=True)
+            out["car_health"] = {
+                "cars_tracked": len(docs),
+                "active_alerts": alerts[:100],
+                "n_active": len(alerts),
+            }
+        return out
 
     def _status(self, m, body):
         return 200, self.snapshot()
@@ -72,6 +89,18 @@ class ControlCenter(RestServer):
         mrows = "".join(
             f"<tr><td>{html.escape(k)}</td><td>{v:g}</td></tr>"
             for k, v in sorted(s["metrics"].items()))
+        ch = s.get("car_health")
+        chsec = ""
+        if ch is not None:
+            arows = "".join(
+                f"<tr><td>{html.escape(str(d.get('car')))}</td>"
+                f"<td>{html.escape(str(d.get('source', '')))}</td>"
+                f"<td>{d.get('ema', 0):g}</td></tr>"
+                for d in ch["active_alerts"])
+            chsec = (f"<h2>Car health — {ch['n_active']} active alert(s), "
+                     f"{ch['cars_tracked']} cars tracked</h2>"
+                     f"<table><tr><th>car</th><th>source</th><th>ema</th>"
+                     f"</tr>{arows}</table>")
         page = f"""<!doctype html><html><head><title>iotml control center</title>
 <meta http-equiv="refresh" content="3">
 <style>body{{font-family:monospace;margin:2em}}table{{border-collapse:collapse;margin:1em 0}}
@@ -80,6 +109,7 @@ td,th{{border:1px solid #999;padding:2px 8px;text-align:left}}h2{{margin-bottom:
 <h1>iotml control center</h1>
 <p>MQTT sessions: {s['mqtt_sessions']} · connectors: {len(s['connectors'])}
 · endpoints: {html.escape(json.dumps(s['endpoints']))}</p>
+{chsec}
 <h2>Topics</h2><table><tr><th>topic</th><th>partitions</th><th>messages</th></tr>{rows}</table>
 <h2>KSQL queries</h2><table><tr><th>id</th><th>sink</th></tr>{qrows}</table>
 <h2>Metrics</h2><table>{mrows}</table>

@@ -55,6 +55,9 @@ FAMILIES = {
     "broker": ("mqtt_", "kafka_extension_"),
     "devsim": ("agent_",),
     "ml": ("iotml_",),
+    # the continuous-learning loop + per-car failure detection: trainer
+    # rounds/loss, scorer hot-swaps, live verdict quality, car alerts
+    "live": ("live_", "car_health_"),
 }
 
 

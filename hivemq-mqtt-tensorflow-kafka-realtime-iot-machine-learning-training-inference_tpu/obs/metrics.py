@@ -172,6 +172,22 @@ train_step_seconds = default_registry.histogram(
     "iotml_train_step_seconds", "train-step latency")
 reconstruction_mse = default_registry.gauge(
     "iotml_reconstruction_mse", "last mean reconstruction error")
+# continuous-learning loop (train/live.py ContinuousTrainer +
+# serve/live.py LiveScorer): the round-4 services reported these only as
+# stdout JSON for the bench harness — the operator's dashboards chart
+# them from here
+live_train_rounds = default_registry.counter(
+    "live_train_rounds_total", "continuous-trainer rounds completed")
+live_train_loss = default_registry.gauge(
+    "live_train_loss", "continuous-trainer last round loss")
+live_model_updates = default_registry.counter(
+    "live_model_updates_total", "scorer weight hot-swaps applied")
+live_detection_precision = default_registry.gauge(
+    "live_detection_precision",
+    "live verdict precision vs stream labels (cumulative)")
+live_detection_recall = default_registry.gauge(
+    "live_detection_recall",
+    "live verdict recall vs stream labels (cumulative)")
 
 
 def start_http_server(port: int = 9100, registry: Registry = default_registry):

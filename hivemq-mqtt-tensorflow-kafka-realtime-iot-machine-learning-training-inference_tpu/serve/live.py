@@ -24,6 +24,7 @@ import time
 from typing import Callable, Optional
 
 from ..data.dataset import SensorBatches
+from ..obs import metrics as obs_metrics
 from ..stream.consumer import StreamConsumer
 from ..stream.producer import OutputSequence
 from ..train.artifacts import ArtifactStore
@@ -39,7 +40,8 @@ class LiveScorer:
                  group: str = "cardata-live-score", batch_size: int = 100,
                  out_partition: Optional[int] = 0,
                  carhealth_topic: Optional[str] = "car-health",
-                 car_threshold=0.38):
+                 car_threshold=0.38, car_feature_heads: bool = False,
+                 normalizer=None):
         if model is None:
             from ..models.autoencoder import CAR_AUTOENCODER
 
@@ -53,20 +55,37 @@ class LiveScorer:
                                                  group=group, eof=False)
         carhealth = None
         if carhealth_topic is not None:
+            from ..core.schema import KSQL_CAR_SCHEMA
             from .carhealth import CarHealthDetector
 
-            carhealth = CarHealthDetector(threshold=car_threshold)
+            carhealth = CarHealthDetector(
+                threshold=car_threshold,
+                feature_heads=car_feature_heads,
+                feature_names=[f.name
+                               for f in KSQL_CAR_SCHEMA.sensor_fields])
             broker.create_topic(carhealth_topic)
+        batch_kw = {} if normalizer is None else dict(normalizer=normalizer)
         batches = SensorBatches(consumer, batch_size=batch_size,
                                 keep_labels=True,
-                                keep_keys=carhealth is not None)
+                                keep_keys=carhealth is not None,
+                                **batch_kw)
         out = OutputSequence(broker, result_topic, partition=out_partition)
+        # under full normalization the verdict mean stays on the PARITY
+        # feature subset — the threshold protocol's calibrated feature
+        # set (see StreamScorer.verdict_mask)
+        verdict_mask = None
+        if normalizer is not None:
+            from ..core.normalize import CAR_NORMALIZER
+
+            if normalizer is not CAR_NORMALIZER:
+                verdict_mask = CAR_NORMALIZER.mask.astype(bool)
         # params are loaded by wait_for_model(); scoring before that would
         # write garbage predictions from random init
         self.scorer = StreamScorer(model, None, batches, out,
                                    threshold=threshold,
                                    carhealth=carhealth,
-                                   carhealth_topic=carhealth_topic)
+                                   carhealth_topic=carhealth_topic,
+                                   verdict_mask=verdict_mask)
         self._current_artifact: Optional[str] = None
         self.model_updates = 0
 
@@ -81,6 +100,7 @@ class LiveScorer:
         self.scorer.set_params(params)
         self._current_artifact = artifact
         self.model_updates += 1
+        obs_metrics.live_model_updates.inc()
 
     def maybe_swap(self) -> bool:
         """Poll the pointer; swap when it names a new immutable blob."""
@@ -140,6 +160,13 @@ class LiveScorer:
         return self.scorer.scored - scored0
 
     def stats(self) -> dict:
+        q = self.scorer.quality
+        if q["tp"] + q["fp"]:
+            obs_metrics.live_detection_precision.set(
+                q["tp"] / (q["tp"] + q["fp"]))
+        if q["tp"] + q["fn"]:
+            obs_metrics.live_detection_recall.set(
+                q["tp"] / (q["tp"] + q["fn"]))
         return {
             "t": time.time(),
             # False while a max_rows-truncated drain is suspended: the

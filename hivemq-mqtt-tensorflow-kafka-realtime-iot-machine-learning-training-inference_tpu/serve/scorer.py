@@ -89,12 +89,23 @@ class StreamScorer:
 
     def __init__(self, model, params, batches: SensorBatches,
                  out: OutputSequence, threshold: Optional[float] = None,
-                 carhealth=None, carhealth_topic: Optional[str] = None):
+                 carhealth=None, carhealth_topic: Optional[str] = None,
+                 verdict_mask=None):
         self.model = model
         self.params = params
         self.batches = batches
         self.out = out
         self.threshold = threshold
+        #: optional boolean [F] mask restricting the per-row error MEAN
+        #: (verdicts, quality histograms, car mean-EMA) to a feature
+        #: subset.  Full-normalization deployments pass the PARITY mask:
+        #: the threshold protocol was calibrated on the reference's
+        #: feature set, and the four extra full-norm features (inherently
+        #: noisy) dilute the per-record verdict signal (measured: best f1
+        #: 0.50 unmasked vs 0.60 masked at the same model) — while the
+        #: per-feature detector heads still see all 18.
+        self.verdict_mask = (np.asarray(verdict_mask, bool)
+                             if verdict_mask is not None else None)
         #: optional per-car detector (serve.carhealth.CarHealthDetector):
         #: fed each scored batch's (keys, per-row errors) when the batch
         #: source keeps keys; alert transitions publish to
@@ -197,7 +208,16 @@ class StreamScorer:
         preds = preds.reshape((S_pad, B) + preds.shape[1:])[:S]
         # per-row reconstruction error over every non-batch axis
         err_axes = tuple(range(2, preds.ndim))
-        errs = np.mean(np.square(preds - xs), axis=err_axes)  # [S, B]
+        sq = np.square(preds - xs)
+        if self.verdict_mask is not None and sq.ndim == 3:
+            errs = sq[:, :, self.verdict_mask].mean(axis=2)  # [S, B]
+        else:
+            errs = np.mean(sq, axis=err_axes)  # [S, B]
+        # per-FEATURE errors for the detector's feature heads (2-D rows
+        # only: windowed rows have no single per-feature identity)
+        want_ferrs = (self.carhealth is not None
+                      and getattr(self.carhealth, "feature_heads", False)
+                      and sq.ndim == 3)
         # one vectorized formatting pass over every valid row in the
         # super-batch (byte-identical to np.array2string per row — the
         # serve bottleneck, see fastfmt)
@@ -226,8 +246,10 @@ class StreamScorer:
                             buckets[sel], minlength=len(ERR_BUCKETS) + 1)
             if self.carhealth is not None and b.keys is not None \
                     and b.n_valid:
-                trans = self.carhealth.update(b.keys[: b.n_valid],
-                                              err[: b.n_valid])
+                trans = self.carhealth.update(
+                    b.keys[: b.n_valid], err[: b.n_valid],
+                    ferrs=sq[k][: b.n_valid] if want_ferrs else None,
+                    fvals=xs[k][: b.n_valid] if want_ferrs else None)
                 if trans and self.carhealth_topic is not None:
                     self.carhealth.publish_transitions(
                         self.out.broker, self.carhealth_topic, trans)

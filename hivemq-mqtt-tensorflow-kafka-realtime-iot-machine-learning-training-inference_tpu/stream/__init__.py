@@ -5,3 +5,4 @@ from .csv_source import replay_csv  # noqa: F401
 from .group import GroupCoordinator, GroupConsumer  # noqa: F401
 from .registry import SchemaRegistry, RegisteredSchema, parse_avsc  # noqa: F401
 from .registry_server import SchemaRegistryServer  # noqa: F401
+from .replica import FollowerReplica  # noqa: F401

@@ -170,6 +170,33 @@ class Broker:
     def begin_offset(self, topic: str, partition: int = 0) -> int:
         return self._parts[topic][partition].base_offset
 
+    def align_base_offset(self, topic: str, partition: int,
+                          offset: int) -> None:
+        """Seed an EMPTY partition's base offset — replica bootstrap: a
+        follower mirroring a leader whose log head was already trimmed
+        must append the first copied message at the leader's earliest
+        retained offset, not 0, so offsets stay identical across the
+        pair (consumer cursors survive a failover unchanged)."""
+        part = self._parts[topic][partition]
+        with self._lock:
+            if part.log:
+                raise ValueError(
+                    f"{topic}:{partition} not empty; base is immutable")
+            part.base_offset = max(part.base_offset, int(offset))
+
+    def reset_partition(self, topic: str, partition: int,
+                        base_offset: int) -> None:
+        """Drop a partition's log and restart it at `base_offset` —
+        replica REALIGNMENT when the leader's retention outran
+        replication: appending the post-gap messages at the local end
+        would shift every subsequent offset and silently break the
+        offsets-identical failover contract.  Readers see the same thing
+        a leader-side trim shows them (fetch clamps to the new base)."""
+        part = self._parts[topic][partition]
+        with self._lock:
+            part.log.clear()
+            part.base_offset = int(base_offset)
+
     def fetch(self, topic: str, partition: int, offset: int,
               max_messages: int = 1024) -> List[Message]:
         """Read up to max_messages starting at offset (monotone, no blocking)."""

@@ -63,6 +63,13 @@ _SUPPORTED = {PRODUCE: (2, 2), FETCH: (2, 2), LIST_OFFSETS: (1, 1),
               CREATE_TOPICS: (0, 0)}
 
 
+class SaslAuthError(ConnectionError):
+    """The server explicitly REJECTED the credentials (handshake error
+    or non-empty auth response) — as opposed to dying mid-handshake.
+    Failover must not retry rejected credentials against every
+    bootstrap server; connectivity errors it may."""
+
+
 # ------------------------------------------------------------- primitives
 class _Writer:
     def __init__(self):
@@ -357,26 +364,69 @@ class KafkaWireBroker(ProducePartitionMixin):
         self._lock = threading.Lock()
         self._corr = 0
         # bootstrap list: try each server in order (a standard client's
-        # bootstrap.servers semantics), keep the first that answers
+        # bootstrap.servers semantics), keep the first that answers.  The
+        # full list is retained for FAILOVER: a request that hits a dead
+        # socket reconnects to the next reachable server and retries once
+        # (see _request) — how a consumer survives a leader death when a
+        # FollowerReplica serves the same topics on the second address.
         from ..utils.net import parse_bootstrap
 
-        last_err: Optional[Exception] = None
+        self._servers = list(parse_bootstrap(servers))
+        self._servers_repr = servers
+        self._timeout_s = timeout_s
+        self._sasl_creds = ((sasl_username, sasl_password or "")
+                            if sasl_username is not None else None)
         self._sock = None
-        for host, port in parse_bootstrap(servers):
-            try:
-                self._sock = socket.create_connection((host, port),
-                                                      timeout=timeout_s)
-                break
-            except OSError as e:
-                last_err = e
-        if self._sock is None:
-            raise last_err or OSError(f"no reachable broker in {servers!r}")
+        self._connect_any()
         self._meta: Dict[str, int] = {}  # topic → partition count
         self._rr: Dict[str, int] = {}
-        if sasl_username is not None:
-            self._sasl_plain(sasl_username, sasl_password or "")
 
     # ---------------------------------------------------------- transport
+    def _connect_any(self) -> None:
+        """Connect to the first reachable bootstrap server (+ SASL).
+        Caller must hold the lock (or be __init__, pre-threading).
+
+        An explicit SASL REJECTION raises immediately (the credentials
+        are wrong everywhere — retrying them fleet-wide would spam auth
+        failures); a server dying mid-handshake is connectivity and
+        falls through to the next server.  Either way the dead/rejected
+        socket is closed, never leaked."""
+        last_err: Optional[Exception] = None
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        for host, port in self._servers:
+            try:
+                sock = socket.create_connection((host, port),
+                                                timeout=self._timeout_s)
+            except OSError as e:
+                last_err = e
+                continue
+            try:
+                self._sock = sock
+                if self._sasl_creds is not None:
+                    self._sasl_plain_raw(*self._sasl_creds)
+                return
+            except SaslAuthError:
+                self._sock = None
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                raise
+            except OSError as e:
+                last_err = e
+                self._sock = None
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        raise last_err or \
+            OSError(f"no reachable broker in {self._servers_repr!r}")
+
     def _recv_exact(self, n: int) -> bytes:
         return recv_exact(self._sock, n, "broker closed connection")
 
@@ -387,34 +437,52 @@ class KafkaWireBroker(ProducePartitionMixin):
         (size,) = struct.unpack(">i", self._recv_exact(4))
         return self._recv_exact(size)
 
+    def _exchange(self, api_key: int, api_version: int,
+                  body: bytes) -> tuple:
+        """One request/response on the current socket; caller holds the
+        lock.  Returns (corr, resp bytes)."""
+        self._corr += 1
+        corr = self._corr
+        self._send_frame(_req_header(api_key, api_version, corr,
+                                     self.client_id) + body)
+        return corr, self._recv_frame()
+
     def _request(self, api_key: int, api_version: int, body: bytes) -> _Reader:
         with self._lock:
-            self._corr += 1
-            corr = self._corr
-            self._send_frame(_req_header(api_key, api_version, corr,
-                                         self.client_id) + body)
-            resp = self._recv_frame()
+            try:
+                corr, resp = self._exchange(api_key, api_version, body)
+            except OSError:
+                # dead server: fail over across the bootstrap list and
+                # retry ONCE.  Retried non-idempotent requests (produce,
+                # commit) may double-apply if the dead server processed
+                # them before dying — at-least-once, the same delivery
+                # contract the pipeline already documents.
+                self._connect_any()
+                corr, resp = self._exchange(api_key, api_version, body)
         r = _Reader(resp)
         got = r.i32()
         if got != corr:
             raise ConnectionError(f"correlation id mismatch: {got} != {corr}")
         return r
 
-    def _sasl_plain(self, username: str, password: str) -> None:
+    def _sasl_plain_raw(self, username: str, password: str) -> None:
+        """SASL PLAIN on the current socket, no locking (used by
+        _connect_any, which runs under the lock or from __init__)."""
         w = _Writer()
         w.string("PLAIN")
-        r = self._request(SASL_HANDSHAKE, 0, bytes(w.buf))
+        corr, resp = self._exchange(SASL_HANDSHAKE, 0, bytes(w.buf))
+        r = _Reader(resp)
+        if r.i32() != corr:
+            raise ConnectionError("correlation id mismatch in handshake")
         err = r.i16()
         mechanisms = r.array(lambda rd: rd.string())
         if err != ERR_NONE:
-            raise ConnectionError(
+            raise SaslAuthError(
                 f"SASL handshake failed ({err}); server offers {mechanisms}")
         token = b"\x00" + username.encode() + b"\x00" + password.encode()
-        with self._lock:
-            self._send_frame(token)   # raw token frame (pre-KIP-152)
-            resp = self._recv_frame()
-        if resp != b"":
-            raise ConnectionError("SASL PLAIN authentication failed")
+        self._send_frame(token)   # raw token frame (pre-KIP-152)
+        if self._recv_frame() != b"":
+            raise SaslAuthError("SASL PLAIN authentication failed")
 
     # ------------------------------------------------------------ metadata
     def _metadata(self, topics: Optional[List[str]] = None) -> dict:
@@ -832,6 +900,14 @@ class RemoteGroupCoordinator:
 class _KafkaConn(socketserver.BaseRequestHandler):
     """One client connection to the wire server."""
 
+    def setup(self):
+        with self.server._conn_lock:      # type: ignore[attr-defined]
+            self.server._live_conns.add(self.request)
+
+    def finish(self):
+        with self.server._conn_lock:      # type: ignore[attr-defined]
+            self.server._live_conns.discard(self.request)
+
     def _recv_exact(self, n: int) -> bytes:
         return recv_exact(self.request, n)
 
@@ -1172,6 +1248,8 @@ class KafkaWireServer(socketserver.ThreadingTCPServer):
         self._thread: Optional[threading.Thread] = None
         self._coordinators: dict = {}
         self._coord_lock = threading.Lock()
+        self._live_conns: set = set()
+        self._conn_lock = threading.Lock()
 
     def group_coordinator(self, group_id: str,
                           session_timeout_s: Optional[float] = None):
@@ -1199,4 +1277,24 @@ class KafkaWireServer(socketserver.ThreadingTCPServer):
 
     def __exit__(self, *exc) -> None:
         self.shutdown()
+        self.server_close()
+
+    def kill(self) -> None:
+        """Simulate abrupt broker death (failover tests / drills):
+        `shutdown()` alone only stops the accept loop — established
+        handler threads keep serving their sockets, which a dead process
+        would not.  This severs every live client connection too, so
+        clients observe exactly what a crashed leader looks like."""
+        self.shutdown()
+        with self._conn_lock:
+            conns = list(self._live_conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
         self.server_close()

@@ -1,0 +1,196 @@
+"""Follower replication + leader failover for the wire broker.
+
+The reference provisions replicated infrastructure: RF-3 Kafka topics on
+a 3-broker cluster (reference 01_installConfluentPlatform.sh:180-183,
+gcp.yaml:46-54) and a 5-node HiveMQ cluster (hivemq-crd.yaml:10) — its
+pipeline survives a broker death.  This module is the TPU rebuild's
+minimum equivalent for the stream plane:
+
+- `FollowerReplica`: a second wire-server process/object that
+  continuously pulls a leader's topics (messages, offsets preserved
+  one-to-one, consumer-group commit table included) into its own local
+  log and serves the same Kafka wire protocol.  Async pull replication —
+  Kafka `acks=1` semantics: an unreplicated tail at the moment of leader
+  death is lost (the loss window is `lag()`, observable).
+- Failover lives in the CLIENT: `KafkaWireBroker` keeps its full
+  bootstrap list, and a request hitting a dead socket reconnects to the
+  next reachable server and retries once (kafka_wire.py `_request`).  A
+  consumer built with `bootstrap="leader,follower"` that loses the
+  leader mid-drain resumes fetching from the follower at the SAME
+  offsets; committed offsets are mirrored, so a crash-restart
+  (`from_committed`) also lands correctly.
+
+What this deliberately does not do (scoped against the reference's
+managed clusters, see ARCHITECTURE.md): no ISR/acks=all produce path
+(a produce acked by the leader alone can be lost with it), no automatic
+leader election (the bootstrap order IS the priority list), and no
+replica for the MQTT session plane (HiveMQ clustering replicates live
+session state; the rebuild's MQTT front is stateless-per-connection by
+design, and a reconnecting fleet re-establishes sessions against the
+surviving front).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .broker import Broker
+from .kafka_wire import KafkaWireBroker, KafkaWireServer
+
+
+class FollowerReplica:
+    """Pull-replicate a leader's topics into a local wire-served log.
+
+    Args:
+      leader: bootstrap string of the leader (host:port).
+      topics: topic names to mirror (None = every topic the leader
+        lists, re-polled each sync round so late-created topics join).
+      groups: consumer groups whose committed offsets are mirrored.
+      host/port: where this follower's own wire server listens.
+      poll_interval_s: sleep between sync rounds once caught up.
+      sasl: optional (user, password) for the leader connection; the
+        follower's own server stays open (fixture semantics).
+    """
+
+    def __init__(self, leader: str, topics: Optional[List[str]] = None,
+                 groups: Tuple[str, ...] = (), host: str = "127.0.0.1",
+                 port: int = 0, poll_interval_s: float = 0.05,
+                 fetch_batch: int = 2000,
+                 retention_messages: Optional[int] = None,
+                 sasl: Optional[tuple] = None):
+        #: local log bound per mirrored topic.  The wire protocol does
+        #: not carry the leader's retention config, so a follower of a
+        #: retention-bounded leader must be given its own bound here or
+        #: it accumulates the whole stream forever.
+        self._retention = retention_messages
+        self.local = Broker()
+        self.server = KafkaWireServer(self.local, host=host, port=port)
+        user, pw = sasl if sasl is not None else (None, None)
+        self._leader = KafkaWireBroker(leader, client_id="iotml-replica",
+                                       sasl_username=user, sasl_password=pw)
+        self._topics = topics
+        self._groups = list(groups)
+        self._interval = poll_interval_s
+        self._batch = fetch_batch
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._parts: Dict[str, int] = {}
+        self.sync_errors: list = []
+        self.rounds = 0
+
+    # -------------------------------------------------------- lifecycle
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    def start(self) -> "FollowerReplica":
+        self.server.start()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self.server.shutdown()
+        self.server.server_close()
+        try:
+            self._leader.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "FollowerReplica":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------ replication
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                moved = self.sync_once()
+            except Exception as e:  # noqa: BLE001 - leader may be dying;
+                # the follower's job is to keep serving what it has
+                self.sync_errors.append(f"{type(e).__name__}: {e}")
+                time.sleep(self._interval * 4)
+                continue
+            self.rounds += 1
+            if not moved:
+                time.sleep(self._interval)
+
+    def sync_once(self) -> int:
+        """One replication round; returns messages copied.  Public so
+        tests (and a caught-up barrier) can drive it synchronously."""
+        names = self._topics if self._topics is not None \
+            else self._leader.topics()
+        copied = 0
+        for t in names:
+            spec = self._leader.topic(t)
+            if t not in self._parts:
+                if t not in self.local.topics():
+                    self.local.create_topic(
+                        t, partitions=spec.partitions,
+                        retention_messages=self._retention)
+                    # late-start bootstrap: align each empty partition to
+                    # the leader's earliest retained offset so copied
+                    # messages land at IDENTICAL offsets
+                    for p in range(spec.partitions):
+                        begin = self._leader.begin_offset(t, p)
+                        if begin > 0:
+                            self.local.align_base_offset(t, p, begin)
+                self._parts[t] = spec.partitions
+            for p in range(self._parts[t]):
+                while not self._stop.is_set():
+                    local_end = self.local.end_offset(t, p)
+                    msgs = self._leader.fetch(t, p, local_end,
+                                              max_messages=self._batch)
+                    if not msgs:
+                        break
+                    if msgs[0].offset != local_end:
+                        # leader trimmed past our cursor (retention
+                        # outran replication): REALIGN — appending at the
+                        # local end would shift every later offset and
+                        # silently break the offsets-identical contract
+                        self.sync_errors.append(
+                            f"trimmed past cursor {t}:{p} "
+                            f"{local_end}->{msgs[0].offset}; realigned")
+                        self.local.reset_partition(t, p, msgs[0].offset)
+                    for m in msgs:
+                        self.local.produce(t, m.value, key=m.key,
+                                           partition=p,
+                                           timestamp_ms=m.timestamp_ms)
+                    copied += len(msgs)
+        for g in self._groups:
+            for t in list(self._parts):
+                for p in range(self._parts[t]):
+                    off = self._leader.committed(g, t, p)
+                    if off is not None:
+                        self.local.commit(g, t, p, off)
+        return copied
+
+    def lag(self) -> Dict[str, int]:
+        """Per-topic messages the leader has that this follower doesn't —
+        the loss window if the leader died right now."""
+        out: Dict[str, int] = {}
+        for t, n in self._parts.items():
+            out[t] = sum(
+                max(0, self._leader.end_offset(t, p)
+                    - self.local.end_offset(t, p))
+                for p in range(n))
+        return out
+
+    def caught_up(self, timeout_s: float = 10.0) -> bool:
+        """Block until every mirrored topic's lag is zero (or timeout)."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            try:
+                if all(v == 0 for v in self.lag().values()) and self._parts:
+                    return True
+            except (OSError, RuntimeError, KeyError):
+                pass
+            time.sleep(0.05)
+        return False

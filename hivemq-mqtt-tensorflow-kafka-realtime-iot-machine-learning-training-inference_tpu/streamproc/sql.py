@@ -577,7 +577,8 @@ class SqlSelectTask(StreamTask):
 
     def __init__(self, broker: Broker, src_meta: SourceMeta,
                  sink_meta: SourceMeta, stmt: SelectStmt,
-                 registry: SchemaRegistry, group: str):
+                 registry: SchemaRegistry, group: str,
+                 trusted_passthrough: bool = False):
         super().__init__(broker, src_meta.topic, sink_meta.topic,
                          partitions=broker.topic(sink_meta.topic).partitions
                          if sink_meta.topic in broker.topics() else 1,
@@ -655,6 +656,14 @@ class SqlSelectTask(StreamTask):
             self._rekey_header = frame(b"", self.sink_schema_id)
             if sink_meta.record_schema().fields[0].nullable:
                 self._rekey_header += b"\x02"
+        #: trusted pass-through (engine-level opt-in): skip the strict
+        #: structural re-validation of rekey source payloads.  Sound only
+        #: when the source topic is written exclusively by THIS engine's
+        #: own native encoder (the reference pipeline's AVRO leg feeding
+        #: its REKEY leg): those bytes were validated at encode time, and
+        #: re-decoding every record was the rekey pump's dominant cost.
+        #: External/untrusted source topics must keep validation on.
+        self._trusted = bool(trusted_passthrough)
 
     def _project(self, rec: dict) -> Optional[dict]:
         out = {}
@@ -754,17 +763,20 @@ class SqlSelectTask(StreamTask):
             if not m.value or m.value[0] != 0:
                 return None  # poisoned frame: generic path drops it
             vals.append(m.value)
-        try:
-            # strict validation — the bytes pass through, so success must
-            # guarantee forwarding the ORIGINAL payload is byte-identical
-            # to decode→re-encode (no trailing bytes, minimal varints,
-            # valid UTF-8, sane union branches); anything else sends the
-            # whole batch to the generic path, which drops/canonicalizes
-            # exactly the bad rows
-            self._native_src.codec.decode_batch(
-                vals, strip=5, stride=_NativeAvroSource.STRIDE, strict=True)
-        except (ValueError, TypeError, RuntimeError):
-            return None
+        if not self._trusted:
+            try:
+                # strict validation — the bytes pass through, so success
+                # must guarantee forwarding the ORIGINAL payload is
+                # byte-identical to decode→re-encode (no trailing bytes,
+                # minimal varints, valid UTF-8, sane union branches);
+                # anything else sends the whole batch to the generic path,
+                # which drops/canonicalizes exactly the bad rows.  Skipped
+                # under trusted_passthrough — see __init__.
+                self._native_src.codec.decode_batch(
+                    vals, strip=5, stride=_NativeAvroSource.STRIDE,
+                    strict=True)
+            except (ValueError, TypeError, RuntimeError):
+                return None
         header = self._rekey_header
         out = []
         for m in messages:
@@ -1064,12 +1076,19 @@ class SqlEngine:
     queries, and (via the registry) Avro schema ids for its output topics.
     """
 
-    def __init__(self, broker: Broker, registry: Optional[SchemaRegistry] = None):
+    def __init__(self, broker: Broker, registry: Optional[SchemaRegistry] = None,
+                 trusted_passthrough: bool = False):
         self.broker = broker
         self.registry = registry or SchemaRegistry()
         self.sources: Dict[str, SourceMeta] = {}
         self.queries: Dict[str, Query] = {}
         self._qseq = 0
+        #: when True, pass-through queries whose SOURCE is itself the
+        #: output of one of this engine's own queries (query_id set) skip
+        #: strict payload re-validation — those bytes were produced by the
+        #: engine's validating encoder one hop earlier.  Sources fed by
+        #: external producers always keep validation regardless.
+        self.trusted_passthrough = bool(trusted_passthrough)
 
     # -- public API ---------------------------------------------------
 
@@ -1261,7 +1280,10 @@ class SqlEngine:
             self._qseq += 1
             qid = f"CSAS_{name}_{self._qseq}"
             task = SqlSelectTask(self.broker, src, meta, stmt,
-                                 self.registry, group=f"CSAS_{name}_{fp}")
+                                 self.registry, group=f"CSAS_{name}_{fp}",
+                                 trusted_passthrough=(
+                                     self.trusted_passthrough
+                                     and src.query_id is not None))
         meta.query_id = qid
         self.sources[name] = meta
         self.queries[qid] = Query(qid, name, sql, task)

@@ -25,6 +25,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..data.dataset import SensorBatches
+from ..obs import metrics as obs_metrics
 from ..stream.consumer import StreamConsumer
 from .artifacts import ArtifactStore
 from .loop import Trainer
@@ -50,7 +51,7 @@ class ContinuousTrainer:
                  group: str = "cardata-live-train",
                  model=None, batch_size: int = 100, take_batches: int = 20,
                  epochs_per_round: int = 1, only_normal: bool = True,
-                 learning_rate: float = 1e-3):
+                 learning_rate: float = 1e-3, normalizer=None):
         if model is None:
             from ..models.autoencoder import CAR_AUTOENCODER
 
@@ -75,10 +76,11 @@ class ContinuousTrainer:
         # broker process (expensive when that process is busy), and the
         # batcher's poll budgeting (_need_rows) guarantees a bounded
         # iteration never over-polls past the `take` boundary
+        batch_kw = {} if normalizer is None else dict(normalizer=normalizer)
         self.batches = SensorBatches(self.consumer, batch_size=batch_size,
                                      take=take_batches,
                                      only_normal=only_normal,
-                                     poll_chunk=8192)
+                                     poll_chunk=8192, **batch_kw)
         self.rounds = 0
         self.records_trained = 0
         self.last_loss: Optional[float] = None
@@ -102,6 +104,8 @@ class ContinuousTrainer:
         self.rounds += 1
         self.records_trained += history["records"][-1] * self.epochs_per_round
         self.last_loss = float(history["loss"][-1])
+        obs_metrics.live_train_rounds.inc()
+        obs_metrics.live_train_loss.set(self.last_loss)
         artifact = self.publish()
         # commit AFTER the artifact is durable (the `committed` resume
         # contract: a crash re-trains the slice rather than skipping it)

@@ -55,3 +55,20 @@ def test_family_dashboards_mirror_reference_split():
 
     ml = generate_dashboard(family="ml")
     assert all("iotml_" in p["targets"][0]["expr"] for p in ml["panels"])
+
+
+def test_live_family_charts_the_continuous_loop():
+    """The continuous-learning services' metrics (trainer rounds/loss,
+    scorer hot-swaps, live quality) and the car-health family get their
+    own dashboard — the round-4 gap where the live loop was stdout-only."""
+    from iotml.serve.carhealth import CarHealthDetector
+
+    CarHealthDetector()  # registers car_health_* in the default registry
+    live = generate_dashboard(family="live")
+    exprs = {p["targets"][0]["expr"] for p in live["panels"]}
+    for needle in ("live_train_rounds_total", "live_train_loss",
+                   "live_model_updates_total", "live_detection_precision",
+                   "car_health_alerts_active"):
+        assert any(needle in e for e in exprs), (needle, exprs)
+    doc = json.loads(dashboard_configmap())
+    assert "iotml-live.json" in doc["data"]

@@ -314,6 +314,30 @@ def test_rekey_passthrough_parity_with_trailing_junk():
     assert outs[0] == outs[1]
 
 
+def test_trusted_passthrough_byte_parity_and_scope():
+    """trusted_passthrough=True skips rekey re-validation only for
+    engine-produced sources, with byte-identical output on clean data;
+    sources fed by external producers keep validating regardless."""
+    outs = []
+    for trusted in (False, True):
+        broker = Broker()
+        _produce(broker, _fleet_records(40))
+        engine = SqlEngine(broker, trusted_passthrough=trusted)
+        install_reference_pipeline(engine)
+        rekey = next(q.task for q in engine.queries.values()
+                     if getattr(q.task, "_rekey_fast", False))
+        # scope: the REKEY source is the engine's own AVRO leg → trusted
+        # follows the engine flag; its upstream (external sensor-data)
+        # is never trusted
+        assert rekey._trusted is trusted
+        engine.pump()
+        spec = broker.topic("SENSOR_DATA_S_AVRO_REKEY")
+        outs.append([(p, m.key, m.value) for p in range(spec.partitions)
+                     for m in broker.fetch("SENSOR_DATA_S_AVRO_REKEY",
+                                           p, 0, 10000)])
+    assert outs[0] == outs[1] and len(outs[0]) == 40
+
+
 def test_json_decode_float32_range_guard():
     """A finite JSON number beyond float32 range in an Avro 'float' column
     must fall back: the Python leg raises on encode (struct.pack '<f'
