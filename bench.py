@@ -1850,9 +1850,15 @@ def bench_e2e_platform():
         ch = cum_at(drain_stats, headline["wall1"], "carhealth", None)
         if ch is not None:
             alerted = set(ch.get("cars_alerted", []))
+            # stdout lines stay compact (driver captures truncate long
+            # tails): first 12 names + the counts tell the whole story
             out["_quality"].update(
-                cars_alerted=sorted(alerted),
+                cars_alerted=sorted(alerted)[:12],
+                n_cars_alerted=len(alerted),
                 car_threshold=ch.get("threshold"),
+                alert_sources={k.rsplit("-", 1)[-1]: v for k, v in
+                               sorted(ch.get("alert_sources",
+                                             {}).items())[:12]},
                 car_true_alerts=len(alerted & failing_keys),
                 car_false_alerts=len(alerted - failing_keys),
                 strong_mode_cars=len(strong_keys),

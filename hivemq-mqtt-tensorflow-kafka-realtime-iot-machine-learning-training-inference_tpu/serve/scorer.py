@@ -140,6 +140,11 @@ class StreamScorer:
         the swap cannot drop or reorder output: the OutputSequence index
         stream is untouched."""
         self.params = params
+        if self.carhealth is not None and \
+                hasattr(self.carhealth, "notify_model_swap"):
+            # new weights shift every car's error together: the detector
+            # recalibrates per-update through the fold transient
+            self.carhealth.notify_model_swap()
 
     def score_available(self, max_rows: Optional[int] = None) -> int:
         """Drain whatever is currently in the stream; returns rows scored.
