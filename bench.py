@@ -429,7 +429,7 @@ def bench_long_context():
             return jax.lax.fori_loop(0, n, body, jnp.float32(0))
         return f
 
-    step1, step5 = make_multi(1), make_multi(5)
+    step1, step9 = make_multi(1), make_multi(9)
 
     def timed(f):
         # a host read of the reduced scalar is the sync point: over the
@@ -447,10 +447,14 @@ def bench_long_context():
                p50_s=round(p50, 4), p95_s=round(p95, 4),
                n_passes=n_passes, backend=jax.default_backend())
     if on_tpu:
-        timed(step5)  # compile
-        w5 = min(timed(step5) for _ in range(3))
+        # K=9 loop: the K-step subtraction divides dispatch jitter by
+        # K-1, and an 8× on-device term dwarfs a ±0.1 s dispatch swing
+        # (a K=5 run once measured an impossible 99% "MFU" when w1's min
+        # caught a slow dispatch and wK's min a fast one)
+        timed(step9)  # compile
+        w9 = min(timed(step9) for _ in range(4))
         w1 = min(walls)
-        on_device = (w5 - w1) / 4
+        on_device = (w9 - w1) / 8
         if on_device > 0.001:  # degenerate (tunnel jitter): omit, don't lie
             flops = 7.0 * T * T * D * B * H  # 2 fwd + 5 bwd causal matmuls
             kind = jax.devices()[0].device_kind
@@ -462,12 +466,19 @@ def bench_long_context():
                      "TPU v6e": 918e12}
             peak = next((p for k, p in peaks.items()
                          if kind.startswith(k)), None)
-            out.update(on_device_step_s=round(on_device, 4),
-                       achieved_tflops=round(flops / on_device / 1e12, 1),
-                       device_kind=kind)
-            if peak:
-                out["mfu_pct"] = round(
-                    100.0 * flops / on_device / peak, 1)
+            mfu = (100.0 * flops / on_device / peak) if peak else None
+            if mfu is not None and mfu > 80.0:
+                # physically impossible for this kernel (VPU overlap
+                # alone bounds it well under 80%): dispatch jitter
+                # swamped the subtraction — say so instead of lying
+                out["mfu_suspect"] = round(mfu, 1)
+            else:
+                out.update(on_device_step_s=round(on_device, 4),
+                           achieved_tflops=round(
+                               flops / on_device / 1e12, 1),
+                           device_kind=kind)
+                if mfu is not None:
+                    out["mfu_pct"] = round(mfu, 1)
     return out
 
 
@@ -1239,7 +1250,7 @@ def bench_e2e_platform():
     rate_env = os.environ.get("IOTML_BENCH_E2E_RATE", "")
     window_s = float(os.environ.get("IOTML_BENCH_E2E_SECONDS", "20"))
     sweep = [float(r) for r in os.environ.get(
-        "IOTML_BENCH_E2E_SWEEP", "12000,16000,20000,24000").split(",") if r]
+        "IOTML_BENCH_E2E_SWEEP", "12000,15000,18000,21000").split(",") if r]
     sweep_window_s = float(os.environ.get("IOTML_BENCH_E2E_SWEEP_SECONDS",
                                           "8"))
     n_conns = 200
@@ -1278,10 +1289,16 @@ def bench_e2e_platform():
     stop = threading.Event()
     err: list = []
 
+    pump_busy = [0.0, 0.0]  # [busy seconds, records]
+
     def ksql_pump():
         while not stop.is_set():
             try:
-                if platform.sql.pump() == 0:
+                t0 = time.perf_counter()
+                n = platform.sql.pump()
+                pump_busy[0] += time.perf_counter() - t0
+                pump_busy[1] += n
+                if n == 0:
                     time.sleep(0.02)
             except Exception as e:  # noqa: BLE001 - surfaced at the end
                 err.append(f"ksql: {e!r}")
@@ -1824,6 +1841,11 @@ def bench_e2e_platform():
         n_failing_cars=n_failing,
         stages="fleet+mqtt+bridge+ksql(main) | train(tpu proc) | "
                "serve(cpu proc), model loop closed via artifact store",
+        # diagnostics: the KSQL pump's share of the main process (its
+        # busy seconds over the whole e2e wall — the saturation-ceiling
+        # work reads this to see where the shared core goes)
+        ksql_pump_busy_s=round(pump_busy[0], 1),
+        ksql_pump_records=int(pump_busy[1]),
     )
     if pr:
         pr50, pr95 = _percentiles(pr)

@@ -244,11 +244,13 @@ class CarHealthDetector:
                   if self.feature_heads and sf is not None else None)
         ve_mat = (self._fold_all(self.vema, ckeys, sv, starts, counts)
                   if self.feature_heads and sv is not None else None)
-        fire_src = self._head_sources_batch(fe_mat, ve_mat, len(ckeys))
+        # head evidence is unusable through the post-swap fold transient
+        # (suppressed below): skip computing it at all
+        fire_src = ([None] * len(ckeys) if self._recal_hot > 0 else
+                    self._head_sources_batch(fe_mat, ve_mat, len(ckeys)))
         out = []
         now = time.time()
-        for ci, (u, lo, hi) in enumerate(zip(uniq, bounds[:-1],
-                                             bounds[1:])):
+        for ci, (lo, hi) in enumerate(zip(bounds[:-1], bounds[1:])):
             k = ckeys[ci]
             e = self.ema.get(k)
             # fold the car's rows in arrival order: EMA of the sequence
@@ -259,6 +261,19 @@ class CarHealthDetector:
                     e + self.alpha * (float(x) - e)
             self.ema[k] = e
             self.count[k] = self.count.get(k, 0) + int(hi - lo)
+            # head evidence is SUPPRESSED through the post-swap fold
+            # transient (_recal_hot > 0): within one update the fleet
+            # calibration is computed before the folds while the z is
+            # evaluated after them, so a large model swap makes every
+            # freshly-folded car an apparent outlier against the
+            # pre-fold median — evidence that straddles a model
+            # boundary must neither PAGE (new alerts, pinned by
+            # test_swap_notification_recalibrates_through_the_fold_
+            # transient) nor HOLD (clears — a transient fire must not
+            # starve an alerted car's recovery through every hot
+            # window).  The mse path keeps its own-car threshold either
+            # way.
+            hot = self._recal_hot > 0
             src_fire = fire_src[ci]
             if k not in self.alerted:
                 src = None
@@ -283,9 +298,13 @@ class CarHealthDetector:
                 # left such cars in ALERT forever), but never while its
                 # mean error is above the alert threshold itself
                 src0 = self.alert_source.get(k, "")
+                if hot and src0 != "mse":
+                    continue  # defer: head-sourced state frozen while hot
                 mse_bar = (self.threshold * self.clear_ratio
                            if src0 == "mse" else self.threshold)
-                quiet_heads = self._head_source(
+                # during a hot window head evidence can neither page nor
+                # hold: treat it as quiet for mse-sourced clears
+                quiet_heads = hot or self._head_source(
                     k, ratio=self.clear_ratio) is None
                 if e < mse_bar and quiet_heads:
                     src = self.alert_source.pop(k, "")

@@ -376,3 +376,86 @@ def test_head_alerted_car_clears_despite_elevated_mean_ema():
         if cleared:
             break
     assert cleared, (d.alerted, d.alert_source)
+
+
+def test_swap_notification_recalibrates_through_the_fold_transient():
+    """The swap contract: notify_model_swap() opens a hot window that
+    both recalibrates per-update AND suppresses new head alerts through
+    the EMA fold transient — within one update the calibration is
+    computed before the folds while z evaluates after them, so a large
+    swap makes every freshly-folded car an apparent outlier against the
+    pre-fold median.  A 4x fleetwide error shift landing mid-cadence,
+    ABOVE the excess floor, must not page when the swap is notified."""
+    rng = np.random.default_rng(7)
+    F = 4
+    d = CarHealthDetector(threshold=99.0, alpha=0.3, min_records=5,
+                          feature_heads=True, feature_z=8.0,
+                          feature_floor=0.01, feature_tail_k=4.0,
+                          drift_z=1e9)
+    cars = [f"car-{i:03d}".encode() for i in range(25)]
+
+    def drive(n, scale):
+        for _ in range(n):
+            keys = np.array(cars, "S16")
+            ferrs = rng.uniform(0.2, 0.3, (len(cars), F)) * scale
+            d.update(keys, ferrs.mean(axis=1), ferrs=ferrs)
+
+    drive(14, 1.0)   # 14 updates: the shift lands OFF the 4-cadence
+    assert d._updates % d.RECAL_EVERY != 0
+    d.notify_model_swap()
+    assert d._recal_hot > 0
+    drive(14, 4.0)   # post-swap: 4x errors everywhere, floor exceeded
+    assert d.alerted == {}, d.summary()
+
+
+def test_scorer_set_params_notifies_the_detector():
+    """StreamScorer.set_params is the one production swap path — it must
+    open the detector's recalibration hot window."""
+    broker = Broker()
+    broker.create_topic("in")
+    broker.create_topic("out")
+    det = CarHealthDetector(feature_heads=True)
+    scorer = StreamScorer(
+        CAR_AUTOENCODER, None,
+        SensorBatches(StreamConsumer(broker, ["in:0:0"], group="g"),
+                      batch_size=10),
+        OutputSequence(broker, "out", partition=0), carhealth=det)
+    assert det._recal_hot == 0
+    scorer.set_params({"w": 1})
+    assert det._recal_hot > 0
+
+
+def test_hot_window_neither_pages_nor_holds_clears():
+    """Symmetric suppression: during the post-swap hot window,
+    head-sourced state is frozen (no new head alerts, no head-evidence
+    holds), and a recovered head-alerted car clears promptly once the
+    window expires."""
+    rng = np.random.default_rng(9)
+    F = 6
+    d = CarHealthDetector(threshold=99.0, alpha=0.3, min_records=5,
+                          feature_heads=True, feature_z=8.0,
+                          feature_floor=0.01, drift_z=1e9)
+    cars = [f"car-{i:03d}".encode() for i in range(25)]
+    bad = cars[4]
+
+    def drive(n, fault):
+        outs = []
+        for _ in range(n):
+            keys = np.array(cars, "S16")
+            ferrs = rng.uniform(0.02, 0.03, (len(cars), F))
+            if fault:
+                ferrs[4, 2] = 0.9
+            outs += d.update(keys, ferrs.mean(axis=1), ferrs=ferrs)
+        return outs
+
+    drive(20, fault=True)
+    assert bad in d.alerted and d.alert_source[bad].startswith("feature:")
+    # the fault subsides; a swap lands — the hot window must not CLEAR
+    # the car off frozen head state nor page anyone new
+    d.notify_model_swap()
+    hot_out = drive(3, fault=False)
+    assert hot_out == [] and bad in d.alerted
+    # window expires (alpha 0.3 → ~6 hot updates), heads quiet → clear
+    cleared = drive(30, fault=False)
+    assert any(k == bad and s == "CLEAR" for _, k, s, *_ in cleared)
+    assert d.alerted == {}

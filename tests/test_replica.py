@@ -137,3 +137,121 @@ def test_consumer_survives_leader_death_mid_drain():
             srv.server_close()
         except OSError:
             pass
+
+
+class _RejectingSaslServer:
+    """Minimal wire server that ACCEPTS the SASL handshake but then
+    explicitly REJECTS the PLAIN token (non-empty auth response) — the
+    behavior real brokers show for bad credentials, which our fixture
+    server does not (it drops the connection instead).  Counts accepted
+    connections so a test can pin the no-retry contract."""
+
+    def __init__(self):
+        import socket as _socket
+        import struct
+        import threading
+
+        self._struct = struct
+        self.sock = _socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        self.connections = 0
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        struct = self._struct
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            self.connections += 1
+            try:
+                # handshake request frame → OK + ["PLAIN"]
+                (size,) = struct.unpack(">i", conn.recv(4))
+                frame = conn.recv(size)
+                corr = struct.unpack(">i", frame[4:8])[0]
+                body = (struct.pack(">i", corr) + struct.pack(">h", 0)
+                        + struct.pack(">i", 1)
+                        + struct.pack(">h", 5) + b"PLAIN")
+                conn.sendall(struct.pack(">i", len(body)) + body)
+                # raw token frame → explicit non-empty REJECTION
+                (size,) = struct.unpack(">i", conn.recv(4))
+                conn.recv(size)
+                conn.sendall(struct.pack(">i", 4) + b"nope")
+            except OSError:
+                pass
+            finally:
+                conn.close()
+
+    def close(self):
+        self.sock.close()
+
+
+def test_failover_rejects_bad_credentials_without_retry_spam():
+    """An EXPLICIT SASL rejection raises SaslAuthError immediately and
+    is NOT retried against the rest of the bootstrap list — wrong
+    credentials are wrong everywhere, and retrying them fleet-wide is
+    auth-failure spam (the pre-fix client did exactly that, leaking one
+    socket per server on the way)."""
+    from iotml.stream.kafka_wire import SaslAuthError
+
+    a, b = _RejectingSaslServer(), _RejectingSaslServer()
+    try:
+        with pytest.raises(SaslAuthError):
+            KafkaWireBroker(f"127.0.0.1:{a.port},127.0.0.1:{b.port}",
+                            sasl_username="svc", sasl_password="wrong")
+        # the FIRST server rejected; the second must never see a try
+        assert a.connections == 1 and b.connections == 0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_bad_credentials_against_fixture_server_fail_closed():
+    """The fixture server drops bad-token connections (pre-KIP-152):
+    construction must still fail (as connectivity), and correct
+    credentials must work."""
+    broker = Broker()
+    broker.create_topic("T")
+    srv = KafkaWireServer(broker, credentials=("svc", "right")).start()
+    try:
+        with pytest.raises(ConnectionError):
+            KafkaWireBroker(f"127.0.0.1:{srv.port}",
+                            sasl_username="svc", sasl_password="wrong")
+        good = KafkaWireBroker(f"127.0.0.1:{srv.port}",
+                               sasl_username="svc", sasl_password="right")
+        assert good.topics() == ["T"]
+        good.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_failover_survives_mid_request_reconnect_with_sasl():
+    """The failover path re-authenticates: a SASL-protected pair, leader
+    dies, the client's next request reconnects to the follower (which is
+    open — fixture semantics) or errors cleanly; with both servers
+    credentialed the request succeeds after re-auth."""
+    broker = Broker()
+    broker.create_topic("T")
+    broker.produce("T", b"x", key=b"k")
+    srv_a = KafkaWireServer(broker, credentials=("svc", "pw")).start()
+    srv_b = KafkaWireServer(broker, credentials=("svc", "pw")).start()
+    try:
+        client = KafkaWireBroker(
+            f"127.0.0.1:{srv_a.port},127.0.0.1:{srv_b.port}",
+            sasl_username="svc", sasl_password="pw")
+        assert client.end_offset("T", 0) == 1
+        srv_a.kill()
+        # next request fails over to B and re-runs the SASL handshake
+        assert client.end_offset("T", 0) == 1
+        client.close()
+    finally:
+        for s in (srv_a, srv_b):
+            try:
+                s.shutdown()
+                s.server_close()
+            except OSError:
+                pass
