@@ -228,6 +228,14 @@ def _flash_kernel_tri(im_ref, jm_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     # systolic passes, f32 accumulation, f32 softmax, P cast for P·V
     s = jax.lax.dot_general(q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
+
+    # the elementwise causal mask runs on EVERY tile even though only
+    # diagonal-straddling tiles need it: branch-specializing it behind a
+    # lax.cond was MEASURED SLOWER (54.2% vs 57.7% MFU same-session —
+    # the cond defeats Mosaic's fusion/pipelining and, in the backward,
+    # the duplicated branch temporaries blow the 16 MB scoped-VMEM
+    # budget at 1024^2 tiles).  Roofline lever 3 stays on the table via
+    # cheaper masks, not control flow.
     qi = jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0) + i * block_q
     kj = jax.lax.broadcasted_iota(
@@ -363,7 +371,9 @@ def _bwd_common(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
     Matmul dtype policy mirrors the forward: score/dP matmuls run at the
     input dtype (exact products for bf16, MXU bf16 rate, f32 accumulate);
     p/ds stay f32 — they are exp-of-f32 quantities the gradient
-    tolerances pin."""
+    tolerances pin.  The mask runs on every tile: branch-specializing it
+    (lax.cond on straddle/tail tiles) was measured slower AND blew the
+    scoped-VMEM budget at 1024^2 tiles — see the forward kernel's note."""
     s = jax.lax.dot_general(q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     qi = jax.lax.broadcasted_iota(
