@@ -9,6 +9,9 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+echo "== 0/3 concurrency & protocol-invariant lint (iotml.analysis)"
+python -m iotml.analysis lint
+
 echo "== 1/3 validate manifests against the codebase"
 python deploy/validate_manifests.py
 

@@ -1,0 +1,27 @@
+"""iotml.analysis — project-wide concurrency & protocol-invariant checker.
+
+The hot paths of this framework — MQTT broker, Kafka wire server/client,
+follower replica, group coordinator, stream-proc pump — are hand-rolled
+threaded code.  Their pipeline invariants (monotonic timeout clocks,
+idempotent-only auto-retry, context-managed locks, no blocking I/O under
+a broker lock, engine-owned topic write exclusivity) are machine-checked
+here rather than left as tribal knowledge:
+
+- ``lint``      AST lint pass over the tree: rules R1-R5, run via
+                ``python -m iotml.analysis lint`` (exit 1 on findings).
+- ``lockcheck`` runtime lock-order & race detector: an instrumented
+                ``threading.Lock``/``RLock`` wrapper that records the
+                per-thread lock-acquisition graph, fails on cycles
+                (deadlock potential), flags locks held across blocking
+                I/O, and tags unguarded mutations of registered shared
+                state from non-owner threads.  Enable for a pytest run
+                with ``IOTML_LOCKCHECK=1`` or
+                ``-p iotml.analysis.pytest_plugin``.
+- the C++ edge is covered by TSan/ASan build targets instead
+  (``make -C iotml/cpp sanitize``).
+
+See ARCHITECTURE.md §analysis for the rule table, how to add a rule, and
+how to suppress a finding with justification.
+"""
+
+from .lint import Finding, RULES, lint_paths  # noqa: F401

@@ -1,0 +1,56 @@
+"""``python -m iotml.analysis`` — run the project checkers.
+
+    python -m iotml.analysis lint [PATH ...] [--rule R2 --rule R4]
+    python -m iotml.analysis rules
+
+``lint`` defaults to the iotml package tree and exits 1 when any finding
+survives (0 on a clean tree), printing ``path:line: RULE message`` per
+finding — the format editors and CI annotate from.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .lint import RULES, default_root, lint_paths
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m iotml.analysis",
+        description="concurrency & protocol-invariant checkers")
+    sub = ap.add_subparsers(dest="cmd")
+
+    lp = sub.add_parser("lint", help="run the AST lint pass (R1-R5)")
+    lp.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the iotml package)")
+    lp.add_argument("--rule", action="append", dest="rules", metavar="RN",
+                    choices=sorted(RULES),
+                    help="restrict to specific rules (repeatable)")
+    lp.add_argument("--quiet", action="store_true",
+                    help="suppress the summary line")
+
+    sub.add_parser("rules", help="print the rule table")
+
+    args = ap.parse_args(argv)
+    if args.cmd == "rules":
+        for rid in sorted(RULES):
+            print(f"{rid}  {RULES[rid]}")
+        return 0
+    if args.cmd != "lint":
+        ap.print_help()
+        return 2
+
+    paths = args.paths or [default_root()]
+    findings = lint_paths(paths, set(args.rules) if args.rules else None)
+    for f in findings:
+        print(f)
+    if not args.quiet:
+        print(f"iotml.analysis lint: {len(findings)} finding(s) over "
+              f"{', '.join(paths)}", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,389 @@
+"""AST lint pass — repo-specific concurrency & protocol invariants.
+
+Rules (see ARCHITECTURE.md §analysis for the full table):
+
+  R1  no non-monotonic clocks: ``time.time()`` is forbidden in the
+      stream/mqtt wire, broker and replica modules — deadlines and
+      timeouts there must use ``time.monotonic()`` (a wall-clock step,
+      e.g. NTP, must never extend or collapse a protocol timeout).
+      Legitimate wall-clock reads (record timestamps, uptime stats)
+      carry ``# wallclock-ok: <reason>``.
+  R2  every ``KafkaWireBroker._request`` call site must name an API
+      from the IDEMPOTENT_APIS allowlist *by constant name* or carry a
+      ``# retry-ok: <reason>`` justification acknowledging the
+      non-idempotent delivery contract (the client auto-retries only
+      allowlisted APIs after a reconnect; everything else surfaces
+      ConnectionError — kafka_wire.py).
+  R3  no bare ``.acquire()`` on locks: context-manager (``with``) only,
+      so the runtime lockcheck sees every hold and release is
+      exception-safe.
+  R4  no blocking call (``recv``/``recv_into``/``recv_exact``/
+      ``accept``/``sleep``/``select``) while a lock is held — checked
+      by a call-graph walk within the module, so a helper that blocks
+      three frames down is still caught.
+  R5  engine-owned topics (``SENSOR_DATA_S_AVRO*``) may only be
+      produced from ``streamproc/`` — the broker enforces this at
+      runtime (Broker.restrict_topic); the lint closes it by
+      construction.
+
+Suppression: append ``# lint-ok: RN <reason>`` to the flagged line (for
+R4, to the ``with`` line holding the lock).  A suppression WITHOUT a
+reason is itself a finding — justifications are the point.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+# APIs the wire client may auto-retry after a reconnect: a duplicate of
+# any of these is invisible (reads) or a no-op (liveness signal).  Kept
+# in sync with kafka_wire.IDEMPOTENT_APIS by tests/test_analysis.py.
+IDEMPOTENT_API_NAMES = frozenset({
+    "FETCH", "METADATA", "LIST_OFFSETS", "OFFSET_FETCH",
+    "API_VERSIONS", "SASL_HANDSHAKE", "HEARTBEAT",
+})
+
+# R5: topics written exclusively by the stream-proc engine (the AVRO leg
+# and everything derived from it) — prefix match, like the broker's
+# runtime restriction.
+ENGINE_OWNED_TOPIC_PREFIXES = ("SENSOR_DATA_S_AVRO",)
+
+# R4: calls that park the thread.  Send-side calls (sendall) are
+# deliberately not listed: writing under a write-lock is the normal way
+# to keep frames atomic, and the kernel buffer usually absorbs it.
+BLOCKING_CALLS = frozenset({
+    "recv", "recv_into", "recv_exact", "accept", "sleep", "select",
+})
+
+# R1 applies to modules under these path segments (the wire/broker/
+# replica/timeout paths); the rest of the tree may use wall clocks.
+R1_PATH_SEGMENTS = ("stream", "mqtt")
+
+RULES: Dict[str, str] = {
+    "R1": "non-monotonic clock (time.time) in wire/broker/replica code; "
+          "use time.monotonic() or annotate '# wallclock-ok: <reason>'",
+    "R2": "_request call site must name an IDEMPOTENT_APIS constant or "
+          "carry '# retry-ok: <reason>'",
+    "R3": "bare Lock.acquire(); hold locks via 'with' only",
+    "R4": "blocking call while a lock is held (module call-graph walk)",
+    "R5": "engine-owned topic produced outside streamproc/",
+}
+
+_SUPPRESS_RE = re.compile(r"#\s*lint-ok:\s*(R\d)\b[ \t]*(.*)")
+_RETRY_OK_RE = re.compile(r"#\s*retry-ok:[ \t]*(.*)")
+_WALLCLOCK_RE = re.compile(r"#\s*wallclock-ok:[ \t]*(.*)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+class _Suppressions:
+    """Per-file suppression comments, and the findings malformed ones
+    produce (a suppression without a reason is flagged, not honored)."""
+
+    def __init__(self, path: str, source: str):
+        self.by_rule: Dict[str, Set[int]] = {}
+        self.retry_ok: Set[int] = set()
+        self.wallclock_ok: Set[int] = set()
+        self.findings: List[Finding] = []
+        self.comment_only: Set[int] = set()
+        for i, text in enumerate(source.splitlines(), start=1):
+            if text.lstrip().startswith("#"):
+                self.comment_only.add(i)
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                rule, reason = m.group(1), m.group(2).strip()
+                if not reason:
+                    self.findings.append(Finding(
+                        path, i, rule,
+                        "suppression without justification: write "
+                        f"'# lint-ok: {rule} <why this is safe>'"))
+                else:
+                    self.by_rule.setdefault(rule, set()).add(i)
+            m = _RETRY_OK_RE.search(text)
+            if m:
+                if not m.group(1).strip():
+                    self.findings.append(Finding(
+                        path, i, "R2",
+                        "retry-ok without justification: write "
+                        "'# retry-ok: <redelivery story>'"))
+                else:
+                    self.retry_ok.add(i)
+            m = _WALLCLOCK_RE.search(text)
+            if m:
+                if not m.group(1).strip():
+                    self.findings.append(Finding(
+                        path, i, "R1",
+                        "wallclock-ok without justification: write "
+                        "'# wallclock-ok: <why wall time is correct>'"))
+                else:
+                    self.wallclock_ok.add(i)
+
+    def _effective_lines(self, node: ast.AST) -> Iterable[int]:
+        """The node's own span, plus the contiguous pure-comment block
+        immediately above it — where multi-line justifications live."""
+        first = node.lineno
+        last = getattr(node, "end_lineno", first)
+        lines = list(range(first, last + 1))
+        ln = first - 1
+        while ln in self.comment_only:
+            lines.append(ln)
+            ln -= 1
+        return lines
+
+    def suppressed(self, rule: str, node: ast.AST) -> bool:
+        marked = self.by_rule.get(rule, set())
+        if rule == "R1":
+            marked = marked | self.wallclock_ok
+        return any(ln in marked for ln in self._effective_lines(node))
+
+    def retry_justified(self, node: ast.AST) -> bool:
+        return any(ln in self.retry_ok
+                   for ln in self._effective_lines(node))
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    """Terminal name of the called thing: foo() → foo, a.b.foo() → foo."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _is_time_time(node: ast.Call) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == "time"
+            and isinstance(f.value, ast.Name) and f.value.id == "time")
+
+
+def _lockish_name(expr: ast.expr) -> Optional[str]:
+    """Terminal identifier of a with-item if it names a lock."""
+    e = expr
+    if isinstance(e, ast.Call):  # e.g. broker.producer_grant(tok) — not a lock
+        return None
+    name = None
+    if isinstance(e, ast.Attribute):
+        name = e.attr
+    elif isinstance(e, ast.Name):
+        name = e.id
+    if name is not None and "lock" in name.lower():
+        return name
+    return None
+
+
+# --------------------------------------------------------------- R4 engine
+class _ModuleCallGraph:
+    """Module-local may-block analysis.
+
+    Functions are indexed by bare name (methods too — self-dispatch within
+    a module resolves by name; cross-class collisions make the analysis
+    conservative, which errs toward flagging).  A function "may block" if
+    its body contains a BLOCKING_CALLS call or a call to a module function
+    that (transitively) may block.
+    """
+
+    def __init__(self, tree: ast.Module):
+        self.bodies: Dict[str, ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # first definition wins; duplicates would only make the
+                # result depend on dict order
+                self.bodies.setdefault(node.name, node)
+        self._memo: Dict[str, Optional[str]] = {}
+
+    def blocking_reason(self, func_name: str,
+                        _visiting: Optional[Set[str]] = None
+                        ) -> Optional[str]:
+        """None, or 'calls recv (net.py-style helper chain)' style text."""
+        if func_name in self._memo:
+            return self._memo[func_name]
+        body = self.bodies.get(func_name)
+        if body is None:
+            return None
+        _visiting = _visiting or set()
+        if func_name in _visiting:
+            return None  # recursion: already being decided
+        _visiting.add(func_name)
+        self._memo[func_name] = None  # break cycles pessimistically-clean
+        reason = None
+        for node in ast.walk(body):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            if name in BLOCKING_CALLS:
+                reason = f"{func_name}() calls blocking {name}()"
+                break
+            if name and name != func_name and name in self.bodies:
+                inner = self.blocking_reason(name, _visiting)
+                if inner:
+                    reason = f"{func_name}() -> {inner}"
+                    break
+        self._memo[func_name] = reason
+        return reason
+
+
+# ----------------------------------------------------------------- checker
+class _FileLinter(ast.NodeVisitor):
+    def __init__(self, path: str, rel: str, tree: ast.Module,
+                 sup: _Suppressions, rules: Set[str]):
+        self.path = path
+        self.rel = rel
+        self.sup = sup
+        self.rules = rules
+        self.findings: List[Finding] = list(sup.findings)
+        self.graph = _ModuleCallGraph(tree) if "R4" in rules else None
+        parts = rel.replace(os.sep, "/").split("/")
+        self.r1_scoped = any(seg in parts for seg in R1_PATH_SEGMENTS)
+        self.in_streamproc = "streamproc" in parts
+        self._lock_stack: List[Tuple[str, int, bool]] = []  # (name, line, suppressed)
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        if rule not in self.rules or self.sup.suppressed(rule, node):
+            return
+        self.findings.append(Finding(self.path, node.lineno, rule, message))
+
+    # R4 needs with-scope tracking, so visit With explicitly
+    def visit_With(self, node: ast.With) -> None:
+        held = []
+        for item in node.items:
+            name = _lockish_name(item.context_expr)
+            if name is not None:
+                held.append((name, node.lineno,
+                             self.sup.suppressed("R4", node)))
+        self._lock_stack.extend(held)
+        self.generic_visit(node)
+        del self._lock_stack[len(self._lock_stack) - len(held):]
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _call_name(node)
+
+        # R1 — wall clock in wire/broker/replica code
+        if self.r1_scoped and _is_time_time(node):
+            self._emit("R1", node,
+                       "time.time() in wire/broker/replica code: use "
+                       "time.monotonic() for deadlines/timeouts, or "
+                       "annotate '# wallclock-ok: <reason>' for real "
+                       "wall-clock reads (timestamps, uptime)")
+
+        # R2 — _request call sites
+        if name == "_request" and isinstance(node.func, ast.Attribute):
+            api = node.args[0] if node.args else None
+            api_name = api.id if isinstance(api, ast.Name) else None
+            if api_name not in IDEMPOTENT_API_NAMES \
+                    and not self.sup.retry_justified(node):
+                shown = api_name or ast.unparse(api) if api else "<missing>"
+                self._emit("R2", node,
+                           f"_request({shown}, ...) is not on the "
+                           "IDEMPOTENT_APIS allowlist: a reconnect will NOT "
+                           "auto-retry it; add '# retry-ok: <redelivery "
+                           "story>' acknowledging the contract")
+
+        # R3 — bare acquire
+        if name == "acquire" and isinstance(node.func, ast.Attribute):
+            self._emit("R3", node,
+                       "bare .acquire(): hold locks with 'with <lock>:' so "
+                       "release is exception-safe and the runtime lockcheck "
+                       "sees the hold")
+
+        # R4 — blocking under a held lock
+        if self._lock_stack and name is not None:
+            active = [(n, ln) for n, ln, suppressed in self._lock_stack
+                      if not suppressed]
+            if active:
+                reason = None
+                if name in BLOCKING_CALLS:
+                    reason = f"blocking {name}()"
+                elif self.graph is not None and name in self.graph.bodies:
+                    inner = self.graph.blocking_reason(name)
+                    if inner:
+                        reason = inner
+                if reason is not None:
+                    lock_name, lock_line = active[-1]
+                    self._emit("R4", node,
+                               f"{reason} while holding {lock_name} "
+                               f"(acquired line {lock_line}): a stalled "
+                               "peer parks every thread contending this "
+                               "lock")
+
+        # R5 — engine-owned topic produced outside streamproc/
+        if not self.in_streamproc and name in ("produce", "produce_many",
+                                               "produce_batch"):
+            topic = None
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                topic = node.args[0].value
+            for kw in node.keywords:
+                if kw.arg == "topic" and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    topic = kw.value.value
+            if topic is not None and \
+                    topic.startswith(ENGINE_OWNED_TOPIC_PREFIXES):
+                self._emit("R5", node,
+                           f"produce to engine-owned topic {topic!r} outside "
+                           "streamproc/: the AVRO leg is written exclusively "
+                           "by the stream-proc engine (trusted_passthrough "
+                           "soundness; Broker.restrict_topic enforces this "
+                           "at runtime)")
+
+        self.generic_visit(node)
+
+
+# --------------------------------------------------------------- driver
+def _iter_py_files(paths: Iterable[str]) -> Iterable[Tuple[str, str]]:
+    """Yield (abs_path, display_rel_path) for every .py under `paths`."""
+    skip_dirs = {"__pycache__", "build", ".git", ".venv", "node_modules"}
+    for root in paths:
+        root = os.path.abspath(root)
+        if os.path.isfile(root):
+            yield root, os.path.basename(root)
+            continue
+        base = os.path.dirname(root)
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames if d not in skip_dirs)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    p = os.path.join(dirpath, fn)
+                    yield p, os.path.relpath(p, base)
+
+
+def lint_file(path: str, rel: Optional[str] = None,
+              rules: Optional[Set[str]] = None) -> List[Finding]:
+    rel = rel if rel is not None else path
+    rules = rules or set(RULES)
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, "PARSE", f"syntax error: {e.msg}")]
+    sup = _Suppressions(path, source)
+    linter = _FileLinter(path, rel, tree, sup, rules)
+    linter.visit(tree)
+    return sorted(linter.findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def lint_paths(paths: Iterable[str],
+               rules: Optional[Set[str]] = None) -> List[Finding]:
+    out: List[Finding] = []
+    for path, rel in _iter_py_files(paths):
+        out.extend(lint_file(path, rel, rules))
+    return out
+
+
+def default_root() -> str:
+    """The iotml package directory this module is part of."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -1,0 +1,41 @@
+"""pytest plugin: run the suite under the runtime lock-order detector.
+
+Activate with ``-p iotml.analysis.pytest_plugin`` or ``IOTML_LOCKCHECK=1``
+(tests/conftest.py registers this module when the env var is set).  The
+detector is installed at configure time — before any test constructs a
+broker/server — so every lock the stream stack creates is checked.
+
+At session end the collected report is printed; **lock-order cycles fail
+the run** (exit status 3).  I/O-under-lock and unguarded-mutation
+findings are reported as warnings only, unless ``IOTML_LOCKCHECK_STRICT=1``
+promotes them to failures too.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import lockcheck
+
+
+def pytest_configure(config):
+    lockcheck.install()
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    st = lockcheck.state()
+    if st is None:
+        return
+    tw = terminalreporter
+    tw.section("iotml lockcheck")
+    tw.write_line(st.report())
+
+
+def pytest_sessionfinish(session, exitstatus):
+    st = lockcheck.state()
+    if st is None:
+        return
+    strict = os.environ.get("IOTML_LOCKCHECK_STRICT", "") not in ("", "0")
+    failures = st.violations if strict else st.cycles()
+    if failures:
+        session.exitstatus = 3

@@ -82,9 +82,15 @@ class Platform:
         # this same engine encodes in-process, so re-validating every
         # pass-through payload would only re-check the engine's own
         # encoder output (external producers still validate — the flag
-        # narrows itself to engine-produced sources)
+        # narrows itself to engine-produced sources).  The soundness
+        # premise — only the engine writes the AVRO leg — is ENFORCED,
+        # not inferred: the broker marks SENSOR_DATA_S_AVRO* engine-owned
+        # and rejects produces without the engine's grant; a wire/native
+        # client with SASL creds gets TOPIC_AUTHORIZATION_FAILED instead
+        # of silently forking the validated stream (ADVICE.md round-5).
+        owner = self.broker.restrict_topic("SENSOR_DATA_S_AVRO")
         self.sql = SqlEngine(self.broker, registry=self.registry,
-                             trusted_passthrough=True)
+                             trusted_passthrough=True, owner_token=owner)
         install_reference_pipeline(self.sql)
         self.ksql = KsqlServer(self.sql, host=host, port=ksql_port)
 

@@ -197,8 +197,14 @@ def load_config(argv: Optional[Sequence[str]] = None,
                 _apply(cfg, f"{section}.{field}", value, applied)
 
     sections = {f.name for f in dataclasses.fields(cfg)}
+    # process-level toggles that are NOT config: the test platform pin
+    # (tests/conftest.py) and the runtime lock-order detector switches
+    # (iotml.analysis.lockcheck) ride the IOTML_ prefix but configure the
+    # harness around the process, not the pipeline inside it
+    non_config = {"IOTML_CONFIG", "IOTML_TEST_PLATFORM",
+                  "IOTML_LOCKCHECK", "IOTML_LOCKCHECK_STRICT"}
     for key, value in env.items():
-        if not key.startswith("IOTML_") or key == "IOTML_CONFIG":
+        if not key.startswith("IOTML_") or key in non_config:
             continue
         rest = key[len("IOTML_"):].lower()
         section, _, field = rest.partition("_")

@@ -34,7 +34,7 @@ class Session:
         self.client_id = client_id
         self.deliver = deliver
         self.clean_start = clean_start
-        self.connected_at = time.time()
+        self.connected_at = time.time()  # wallclock-ok: display stat (when the session connected), not a timeout
         # Last Will from CONNECT: (topic, payload, qos, retain) published on
         # abnormal disconnect (socket drop, keepalive timeout, protocol
         # violation, session takeover), DISCARDED on clean DISCONNECT.
@@ -270,7 +270,9 @@ class MqttBroker:
                     # otherwise-quiet broker
                     delay = min(cur.will_delay_s,
                                 self.offline_session_expiry_s)
-                    delayed = (will, time.time() + delay)
+                    # monotonic domain: will/session deadlines must not
+                    # stretch or collapse on a wall-clock step (NTP)
+                    delayed = (will, time.monotonic() + delay)
                     will = None
                 if cur.clean_start:
                     self._tree.unsubscribe_all(client_id)
@@ -281,7 +283,7 @@ class MqttBroker:
                     q = deque(cur.pending or (),
                               maxlen=self.offline_queue_limit)
                     self._offline[client_id] = [
-                        q, time.time() + self.offline_session_expiry_s,
+                        q, time.monotonic() + self.offline_session_expiry_s,
                         cur.qos2_inbound, delayed]
                     if delayed is not None:
                         self._arm_will_timer(delayed[1])
@@ -298,7 +300,7 @@ class MqttBroker:
         wills (v5 will-delay-interval) for the CALLER to publish after
         releasing _lock — fan-out under the broker lock would let one slow
         subscriber socket stall every connect/disconnect/publish."""
-        now = time.time()
+        now = time.monotonic()
         due_wills = []
         dead = []
         for cid, entry in self._offline.items():
@@ -320,7 +322,7 @@ class MqttBroker:
         if self._will_timer is not None:
             self._will_timer.cancel()
         self._will_timer_due = due_time
-        t = threading.Timer(max(due_time - time.time(), 0.0),
+        t = threading.Timer(max(due_time - time.monotonic(), 0.0),
                             self._sweep_due_wills)
         t.daemon = True
         t.start()
@@ -416,7 +418,7 @@ class MqttBroker:
         live: List[Tuple[Session, int]] = []
         due_wills: list = []
         with self._lock:  # routing + queue mutation atomic; delivery after
-            now = time.time()
+            now = time.monotonic()
             if now >= self._next_offline_sweep:
                 due_wills = self._expire_offline()
                 self._next_offline_sweep = now + 5.0

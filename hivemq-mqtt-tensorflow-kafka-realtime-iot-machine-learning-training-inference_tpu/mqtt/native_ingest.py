@@ -152,7 +152,7 @@ class NativeIngestBridge:
         batch = self.ingest.poll(timeout_ms)
         if not batch:
             return 0
-        ts = int(time.time() * 1000)
+        ts = int(time.time() * 1000)  # wallclock-ok: record timestamp, not a timeout
         matches = self._matches
         entries = [(topic, payload, ts) for topic, payload in batch
                    if matches(topic)]
@@ -191,8 +191,8 @@ class NativeIngestBridge:
         # reporting).  Bounded: quiesce publishers before stop, or the
         # deadline cuts the drain off.
         idle = 0
-        deadline = time.time() + 30
-        while idle < 2 and time.time() < deadline:
+        deadline = time.monotonic() + 30
+        while idle < 2 and time.monotonic() < deadline:
             idle = idle + 1 if self.pump_once(timeout_ms=0) == 0 else 0
         self.ingest.close()
 

@@ -274,10 +274,25 @@ class StreamScorer:
     def run_forever(self, poll_interval_s: float = 0.2,
                     max_rounds: Optional[int] = None):
         """The long-lived loop the reference's restart-the-pod pattern
-        approximates.  max_rounds bounds it for tests."""
+        approximates.  max_rounds bounds it for tests.
+
+        Failover: the wire client does NOT auto-retry non-idempotent
+        produce/commit after a reconnect (kafka_wire._request) — a broker
+        death mid-drain surfaces ConnectionError here, already
+        reconnected to the next bootstrap server.  This loop is the
+        opt-in redelivery point the contract requires: rewind the input
+        to the committed offsets and re-drain.  Output duplicates are
+        benign — predictions are keyed by global index (see class
+        docstring), the same at-least-once window a crash-restart has."""
         rounds = 0
         while max_rounds is None or rounds < max_rounds:
-            n = self.score_available()
+            try:
+                n = self.score_available()
+            except ConnectionError:
+                self.batches.consumer.rewind_to_committed()
+                rounds += 1
+                time.sleep(poll_interval_s)
+                continue
             rounds += 1
             if n == 0:
                 time.sleep(poll_interval_s)

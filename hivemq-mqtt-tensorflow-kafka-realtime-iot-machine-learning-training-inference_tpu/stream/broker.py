@@ -21,10 +21,29 @@ the lock-free hot path.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import threading
 import zlib
 from typing import Dict, List, NamedTuple, Optional
+
+
+class TopicOwnershipError(PermissionError):
+    """Produce to an engine-owned topic without the owner's grant.
+
+    Engine-owned topics (the stream-proc AVRO leg and its derivatives)
+    are written exclusively by the owning engine — that exclusivity is
+    what makes trusted_passthrough sound (the engine skips re-validating
+    bytes only its own validating encoder could have written).  The wire
+    server maps this to Kafka's TOPIC_AUTHORIZATION_FAILED."""
+
+
+# Thread-local produce grants: a thread pumping an owning engine enters
+# `producer_grant(token)` and may produce to the topics that token
+# restricts; every other producer is rejected.  Thread-local (not an
+# instance flag) so a grant cannot leak across the wire server's
+# handler threads.
+_grants = threading.local()
 
 
 class Message(NamedTuple):
@@ -69,6 +88,45 @@ class Broker:
         self._parts: Dict[str, List[_Partition]] = {}
         self._group_offsets: Dict[tuple, int] = {}  # (group, topic, part) → next offset
         self._rr: Dict[str, int] = {}  # round-robin cursor per topic
+        self._owned: Dict[str, object] = {}  # topic prefix → owner token
+
+    # --------------------------------------------------------- ownership
+    def restrict_topic(self, prefix: str,
+                       token: Optional[object] = None) -> object:
+        """Mark every topic named `prefix`* engine-owned: produces are
+        rejected (TopicOwnershipError) unless the calling thread holds
+        the returned token via `producer_grant`.  Reads, commits and
+        topic creation stay open — the invariant is write exclusivity."""
+        token = token if token is not None else object()
+        with self._lock:
+            self._owned[prefix] = token
+        return token
+
+    @contextlib.contextmanager
+    def producer_grant(self, token: object):
+        """Authorize this thread to produce to the topics `token`
+        restricts for the duration of the block (re-entrant)."""
+        held = getattr(_grants, "tokens", None)
+        if held is None:
+            held = _grants.tokens = []
+        held.append(token)
+        try:
+            yield self
+        finally:
+            held.pop()
+
+    def _check_producer(self, topic: str) -> None:
+        if not self._owned:
+            return
+        with self._lock:  # snapshot: restrict_topic may race a produce
+            owned = list(self._owned.items())
+        for prefix, token in owned:
+            if topic.startswith(prefix) and \
+                    token not in getattr(_grants, "tokens", ()):
+                raise TopicOwnershipError(
+                    f"topic {topic!r} is engine-owned (prefix {prefix!r}): "
+                    f"produce requires the owner's grant "
+                    f"(Broker.producer_grant)")
 
     # ------------------------------------------------------------- topics
     def create_topic(self, name: str, partitions: int = 1,
@@ -110,6 +168,7 @@ class Broker:
         """Append one record; returns its offset. Auto-creates 1-partition
         topics (matching Kafka's auto.create default used by the reference's
         local demos)."""
+        self._check_producer(topic)
         if topic not in self._topics:
             self.create_topic(topic)
         with self._lock:
@@ -141,6 +200,7 @@ class Broker:
         per-record semantics as produce() (key-hash partitioning,
         retention trimming) — minus a lock round-trip and method dispatch
         per message, the ingest bridges' hot path."""
+        self._check_producer(topic)
         entries = list(entries)
         if topic not in self._topics:
             self.create_topic(topic)
@@ -210,7 +270,12 @@ class Broker:
 
     # ------------------------------------------------- consumer-group API
     def commit(self, group: str, topic: str, partition: int, next_offset: int):
-        self._group_offsets[(group, topic, partition)] = next_offset
+        # under the broker lock like every other mutation: a dict store is
+        # atomic under the GIL, but the lockcheck race detector (rightly)
+        # has no way to prove that, and free-threaded builds won't either
+        with self._lock:
+            self._group_offsets[(group, topic, partition)] = next_offset
 
     def committed(self, group: str, topic: str, partition: int) -> Optional[int]:
-        return self._group_offsets.get((group, topic, partition))
+        with self._lock:
+            return self._group_offsets.get((group, topic, partition))

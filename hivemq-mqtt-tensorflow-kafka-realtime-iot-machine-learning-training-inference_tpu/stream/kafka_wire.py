@@ -51,6 +51,7 @@ ERR_UNKNOWN_TOPIC = 3
 ERR_ILLEGAL_GENERATION = 22
 ERR_UNKNOWN_MEMBER_ID = 25
 ERR_REBALANCE_IN_PROGRESS = 27
+ERR_TOPIC_AUTHORIZATION_FAILED = 29
 ERR_UNSUPPORTED_VERSION = 35
 ERR_TOPIC_EXISTS = 36
 ERR_SASL_AUTH_FAILED = 58
@@ -61,6 +62,16 @@ _SUPPORTED = {PRODUCE: (2, 2), FETCH: (2, 2), LIST_OFFSETS: (1, 1),
               HEARTBEAT: (0, 0), LEAVE_GROUP: (0, 0), SYNC_GROUP: (0, 0),
               SASL_HANDSHAKE: (0, 0), API_VERSIONS: (0, 0),
               CREATE_TOPICS: (0, 0)}
+
+# APIs the client may auto-retry after a reconnect (see _request): a
+# duplicate of any of these is invisible (pure reads) or a no-op
+# (liveness signal).  Everything else — produce, offset-commit, topic
+# creation, group membership changes — may have been APPLIED by the dead
+# server before it died, so a blind retry double-applies; those surface
+# ConnectionError and the caller owns redelivery.  The R2 lint
+# (iotml.analysis) holds every _request call site to this list.
+IDEMPOTENT_APIS = frozenset({FETCH, METADATA, LIST_OFFSETS, OFFSET_FETCH,
+                             API_VERSIONS, SASL_HANDSHAKE, HEARTBEAT})
 
 
 class SaslAuthError(ConnectionError):
@@ -448,16 +459,26 @@ class KafkaWireBroker(ProducePartitionMixin):
         return corr, self._recv_frame()
 
     def _request(self, api_key: int, api_version: int, body: bytes) -> _Reader:
+        # lint-ok: R4 single-socket client by design: requests are
+        # serialized over one connection and every socket op is bounded by
+        # timeout_s, so a stalled broker parks callers for at most that.
         with self._lock:
             try:
                 corr, resp = self._exchange(api_key, api_version, body)
-            except OSError:
-                # dead server: fail over across the bootstrap list and
-                # retry ONCE.  Retried non-idempotent requests (produce,
-                # commit) may double-apply if the dead server processed
-                # them before dying — at-least-once, the same delivery
-                # contract the pipeline already documents.
+            except OSError as e:
+                # dead server: fail over across the bootstrap list, then
+                # retry ONCE — but only IDEMPOTENT_APIS.  The dead server
+                # may have applied the request before dying, so retrying
+                # produce/commit would double-apply records/offsets; those
+                # surface ConnectionError (on a now-reconnected client) and
+                # the caller opts into redelivery explicitly.
                 self._connect_any()
+                if api_key not in IDEMPOTENT_APIS:
+                    raise ConnectionError(
+                        f"connection lost during non-idempotent request "
+                        f"(api_key={api_key}); not auto-retried — the dead "
+                        f"server may have applied it.  Reconnected; the "
+                        f"caller decides whether to redeliver.") from e
                 corr, resp = self._exchange(api_key, api_version, body)
         r = _Reader(resp)
         got = r.i32()
@@ -539,6 +560,8 @@ class KafkaWireBroker(ProducePartitionMixin):
 
         w.array([None], one)
         w.i32(10_000)  # timeout ms
+        # retry-ok: not auto-retried; a lost CreateTopics surfaces
+        # ConnectionError and re-issuing is safe (TOPIC_EXISTS handled below)
         r = self._request(CREATE_TOPICS, 0, bytes(w.buf))
         errs = r.array(lambda rd: (rd.string(), rd.i16()))
         existed = False
@@ -579,6 +602,8 @@ class KafkaWireBroker(ProducePartitionMixin):
             wr.string(topic).array(sorted(by_part.items()), part_entry)
 
         w.array([None], topic_entry)
+        # retry-ok: produce is NOT auto-retried (double-append risk);
+        # ConnectionError reaches the producer, which owns redelivery
         r = self._request(PRODUCE, 2, bytes(w.buf))
 
         def part_resp(rd):
@@ -678,6 +703,33 @@ class KafkaWireBroker(ProducePartitionMixin):
                 return None if off < 0 else off
         return None
 
+    def committed_many(self, group: str, pairs
+                       ) -> Dict[Tuple[str, int], int]:
+        """Committed offsets for [(topic, partition), ...] in ONE
+        OffsetFetch round-trip (the per-partition committed() loop cost a
+        wire request each — at replica-mirror rates that was hundreds of
+        idle requests/s against the leader).  Pairs with no committed
+        offset are omitted from the result."""
+        by_topic: Dict[str, List[int]] = {}
+        for t, p in pairs:
+            by_topic.setdefault(t, []).append(p)
+        w = _Writer()
+        w.string(group)
+        w.array(sorted(by_topic.items()), lambda wr, tp: (
+            wr.string(tp[0]),
+            wr.array(sorted(tp[1]), lambda pw, p: pw.i32(p))))
+        r = self._request(OFFSET_FETCH, 1, bytes(w.buf))
+        tops = r.array(lambda rd: (rd.string(), rd.array(
+            lambda p: (p.i32(), p.i64(), p.string(), p.i16()))))
+        out: Dict[Tuple[str, int], int] = {}
+        for tname, parts in tops:
+            for pid, off, _meta, err in parts:
+                if err != ERR_NONE:
+                    raise RuntimeError(f"offset fetch {tname}:{pid}: {err}")
+                if off >= 0:
+                    out[(tname, pid)] = off
+        return out
+
     def commit_many(self, group: str, topic: str, entries) -> None:
         """Commit [(partition, next_offset), ...] of one topic in ONE
         OffsetCommit request (StreamConsumer.commit's fast path) —
@@ -708,6 +760,9 @@ class KafkaWireBroker(ProducePartitionMixin):
             wr.string(tp[0]),
             wr.array(tp[1], lambda pw, p: pw.i32(p[0]).i64(p[1])
                      .string(None))))
+        # retry-ok: offset commits are NOT auto-retried (a stale commit
+        # replayed after a rebalance could fence-bypass); callers re-commit
+        # from their own cursors on ConnectionError
         r = self._request(OFFSET_COMMIT, 2, bytes(w.buf))
         tops = r.array(lambda rd: (rd.string(), rd.array(
             lambda p: (p.i32(), p.i16()))))
@@ -739,6 +794,9 @@ class KafkaWireBroker(ProducePartitionMixin):
         w.string("consumer")
         w.array([("range", bytes(meta.buf))],
                 lambda wr, p: (wr.string(p[0]), wr.bytes_(p[1])))
+        # retry-ok: join mutates membership (may create a member id); the
+        # coordinator adapter's join loop retries with its member id, so a
+        # lost response never leaks a zombie member past session timeout
         r = self._request(JOIN_GROUP, 0, bytes(w.buf))
         err = r.i16()
         if err != ERR_NONE:
@@ -785,6 +843,8 @@ class KafkaWireBroker(ProducePartitionMixin):
             xw.array(sorted(parts), lambda pw, p: pw.i32(p))
 
         w.array(sorted((assignments or {}).items()), one)
+        # retry-ok: sync is generation-fenced server-side; callers rejoin
+        # on ConnectionError rather than replay a possibly-stale sync
         r = self._request(SYNC_GROUP, 0, bytes(w.buf))
         err = r.i16()
         blob = r.bytes_() or b""
@@ -810,10 +870,16 @@ class KafkaWireBroker(ProducePartitionMixin):
     def leave_group(self, group: str, member_id: str) -> None:
         w = _Writer()
         w.string(group).string(member_id)
+        # retry-ok: a lost leave is self-healing (session timeout expires
+        # the member); not worth retrying against a possibly-new leader
         self._request(LEAVE_GROUP, 0, bytes(w.buf)).i16()
 
     def close(self) -> None:
-        self._sock.close()
+        # _sock is None when the last reconnect attempt found no
+        # reachable server (_connect_any clears it before trying) — a
+        # replica losing its leader hits exactly this at stop()
+        if self._sock is not None:
+            self._sock.close()
 
 
 class RemoteGroupCoordinator:
@@ -1009,13 +1075,21 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                         presp.append((pid, ERR_UNKNOWN_TOPIC, -1))
                         continue
                     base = broker.end_offset(tname, pid)
-                    # bulk append under one broker lock — the per-message
-                    # produce loop was a per-record cost in the server's
-                    # hottest handler
-                    broker.produce_many(
-                        tname, [(key, value or b"", ts)
-                                for _, key, value, ts in entries],
-                        partition=pid)
+                    try:
+                        # bulk append under one broker lock — the
+                        # per-message produce loop was a per-record cost
+                        # in the server's hottest handler
+                        broker.produce_many(
+                            tname, [(key, value or b"", ts)
+                                    for _, key, value, ts in entries],
+                            partition=pid)
+                    except PermissionError:
+                        # engine-owned topic (Broker.restrict_topic): an
+                        # external client may not write the AVRO leg —
+                        # the exclusivity trusted_passthrough relies on
+                        presp.append(
+                            (pid, ERR_TOPIC_AUTHORIZATION_FAILED, -1))
+                        continue
                     presp.append((pid, ERR_NONE, base))
                 resp.append((tname, presp))
             w.array(resp, lambda wr, t: (wr.string(t[0]), wr.array(

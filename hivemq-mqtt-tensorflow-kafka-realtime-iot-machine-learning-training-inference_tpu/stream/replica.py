@@ -50,6 +50,13 @@ class FollowerReplica:
       groups: consumer groups whose committed offsets are mirrored.
       host/port: where this follower's own wire server listens.
       poll_interval_s: sleep between sync rounds once caught up.
+      commit_interval_s: idle cadence of commit-table mirroring in the
+        background loop.  Rounds that copied messages always mirror
+        (commits land together with the data they fence); fully
+        caught-up rounds re-poll the group tables at most this often —
+        without it, every idle round issued offset fetches at
+        poll_interval_s rates (~hundreds of requests/s of steady idle
+        load on the leader for a 10-partition topic, ADVICE.md round-5).
       sasl: optional (user, password) for the leader connection; the
         follower's own server stays open (fixture semantics).
     """
@@ -59,7 +66,8 @@ class FollowerReplica:
                  port: int = 0, poll_interval_s: float = 0.05,
                  fetch_batch: int = 2000,
                  retention_messages: Optional[int] = None,
-                 sasl: Optional[tuple] = None):
+                 sasl: Optional[tuple] = None,
+                 commit_interval_s: float = 1.0):
         #: local log bound per mirrored topic.  The wire protocol does
         #: not carry the leader's retention config, so a follower of a
         #: retention-bounded leader must be given its own bound here or
@@ -73,6 +81,8 @@ class FollowerReplica:
         self._topics = topics
         self._groups = list(groups)
         self._interval = poll_interval_s
+        self._commit_interval = commit_interval_s
+        self._last_commit_sync = float("-inf")  # monotonic domain
         self._batch = fetch_batch
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -112,7 +122,10 @@ class FollowerReplica:
     def _run(self) -> None:
         while not self._stop.is_set():
             try:
-                moved = self.sync_once()
+                # cadence-throttled mirroring: sync_once(None) lets the
+                # round decide — mirror when it copied messages, or when
+                # commit_interval_s has elapsed since the last mirror
+                moved = self.sync_once(mirror_commits=None)
             except Exception as e:  # noqa: BLE001 - leader may be dying;
                 # the follower's job is to keep serving what it has
                 self.sync_errors.append(f"{type(e).__name__}: {e}")
@@ -122,9 +135,12 @@ class FollowerReplica:
             if not moved:
                 time.sleep(self._interval)
 
-    def sync_once(self) -> int:
+    def sync_once(self, mirror_commits: Optional[bool] = True) -> int:
         """One replication round; returns messages copied.  Public so
-        tests (and a caught-up barrier) can drive it synchronously."""
+        tests (and a caught-up barrier) can drive it synchronously —
+        direct calls mirror the commit tables unconditionally
+        (deterministic); the background loop passes None to apply the
+        commit_interval_s cadence instead."""
         names = self._topics if self._topics is not None \
             else self._leader.topics()
         copied = 0
@@ -164,12 +180,20 @@ class FollowerReplica:
                                            partition=p,
                                            timestamp_ms=m.timestamp_ms)
                     copied += len(msgs)
-        for g in self._groups:
-            for t in list(self._parts):
-                for p in range(self._parts[t]):
-                    off = self._leader.committed(g, t, p)
-                    if off is not None:
-                        self.local.commit(g, t, p, off)
+        if mirror_commits is None:
+            mirror_commits = bool(copied) or (
+                time.monotonic() - self._last_commit_sync
+                >= self._commit_interval)
+        if mirror_commits and self._groups:
+            # ONE OffsetFetch round-trip per group covering every
+            # mirrored (topic, partition) — not a wire request each
+            pairs = [(t, p) for t in list(self._parts)
+                     for p in range(self._parts[t])]
+            for g in self._groups:
+                for (t, p), off in self._leader.committed_many(
+                        g, pairs).items():
+                    self.local.commit(g, t, p, off)
+            self._last_commit_sync = time.monotonic()
         return copied
 
     def lag(self) -> Dict[str, int]:
@@ -185,8 +209,8 @@ class FollowerReplica:
 
     def caught_up(self, timeout_s: float = 10.0) -> bool:
         """Block until every mirrored topic's lag is zero (or timeout)."""
-        deadline = time.time() + timeout_s
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
             try:
                 if all(v == 0 for v in self.lag().values()) and self._parts:
                     return True

@@ -26,6 +26,7 @@ a real schema id from the attached `SchemaRegistry`, so downstream consumers
 
 from __future__ import annotations
 
+import contextlib
 import json
 import re
 from collections import Counter
@@ -1077,7 +1078,8 @@ class SqlEngine:
     """
 
     def __init__(self, broker: Broker, registry: Optional[SchemaRegistry] = None,
-                 trusted_passthrough: bool = False):
+                 trusted_passthrough: bool = False,
+                 owner_token: Optional[object] = None):
         self.broker = broker
         self.registry = registry or SchemaRegistry()
         self.sources: Dict[str, SourceMeta] = {}
@@ -1089,6 +1091,12 @@ class SqlEngine:
         #: engine's validating encoder one hop earlier.  Sources fed by
         #: external producers always keep validation regardless.
         self.trusted_passthrough = bool(trusted_passthrough)
+        #: produce grant for engine-owned topics (Broker.restrict_topic):
+        #: when the platform restricts the AVRO leg to this engine, pump
+        #: rounds run under this token so only the engine's own tasks may
+        #: write there — the write-exclusivity that makes
+        #: trusted_passthrough sound, enforced instead of inferred.
+        self.owner_token = owner_token
 
     # -- public API ---------------------------------------------------
 
@@ -1113,14 +1121,19 @@ class SqlEngine:
         emitted before the failure within the round may be re-emitted —
         KSQL's default delivery guarantee).  The error therefore stays
         visible in SHOW QUERIES until the chunk actually reprocesses."""
+        grant = (self.broker.producer_grant(self.owner_token)
+                 if self.owner_token is not None
+                 and hasattr(self.broker, "producer_grant")
+                 else contextlib.nullcontext())
         n = 0
-        for q in list(self.queries.values()):
-            try:
-                n += q.task.process_available(chunk)
-                q.error = None
-            except Exception as e:  # noqa: BLE001 - per-query fault isolation
-                q.error = f"{type(e).__name__}: {e}"
-                q.task.consumer.rewind_to_committed()
+        with grant:
+            for q in list(self.queries.values()):
+                try:
+                    n += q.task.process_available(chunk)
+                    q.error = None
+                except Exception as e:  # noqa: BLE001 - per-query fault isolation
+                    q.error = f"{type(e).__name__}: {e}"
+                    q.task.consumer.rewind_to_committed()
         return n
 
     def table(self, name: str) -> Dict[tuple, dict]:

@@ -42,6 +42,23 @@ requires_reference = pytest.mark.skipif(
     not reference_available(),
     reason="read-only reference checkout not mounted")
 
+# IOTML_LOCKCHECK=1: run the whole suite under the runtime lock-order &
+# race detector (iotml.analysis.lockcheck).  Installed at import time —
+# before any test constructs a broker/server — so every lock the stream
+# stack creates is instrumented; the registered plugin reports at session
+# end and FAILS the run on lock-order cycles.  Equivalent to
+# `pytest -p iotml.analysis.pytest_plugin`.
+if os.environ.get("IOTML_LOCKCHECK", "") not in ("", "0"):
+    from iotml.analysis import lockcheck as _lockcheck
+
+    _lockcheck.install()
+
+    def pytest_configure(config):
+        if not config.pluginmanager.has_plugin("iotml-lockcheck"):
+            from iotml.analysis import pytest_plugin
+
+            config.pluginmanager.register(pytest_plugin, "iotml-lockcheck")
+
 
 @pytest.fixture
 def rng():

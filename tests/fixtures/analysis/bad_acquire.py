@@ -1,0 +1,19 @@
+"""Seeded R3 violation: bare .acquire() instead of a context manager."""
+
+import threading
+
+_lock = threading.Lock()
+_items = []
+
+
+def push(item):
+    _lock.acquire()                             # R3: bare acquire
+    try:
+        _items.append(item)
+    finally:
+        _lock.release()
+
+
+def push_ok(item):
+    with _lock:
+        _items.append(item)

@@ -1,0 +1,29 @@
+"""Seeded R4 violation: blocking socket I/O while a lock is held —
+directly and through a module-local helper chain."""
+
+import threading
+
+
+class Pump:
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self._sock = sock
+
+    def _read_frame(self):
+        return self._sock.recv(4096)
+
+    def _next(self):
+        return self._read_frame()
+
+    def step_direct(self):
+        with self._lock:
+            return self._sock.recv(4096)            # R4: recv under lock
+
+    def step_transitive(self):
+        with self._lock:
+            return self._next()                     # R4: blocks 2 frames down
+
+    def step_outside(self):
+        frame = self._read_frame()                  # clean: lock not held
+        with self._lock:
+            return len(frame)

@@ -1,0 +1,10 @@
+"""Seeded R5 violation: producing to an engine-owned topic from outside
+streamproc/ (this fixture is not under a streamproc/ path)."""
+
+
+def inject(broker, payload: bytes):
+    broker.produce("SENSOR_DATA_S_AVRO", payload)   # R5: engine-owned topic
+
+
+def observe(broker):
+    return broker.fetch("SENSOR_DATA_S_AVRO", 0, 0)  # reads stay open

@@ -1,0 +1,19 @@
+"""Seeded R2 violation: non-idempotent _request call with no retry-ok
+justification (plus a justified one and an allowlisted one, both clean)."""
+
+PRODUCE, FETCH, OFFSET_COMMIT = 0, 1, 8
+
+
+class MiniClient:
+    def _request(self, api_key, api_version, body):
+        raise NotImplementedError
+
+    def produce(self, body):
+        return self._request(PRODUCE, 2, body)      # R2: no justification
+
+    def commit(self, body):
+        # retry-ok: caller re-commits from its own cursor on ConnectionError
+        return self._request(OFFSET_COMMIT, 2, body)
+
+    def fetch(self, body):
+        return self._request(FETCH, 2, body)        # allowlisted: clean

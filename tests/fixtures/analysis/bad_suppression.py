@@ -1,0 +1,11 @@
+"""Seeded meta-violation: a suppression comment with no justification is
+itself a finding (justifications are the point of the mechanism)."""
+
+import threading
+
+_lock = threading.Lock()
+
+
+def grab():
+    _lock.acquire()  # lint-ok: R3
+    _lock.release()

@@ -1,0 +1,50 @@
+"""Runtime fixture for the lockcheck detector: a seeded lock-order cycle
+(A→B in one thread, B→A in another) and a consistent-order twin that
+must stay clean.  Locks are created inside the functions so they are
+instrumented when the caller installs lockcheck first."""
+
+import threading
+
+
+def run_cycle() -> None:
+    """Two threads acquire two locks in opposite orders — the classic
+    deadlock shape, sequenced with events so it never actually deadlocks
+    (the detector works on acquisition ORDER, not on a stuck runtime)."""
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+    first_done = threading.Event()
+
+    def ab():
+        with lock_a:
+            with lock_b:
+                pass
+        first_done.set()
+
+    def ba():
+        first_done.wait(5)
+        with lock_b:
+            with lock_a:
+                pass
+
+    t1 = threading.Thread(target=ab)
+    t2 = threading.Thread(target=ba)
+    t1.start(); t2.start()
+    t1.join(5); t2.join(5)
+
+
+def run_consistent() -> None:
+    """Same two locks, same nesting — but every thread honors one global
+    order, so the graph stays acyclic."""
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+
+    def ab():
+        with lock_a:
+            with lock_b:
+                pass
+
+    threads = [threading.Thread(target=ab) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(5)

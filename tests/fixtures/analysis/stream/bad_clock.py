@@ -1,0 +1,21 @@
+"""Seeded R1 violation: wall-clock deadline in a 'stream' path.
+
+Never imported — parsed by tests/test_analysis.py to pin that the lint
+flags `time.time()` timeout arithmetic in stream/mqtt modules, and that
+a justified wallclock-ok read stays clean.
+"""
+
+import time
+
+
+def wait_for_flag(flag, timeout_s: float = 5.0) -> bool:
+    deadline = time.time() + timeout_s          # R1: non-monotonic timeout
+    while time.time() < deadline:               # R1
+        if flag.is_set():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def stamp_record() -> int:
+    return int(time.time() * 1000)  # wallclock-ok: record timestamp, not a timeout

@@ -1,0 +1,202 @@
+"""The checker checked: iotml.analysis lint rules R1-R5 against seeded
+violation fixtures (tests/fixtures/analysis/) and a clean tree, the
+runtime lock-order/race detector against a seeded cycle, and the
+allowlist the R2 lint enforces pinned to the client that implements it."""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from iotml.analysis import lint as lint_mod
+from iotml.analysis import lockcheck
+from iotml.analysis.lint import lint_file, lint_paths
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures", "analysis")
+
+
+def _rules_by_file(findings):
+    out = {}
+    for f in findings:
+        out.setdefault(os.path.basename(f.path), set()).add(f.rule)
+    return out
+
+
+# ------------------------------------------------------------------ lint
+def test_lint_flags_every_seeded_violation():
+    by_file = _rules_by_file(lint_paths([FIXTURES]))
+    assert by_file.get("bad_clock.py") == {"R1"}
+    assert by_file.get("bad_acquire.py") == {"R3"}
+    assert by_file.get("bad_retry.py") == {"R2"}
+    assert by_file.get("bad_blocking.py") == {"R4"}
+    assert by_file.get("bad_owned_topic.py") == {"R5"}
+    # a reason-less suppression is itself a finding AND does not suppress
+    assert by_file.get("bad_suppression.py") == {"R3"}
+    # the runtime fixture is lint-clean (locks held via `with` only)
+    assert "lock_cycle.py" not in by_file
+
+
+def test_lint_finding_lines_and_count():
+    path = os.path.join(FIXTURES, "stream", "bad_clock.py")
+    findings = lint_file(path)
+    # the two deadline reads flagged; the wallclock-ok timestamp is not
+    assert [f.rule for f in findings] == ["R1", "R1"]
+    assert [f.line for f in findings] == [12, 13]
+    assert all(str(f).startswith(f"{path}:") for f in findings)
+
+
+def test_lint_r4_direct_and_transitive_but_not_outside():
+    path = os.path.join(FIXTURES, "bad_blocking.py")
+    findings = lint_file(path)
+    assert [f.rule for f in findings] == ["R4", "R4"]
+    # one direct recv, one through the _next -> _read_frame chain;
+    # step_outside's recv (lock not held) stays clean
+    assert "recv" in findings[0].message
+    assert "_next" in findings[1].message or "recv" in findings[1].message
+
+
+def test_lint_clean_on_the_tree():
+    findings = lint_paths([lint_mod.default_root()])
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_lint_cli_exit_codes():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    clean = subprocess.run(
+        [sys.executable, "-m", "iotml.analysis", "lint", "--quiet"],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(lint_mod.default_root()))
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    seeded = subprocess.run(
+        [sys.executable, "-m", "iotml.analysis", "lint", "--quiet", FIXTURES],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(lint_mod.default_root()))
+    assert seeded.returncode == 1
+    # file:line findings on stdout, machine-parseable
+    assert any(":12: R1" in ln for ln in seeded.stdout.splitlines())
+
+
+def test_r2_allowlist_pinned_to_the_wire_client():
+    """The lint's name allowlist and the client's api-key allowlist are
+    the same set — a drift would let the lint pass call sites the client
+    no longer auto-retries (or vice versa)."""
+    from iotml.stream import kafka_wire as kw
+
+    lint_keys = {getattr(kw, name) for name in lint_mod.IDEMPOTENT_API_NAMES}
+    assert lint_keys == set(kw.IDEMPOTENT_APIS)
+
+
+# -------------------------------------------------------------- lockcheck
+@pytest.fixture
+def fresh_lockcheck():
+    """Isolated install: skips if a session-level lockcheck is already
+    live (IOTML_LOCKCHECK=1 runs), since its State is shared."""
+    if lockcheck.state() is not None:
+        pytest.skip("session-level lockcheck active")
+    st = lockcheck.install()
+    try:
+        yield st
+    finally:
+        lockcheck.uninstall()
+
+
+def test_lockcheck_flags_seeded_cycle(fresh_lockcheck):
+    sys.modules.pop("tests.fixtures.analysis.lock_cycle", None)
+    sys.path.insert(0, FIXTURES)
+    try:
+        import lock_cycle
+    finally:
+        sys.path.remove(FIXTURES)
+    lock_cycle.run_consistent()
+    assert fresh_lockcheck.cycles() == []
+    lock_cycle.run_cycle()
+    cycles = fresh_lockcheck.cycles()
+    assert len(cycles) == 1
+    assert "lock_cycle.py" in cycles[0].message
+
+
+def test_lockcheck_flags_sleep_under_lock(fresh_lockcheck):
+    time.sleep(0)  # no lock held: clean
+    assert not any(v.kind == "io-under-lock"
+                   for v in fresh_lockcheck.violations)
+    lk = threading.Lock()
+    with lk:
+        time.sleep(0)
+    kinds = [v.kind for v in fresh_lockcheck.violations]
+    assert "io-under-lock" in kinds
+    assert fresh_lockcheck.cycles() == []
+
+
+def test_lockcheck_watched_dict_lock_and_owner_modes(fresh_lockcheck):
+    lk = threading.Lock()
+    table = lockcheck.WatchedDict({}, "t.guarded", lock=lk)
+    with lk:
+        table["ok"] = 1
+    assert not fresh_lockcheck.violations
+    table["bad"] = 2
+    assert any(v.kind == "unguarded-mutation" and "t.guarded" in v.message
+               for v in fresh_lockcheck.violations)
+
+    owned = lockcheck.WatchedDict({}, "t.owned")
+    owned["claims-ownership"] = 1            # first mutator becomes owner
+    t = threading.Thread(target=owned.__setitem__, args=("other", 2))
+    t.start(); t.join(5)
+    assert any(v.kind == "unguarded-mutation" and "t.owned" in v.message
+               for v in fresh_lockcheck.violations)
+
+
+def test_lockcheck_broker_commit_is_guarded(fresh_lockcheck):
+    """The Broker created under lockcheck gets watched tables, and the
+    whole public mutation surface holds the broker lock — including
+    commit(), which the detector originally caught writing the group
+    table lock-free."""
+    from iotml.stream.broker import Broker
+
+    b = Broker()
+    assert isinstance(b._group_offsets, lockcheck.WatchedDict)
+    b.create_topic("t", partitions=2)
+    b.produce("t", b"v")
+    b.commit("g", "t", 0, 7)
+    assert b.committed("g", "t", 0) == 7
+    bad = [v for v in fresh_lockcheck.violations
+           if v.kind == "unguarded-mutation"]
+    assert bad == [], bad
+
+
+def test_lockcheck_uninstall_restores_everything():
+    if lockcheck.state() is not None:
+        pytest.skip("session-level lockcheck active")
+    lockcheck.install()
+    assert isinstance(threading.Lock(), lockcheck.CheckedLock)
+    lockcheck.uninstall()
+    assert threading.Lock is lockcheck._REAL_LOCK
+    assert time.sleep is lockcheck._REAL_SLEEP
+    assert type(threading.Lock()).__module__ == "_thread"
+
+
+def test_lockcheck_condition_integration(fresh_lockcheck):
+    """Condition/Event built over checked locks must keep the held-stack
+    truthful across wait() (RLock _release_save/_acquire_restore)."""
+    cv = threading.Condition()           # RLock() -> CheckedRLock
+    done = []
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=5)
+            done.append(True)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        cv.notify()
+    t.join(5)
+    assert done == [True]
+    ev = threading.Event()
+    threading.Thread(target=ev.set).start()
+    assert ev.wait(5)
+    assert fresh_lockcheck.cycles() == []

@@ -128,3 +128,77 @@ def test_produce_many_matches_per_message_produce():
         mb = b.fetch("t", p, b.begin_offset("t", p), 100)
         assert [(m.key, m.value, m.timestamp_ms) for m in ma] == \
             [(m.key, m.value, m.timestamp_ms) for m in mb]
+
+
+def test_engine_owned_topic_restriction():
+    """restrict_topic: produces to the owned prefix require the owner's
+    grant; reads, commits and other topics stay open (the invariant is
+    write exclusivity, ADVICE.md round-5 trusted_passthrough hole)."""
+    from iotml.stream.broker import Broker, TopicOwnershipError
+
+    b = Broker()
+    b.create_topic("SENSOR_DATA_S_AVRO", partitions=2)
+    b.produce("SENSOR_DATA_S_AVRO", b"pre-restriction")  # open until marked
+    token = b.restrict_topic("SENSOR_DATA_S_AVRO")
+    with pytest.raises(TopicOwnershipError):
+        b.produce("SENSOR_DATA_S_AVRO", b"external")
+    with pytest.raises(TopicOwnershipError):
+        b.produce_many("SENSOR_DATA_S_AVRO_REKEY",  # prefix match
+                       [(None, b"external", 0)])
+    with pytest.raises(TopicOwnershipError):
+        b.produce_batch("SENSOR_DATA_S_AVRO", [b"x"])
+    # nothing landed
+    assert b.end_offset("SENSOR_DATA_S_AVRO", 0) + \
+        b.end_offset("SENSOR_DATA_S_AVRO", 1) == 1
+    # the owner produces under its grant; other topics need none
+    with b.producer_grant(token):
+        b.produce("SENSOR_DATA_S_AVRO", b"engine")
+    b.produce("sensor-data", b"anyone")
+    # grant is thread-local: it does not leak to other threads
+    errs = []
+
+    def other_thread():
+        try:
+            b.produce("SENSOR_DATA_S_AVRO", b"sneak")
+        except TopicOwnershipError:
+            errs.append("rejected")
+
+    import threading
+
+    with b.producer_grant(token):
+        t = threading.Thread(target=other_thread)
+        t.start(); t.join(5)
+    assert errs == ["rejected"]
+    # reads and commits unaffected
+    assert b.committed("g", "SENSOR_DATA_S_AVRO", 0) is None
+    b.commit("g", "SENSOR_DATA_S_AVRO", 0, 1)
+    assert b.committed("g", "SENSOR_DATA_S_AVRO", 0) == 1
+
+
+def test_sql_engine_pumps_under_owner_grant():
+    """The platform wiring end to end: a restricted broker + an engine
+    holding the owner token — the reference pipeline's AVRO leg still
+    flows, while a direct external produce is rejected."""
+    import json
+
+    import pytest as _pytest
+
+    from iotml.core.schema import KSQL_CAR_SCHEMA
+    from iotml.stream.broker import Broker, TopicOwnershipError
+    from iotml.streamproc import SqlEngine
+    from iotml.streamproc.sql import install_reference_pipeline
+
+    b = Broker()
+    b.create_topic("sensor-data", partitions=2)
+    token = b.restrict_topic("SENSOR_DATA_S_AVRO")
+    engine = SqlEngine(b, trusted_passthrough=True, owner_token=token)
+    install_reference_pipeline(engine)
+    rec = {f.name: ("false" if f.name == "FAILURE_OCCURRED" else
+                    "car1" if f.avro_type == "string" else 1)
+           for f in KSQL_CAR_SCHEMA.fields}
+    b.produce("sensor-data", json.dumps(rec).encode(), key=b"car1")
+    assert engine.pump() > 0
+    assert b.end_offset("SENSOR_DATA_S_AVRO", 0) + \
+        b.end_offset("SENSOR_DATA_S_AVRO", 1) == 1
+    with _pytest.raises(TopicOwnershipError):
+        b.produce("SENSOR_DATA_S_AVRO", b"external")

@@ -254,3 +254,87 @@ def test_parse_bootstrap_handles_malformed_and_ipv6():
     assert parse_bootstrap("[::1]:3,[fe80::1]") == \
         [("::1", 3), ("fe80::1", 9092)]
     assert parse_bootstrap(",,") == []
+
+
+def test_failover_retries_idempotent_apis_only():
+    """A reconnect auto-retries reads (fetch/metadata) transparently, but
+    surfaces ConnectionError for non-idempotent produce/commit — the dead
+    server may have applied them, and a blind retry double-applies
+    (ADVICE.md round-5).  The client is reconnected afterwards, so the
+    caller opts into redelivery with a plain re-call."""
+    b1, b2 = Broker(), Broker()
+    for b in (b1, b2):
+        b.create_topic("t", partitions=1)
+        b.produce("t", b"seed")
+    s1 = KafkaWireServer(b1).start()
+    s2 = KafkaWireServer(b2).start()
+    try:
+        client = KafkaWireBroker(f"127.0.0.1:{s1.port},127.0.0.1:{s2.port}",
+                                 timeout_s=5.0)
+        assert client.produce("t", b"on-leader") == 1
+        s1.kill()
+        # non-idempotent: surfaced, not silently retried
+        with pytest.raises(ConnectionError, match="non-idempotent"):
+            client.produce("t", b"during-failover")
+        # ...but the failover reconnect already happened: an explicit
+        # redelivery lands on the follower
+        assert client.produce("t", b"redelivered") == 1
+        with pytest.raises(ConnectionError):
+            # commit rides OffsetCommit: same contract
+            s2.kill()
+            client.commit("g", "t", 0, 1)
+    finally:
+        for s in (s1, s2):
+            try:
+                s.server_close()
+            except OSError:
+                pass
+
+
+def test_failover_fetch_is_transparent():
+    """The idempotent side of the same contract: a fetch that hits a dead
+    socket fails over and answers from the next bootstrap server without
+    the caller noticing."""
+    b1, b2 = Broker(), Broker()
+    for b in (b1, b2):
+        b.create_topic("t", partitions=1)
+        for i in range(3):
+            b.produce("t", f"m{i}".encode())
+    s1 = KafkaWireServer(b1).start()
+    s2 = KafkaWireServer(b2).start()
+    try:
+        client = KafkaWireBroker(f"127.0.0.1:{s1.port},127.0.0.1:{s2.port}",
+                                 timeout_s=5.0)
+        assert len(client.fetch("t", 0, 0)) == 3
+        s1.kill()
+        assert [m.value for m in client.fetch("t", 0, 0)] == \
+            [b"m0", b"m1", b"m2"]
+        assert client.end_offset("t") == 3
+    finally:
+        for s in (s1, s2):
+            try:
+                s.server_close()
+            except OSError:
+                pass
+
+
+def test_committed_many_one_round_trip():
+    """committed_many fetches every (topic, partition) of a group in ONE
+    OffsetFetch request, omitting uncommitted pairs — the replica's
+    commit-mirror batching."""
+    broker = Broker()
+    broker.create_topic("A", partitions=3)
+    broker.create_topic("B", partitions=2)
+    with KafkaWireServer(broker) as srv:
+        client = KafkaWireBroker(f"127.0.0.1:{srv.port}")
+        client.commit("g", "A", 0, 10)
+        client.commit("g", "A", 2, 30)
+        client.commit("g", "B", 1, 5)
+        pairs = [("A", p) for p in range(3)] + [("B", p) for p in range(2)]
+        before = client._corr
+        got = client.committed_many("g", pairs)
+        assert client._corr == before + 1  # exactly one wire request
+        assert got == {("A", 0): 10, ("A", 2): 30, ("B", 1): 5}
+        # parity with the single-pair path
+        assert client.committed("g", "A", 1) is None
+        client.close()

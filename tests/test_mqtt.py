@@ -486,7 +486,9 @@ def test_offline_session_expiry_drops_queue_and_subscriptions():
     assert broker._offline  # queued while within expiry
 
     with mock.patch("iotml.mqtt.broker.time") as m:
-        m.time.return_value = _time.time() + 11.0
+        # session deadlines live in the monotonic clock domain (a wall
+        # clock step must not expire or extend sessions)
+        m.monotonic.return_value = _time.monotonic() + 11.0
         # any session operation sweeps expired offline state
         QueueClient(broker, "other", clean_start=True)
     assert not broker._offline

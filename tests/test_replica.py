@@ -255,3 +255,45 @@ def test_failover_survives_mid_request_reconnect_with_sasl():
                 s.server_close()
             except OSError:
                 pass
+
+
+def test_commit_mirror_throttled_and_batched():
+    """Idle sync rounds must not hammer the leader with offset fetches:
+    the background cadence (sync_once(mirror_commits=None)) mirrors only
+    after rounds that copied messages or once per commit_interval_s —
+    and each mirror is ONE OffsetFetch per group, not one per
+    partition.  Direct sync_once() keeps mirroring unconditionally
+    (deterministic test semantics)."""
+    broker, srv, _gen = _leader_with_data(n_ticks=4, partitions=2)
+    try:
+        leader_client = KafkaWireBroker(f"127.0.0.1:{srv.port}")
+        leader_client.commit("g", "T", 0, 3)
+        rep = FollowerReplica(f"127.0.0.1:{srv.port}", topics=["T"],
+                              groups=("g",), commit_interval_s=3600.0)
+        # first round copies messages -> mirrors commits despite cadence
+        assert rep.sync_once(mirror_commits=None) > 0
+        assert rep.local.committed("g", "T", 0) == 3
+        # caught up + a fresh leader-side commit: a cadence round must
+        # SKIP the mirror (nothing copied, interval not elapsed)...
+        leader_client.commit("g", "T", 0, 4)
+        corr_before = rep._leader._corr
+        assert rep.sync_once(mirror_commits=None) == 0
+        assert rep.local.committed("g", "T", 0) == 3
+        # ...and the skipped round made zero OffsetFetch round-trips
+        # (remaining requests are the topic/fetch probes only)
+        reqs = rep._leader._corr - corr_before
+        assert reqs <= 1 + 2  # metadata refresh + one fetch per partition
+        # interval elapsed -> cadence round mirrors again, in ONE request
+        rep._last_commit_sync = float("-inf")
+        corr_before = rep._leader._corr
+        assert rep.sync_once(mirror_commits=None) == 0
+        assert rep.local.committed("g", "T", 0) == 4
+        # explicit sync_once(): unconditional mirror
+        leader_client.commit("g", "T", 1, 9)
+        rep.sync_once()
+        assert rep.local.committed("g", "T", 1) == 9
+        rep._leader.close()
+        leader_client.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
