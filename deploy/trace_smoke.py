@@ -1,0 +1,90 @@
+#!/usr/bin/env python3
+"""Traced end-to-end smoke: devsim fleet → MQTT → bridge → KSQL →
+consumer → scorer with IOTML_TRACE=1, then assert the span log covers
+the pipeline (ISSUE 2 acceptance run; .github/workflows/obs.yml runs
+this followed by the `python -m iotml.obs trace` CLI checks).
+
+    IOTML_TRACE=1 IOTML_TRACE_PATH=spans.jsonl python deploy/trace_smoke.py
+    python -m iotml.obs trace spans.jsonl --min-stages 5 --require-e2e
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+# runnable straight from a checkout: `python deploy/trace_smoke.py`
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    if os.environ.get("IOTML_TRACE") != "1":
+        print("set IOTML_TRACE=1 (and IOTML_TRACE_PATH) for a traced run",
+              file=sys.stderr)
+        return 2
+
+    import numpy as np
+
+    from iotml.core.schema import CAR_SCHEMA
+    from iotml.data.dataset import SensorBatches
+    from iotml.gen.simulator import FleetGenerator, FleetScenario
+    from iotml.mqtt.broker import MqttBroker
+    from iotml.mqtt.bridge import KafkaBridge
+    from iotml.models.autoencoder import CAR_AUTOENCODER
+    from iotml.obs import tracing
+    from iotml.obs import metrics as obs_metrics
+    from iotml.serve.scorer import StreamScorer
+    from iotml.stream.broker import Broker
+    from iotml.stream.consumer import StreamConsumer
+    from iotml.stream.producer import OutputSequence
+    from iotml.streamproc.tasks import JsonToAvro, RekeyByCar
+    from iotml.train.loop import Trainer
+
+    # devsim fleet publishes JSON sensor records over the MQTT broker;
+    # the bridge forwards into `sensor-data`; the KSQL-equivalent tasks
+    # produce the framed-Avro ML input topic
+    mqtt = MqttBroker()
+    stream = Broker()
+    bridge = KafkaBridge(mqtt, stream, partitions=2)
+    gen = FleetGenerator(FleetScenario(num_cars=25, seed=7))
+    n_ticks = 8
+    for _ in range(n_ticks):
+        cols = gen.step_columns()
+        for i in range(len(cols["car"])):
+            rec = gen.row_record(cols, i, schema=CAR_SCHEMA)
+            rec["failure_occurred"] = str(cols["failure_occurred"][i])
+            mqtt.publish(f"vehicles/sensor/data/{gen.scenario.car_id(i)}",
+                         json.dumps(rec).encode(), qos=1)
+    assert bridge.forwarded() == 25 * n_ticks
+    JsonToAvro(stream, src="sensor-data",
+               dst="SENSOR_DATA_S_AVRO").process_available()
+    RekeyByCar(stream, src="SENSOR_DATA_S_AVRO",
+               dst="SENSOR_DATA_S_AVRO_REKEY",
+               partitions=2).process_available()
+
+    # consumer → scorer closes every trace with its e2e span
+    spec = stream.topic("SENSOR_DATA_S_AVRO")
+    consumer = StreamConsumer(
+        stream, [f"SENSOR_DATA_S_AVRO:{p}:0" for p in range(spec.partitions)],
+        group="trace-smoke")
+    batches = SensorBatches(consumer, batch_size=100)
+    trainer = Trainer(CAR_AUTOENCODER)
+    trainer._ensure_state(np.zeros((100, 18), np.float32))
+    scorer = StreamScorer(CAR_AUTOENCODER, trainer.state.params, batches,
+                          OutputSequence(stream, "model-predictions",
+                                         partition=0))
+    scored = scorer.score_available()
+    counts = tracing.flush()
+    render = obs_metrics.default_registry.render()
+    ok_hist = ("iotml_stage_seconds_bucket" in render
+               and "iotml_e2e_ingest_to_score_seconds_count" in render)
+    print(json.dumps({"published": bridge.forwarded(), "scored": scored,
+                      "spans_flushed": counts, "histograms": ok_hist}))
+    if scored != 25 * n_ticks or not ok_hist:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

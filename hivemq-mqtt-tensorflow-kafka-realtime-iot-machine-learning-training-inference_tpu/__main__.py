@@ -24,6 +24,8 @@ COMMANDS = [
     ("iotml.cli.devsim", "scenario-driven device fleet "
                          "(run/jobs/show/log/abort/example)"),
     ("iotml.obs.dashboards", "generate the Grafana dashboard ConfigMap"),
+    ("iotml.obs", "trace: summarize a span log (IOTML_TRACE=1) into a "
+                  "per-stage latency breakdown + bottleneck"),
 ]
 
 

@@ -25,6 +25,12 @@ Rules (see ARCHITECTURE.md §analysis for the full table):
       produced from ``streamproc/`` — the broker enforces this at
       runtime (Broker.restrict_topic); the lint closes it by
       construction.
+  R6  metric families and trace span/stage names follow the lowercase
+      snake_case convention (framework-owned names must match
+      ``iotml_[a-z0-9_]+`` exactly), and span recording
+      (``ctx.mark``/``ctx.close``/``tracing.start``/``tracing.flush``)
+      must not happen while a lock is held — the trace collector is
+      lock-free by contract (checked with R4's call-graph walk).
 
 Suppression: append ``# lint-ok: RN <reason>`` to the flagged line (for
 R4, to the ``with`` line holding the lock).  A suppression WITHOUT a
@@ -63,6 +69,20 @@ BLOCKING_CALLS = frozenset({
 # replica/timeout paths); the rest of the tree may use wall clocks.
 R1_PATH_SEGMENTS = ("stream", "mqtt")
 
+# R6 (naming): metric families and span/stage names are lowercase
+# snake_case; framework-owned names (iotml-prefixed) must follow the
+# full `iotml_[a-z0-9_]+` convention.  Reference-parity families
+# (mqtt_*, kafka_extension_*, agent_*, com_hivemq_* — the names the
+# reference's Grafana dashboards chart) are lowercase snake too, so
+# they pass; what the rule rejects is uppercase, dashes, dots and a
+# malformed iotml prefix — names Prometheus relabeling and the span
+# CLI's aggregation would silently fork on.
+_METRIC_FACTORY_CALLS = frozenset({"counter", "gauge", "histogram"})
+_SPAN_LITERAL_CALLS = frozenset({"mark", "close"})  # TraceContext methods
+_TRACING_MODULE_CALLS = frozenset({"start", "flush", "liveness"})
+_SNAKE_NAME_RE = re.compile(r"[a-z][a-z0-9_]*\Z")
+_IOTML_NAME_RE = re.compile(r"iotml_[a-z0-9_]+\Z")
+
 RULES: Dict[str, str] = {
     "R1": "non-monotonic clock (time.time) in wire/broker/replica code; "
           "use time.monotonic() or annotate '# wallclock-ok: <reason>'",
@@ -71,6 +91,8 @@ RULES: Dict[str, str] = {
     "R3": "bare Lock.acquire(); hold locks via 'with' only",
     "R4": "blocking call while a lock is held (module call-graph walk)",
     "R5": "engine-owned topic produced outside streamproc/",
+    "R6": "metric/span name violates the iotml_[a-z0-9_]+ naming "
+          "convention, or a span is recorded while a lock is held",
 }
 
 _SUPPRESS_RE = re.compile(r"#\s*lint-ok:\s*(R\d)\b[ \t]*(.*)")
@@ -185,6 +207,37 @@ def _lockish_name(expr: ast.expr) -> Optional[str]:
     return None
 
 
+def _str_arg0(node: ast.Call) -> Optional[str]:
+    """First positional argument when it is a string literal."""
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        return node.args[0].value
+    return None
+
+
+def _is_tracing_module_call(node: ast.Call) -> bool:
+    """``tracing.start(...)`` / ``tracing.flush()`` style module calls."""
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr in _TRACING_MODULE_CALLS
+            and isinstance(f.value, ast.Name) and f.value.id == "tracing")
+
+
+def _span_call_reason(node: ast.Call, name: Optional[str]) -> Optional[str]:
+    """The R6 under-lock predicate: 'records a span (...)' or None.
+
+    Span-recording shapes: a TraceContext method with a string-literal
+    stage (``ctx.mark("decode")``, ``ctx.close("score")``) or a call on
+    the tracing module (``tracing.start(...)``, ``tracing.flush()``).
+    The literal-argument requirement keeps generic ``.close()`` /
+    ``.mark()`` methods of unrelated objects out of the rule."""
+    if name in _SPAN_LITERAL_CALLS and isinstance(node.func, ast.Attribute) \
+            and _str_arg0(node) is not None:
+        return f"records a span ({name}({_str_arg0(node)!r}))"
+    if _is_tracing_module_call(node):
+        return f"records a span (tracing.{node.func.attr}())"
+    return None
+
+
 # --------------------------------------------------------------- R4 engine
 class _ModuleCallGraph:
     """Module-local may-block analysis.
@@ -203,14 +256,34 @@ class _ModuleCallGraph:
                 # first definition wins; duplicates would only make the
                 # result depend on dict order
                 self.bodies.setdefault(node.name, node)
-        self._memo: Dict[str, Optional[str]] = {}
+        # one memo per predicate kind: "block" (R4) and "span" (R6)
+        self._memos: Dict[str, Dict[str, Optional[str]]] = {
+            "block": {}, "span": {}}
 
-    def blocking_reason(self, func_name: str,
-                        _visiting: Optional[Set[str]] = None
-                        ) -> Optional[str]:
+    @staticmethod
+    def _block_pred(node: ast.Call, name: Optional[str]) -> Optional[str]:
+        if name in BLOCKING_CALLS:
+            return f"calls blocking {name}()"
+        return None
+
+    @staticmethod
+    def _span_pred(node: ast.Call, name: Optional[str]) -> Optional[str]:
+        return _span_call_reason(node, name)
+
+    def blocking_reason(self, func_name: str) -> Optional[str]:
         """None, or 'calls recv (net.py-style helper chain)' style text."""
-        if func_name in self._memo:
-            return self._memo[func_name]
+        return self._reason(func_name, "block", self._block_pred)
+
+    def span_reason(self, func_name: str) -> Optional[str]:
+        """None, or the span-recording chain — the same transitive walk
+        R4 uses, with the R6 predicate."""
+        return self._reason(func_name, "span", self._span_pred)
+
+    def _reason(self, func_name: str, kind: str, pred,
+                _visiting: Optional[Set[str]] = None) -> Optional[str]:
+        memo = self._memos[kind]
+        if func_name in memo:
+            return memo[func_name]
         body = self.bodies.get(func_name)
         if body is None:
             return None
@@ -218,21 +291,22 @@ class _ModuleCallGraph:
         if func_name in _visiting:
             return None  # recursion: already being decided
         _visiting.add(func_name)
-        self._memo[func_name] = None  # break cycles pessimistically-clean
+        memo[func_name] = None  # break cycles pessimistically-clean
         reason = None
         for node in ast.walk(body):
             if not isinstance(node, ast.Call):
                 continue
             name = _call_name(node)
-            if name in BLOCKING_CALLS:
-                reason = f"{func_name}() calls blocking {name}()"
+            direct = pred(node, name)
+            if direct:
+                reason = f"{func_name}() {direct}"
                 break
             if name and name != func_name and name in self.bodies:
-                inner = self.blocking_reason(name, _visiting)
+                inner = self._reason(name, kind, pred, _visiting)
                 if inner:
                     reason = f"{func_name}() -> {inner}"
                     break
-        self._memo[func_name] = reason
+        memo[func_name] = reason
         return reason
 
 
@@ -245,7 +319,8 @@ class _FileLinter(ast.NodeVisitor):
         self.sup = sup
         self.rules = rules
         self.findings: List[Finding] = list(sup.findings)
-        self.graph = _ModuleCallGraph(tree) if "R4" in rules else None
+        self.graph = _ModuleCallGraph(tree) \
+            if rules & {"R4", "R6"} else None
         parts = rel.replace(os.sep, "/").split("/")
         self.r1_scoped = any(seg in parts for seg in R1_PATH_SEGMENTS)
         self.in_streamproc = "streamproc" in parts
@@ -318,6 +393,45 @@ class _FileLinter(ast.NodeVisitor):
                                f"(acquired line {lock_line}): a stalled "
                                "peer parks every thread contending this "
                                "lock")
+                # R6 — span recording under a held lock (same transitive
+                # walk): the trace collector is lock-free by contract, so
+                # a mark inside a critical section would smuggle exporter
+                # work — and its latency — under a protocol lock
+                sreason = _span_call_reason(node, name)
+                if sreason is None and self.graph is not None \
+                        and name in self.graph.bodies:
+                    sreason = self.graph.span_reason(name)
+                if sreason is not None:
+                    lock_name, lock_line = active[-1]
+                    self._emit("R6", node,
+                               f"{sreason} while holding {lock_name} "
+                               f"(acquired line {lock_line}): record "
+                               "spans outside critical sections — the "
+                               "collector is lock-free by design")
+
+        # R6 — metric/span naming convention
+        if name in _METRIC_FACTORY_CALLS and \
+                isinstance(node.func, ast.Attribute):
+            metric = _str_arg0(node)
+            if metric is not None and not (
+                    _SNAKE_NAME_RE.fullmatch(metric)
+                    and (not metric.startswith("iotml")
+                         or _IOTML_NAME_RE.fullmatch(metric))):
+                self._emit("R6", node,
+                           f"metric name {metric!r} violates the naming "
+                           "convention: lowercase snake_case, and "
+                           "framework-owned families must match "
+                           "iotml_[a-z0-9_]+ exactly")
+        stage = _str_arg0(node) if (
+            (name in _SPAN_LITERAL_CALLS
+             and isinstance(node.func, ast.Attribute))
+            or _is_tracing_module_call(node)) else None
+        if stage is not None and not _SNAKE_NAME_RE.fullmatch(stage):
+            self._emit("R6", node,
+                       f"span/stage name {stage!r} violates the naming "
+                       "convention ([a-z][a-z0-9_]*): the span CLI and "
+                       "the stage-label histograms aggregate by this "
+                       "string")
 
         # R5 — engine-owned topic produced outside streamproc/
         if not self.in_streamproc and name in ("produce", "produce_many",

@@ -20,14 +20,16 @@ per-epoch re-read semantics via `reset()`.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
-from typing import Iterator, Optional
+from typing import Iterator, List, Optional
 
 import numpy as np
 
 from ..core.normalize import Normalizer, CAR_NORMALIZER
 from ..core.schema import KSQL_CAR_SCHEMA, RecordSchema
 from ..obs import metrics as obs_metrics
+from ..obs import tracing
 from ..ops.avro import AvroCodec
 from ..ops.framing import strip_frame
 from ..stream.consumer import StreamConsumer
@@ -121,6 +123,26 @@ class SensorBatches:
         # be skipped for good.  Updated by __iter__ between chunks, read
         # by the poll loop to cap each fetch.
         self._need_rows: Optional[int] = None
+        # Trace contexts FORKED from consumed record headers, marked
+        # `consume` at decode and held (bounded drop-oldest) for the
+        # pipeline closer — the train step / scorer calls take_traces()
+        # and closes each with its e2e span.  Forked, not shared: every
+        # consumer group of a topic polls the same header object, and
+        # closing it directly would let the first pipeline steal the
+        # trace from the others (train-then-serve over one topic is the
+        # demo's normal shape).  _seen_traces dedups epoch re-reads of
+        # the same records within THIS batcher (bounded; a continuous
+        # cursor never re-reads, only epoch loops do).  Empty and
+        # untouched when tracing is off.  The pending bound must cover a
+        # full drain at full sampling (a deep-backlog drain holds every
+        # fork until the closing commit); past it the oldest forks drop
+        # — counted into iotml_trace_spans_dropped_total, best-effort —
+        # rather than growing without bound under a reader that never
+        # closes (e.g. an evaluation-only pass over the stream).
+        self._pending_traces: collections.deque = collections.deque(
+            maxlen=65536)
+        self._seen_traces: set = set()
+        self._seen_traces_cap = 65536
         # Native (C++) columnar decode when the engine is built; the pure
         # codec is the fallback and the test oracle.
         self._native = None
@@ -181,6 +203,28 @@ class SensorBatches:
             msgs = self.consumer.poll(self._poll_limit())
             if not msgs:
                 return
+            if tracing.ENABLED:
+                # the fused native path has no per-message Python objects
+                # (and no headers) — traces ride this decode path only
+                pending, overflowed = self._pending_traces, 0
+                for m in msgs:
+                    if m.headers:
+                        ctx = tracing.from_headers(m.headers)
+                        if ctx is None \
+                                or ctx.trace_id in self._seen_traces:
+                            continue  # epoch re-read: trace once
+                        if len(self._seen_traces) < self._seen_traces_cap:
+                            self._seen_traces.add(ctx.trace_id)
+                        # fork: this pipeline closes its own copy; the
+                        # shared header object stays open for other
+                        # consumer groups of the same topic
+                        fork = ctx.fork()
+                        fork.mark("consume")
+                        if len(pending) == pending.maxlen:
+                            overflowed += 1
+                        pending.append(fork)
+                if overflowed:
+                    tracing.spans_dropped.inc(overflowed)
             n = len(msgs)
             keys = None
             if self.keep_keys:
@@ -399,6 +443,17 @@ class SensorBatches:
                 yield b
         finally:
             self._need_rows = None
+
+    # ----------------------------------------------------------- tracing
+    def take_traces(self) -> List["tracing.TraceContext"]:
+        """Hand the traces decoded since the last call to the caller —
+        the pipeline closer (train step / scorer) owns their close()."""
+        out: List[tracing.TraceContext] = []
+        while True:
+            try:
+                out.append(self._pending_traces.popleft())
+            except IndexError:
+                return out
 
     # --------------------------------------------------------- epoch API
     def reset(self):

@@ -25,6 +25,7 @@ from typing import Iterator, Optional
 import numpy as np
 
 from ..core.schema import CAR_SCHEMA, KSQL_CAR_SCHEMA, RecordSchema
+from ..obs import tracing
 from ..ops.avro import AvroCodec
 from ..ops.framing import frame
 
@@ -205,9 +206,11 @@ class FleetGenerator:
                 msgs = native.encode_batch(num, labels,
                                            schema_id=1 if framed else -1)
                 for i, payload in enumerate(msgs):
+                    hdrs = tracing.birth_headers("devsim_publish") \
+                        if tracing.ENABLED else None
                     broker.produce(topic, payload, key=keys[i],
                                    partition=None if partitions > 1 else 0,
-                                   timestamp_ms=ts)
+                                   timestamp_ms=ts, headers=hdrs)
                 count += n
                 continue
             for i in range(n):
@@ -219,9 +222,13 @@ class FleetGenerator:
                     payload = codec.encode(self.row_record(cols, i, schema))
                     if framed:
                         payload = frame(payload)
+                # trace birth for the broker-direct (no-MQTT) ingest leg;
+                # fully guarded: the disabled path makes no tracing calls
+                hdrs = tracing.birth_headers("devsim_publish") \
+                    if tracing.ENABLED else None
                 broker.produce(topic, payload, key=keys[i],
                                partition=None if partitions > 1 else 0,
-                               timestamp_ms=ts)
+                               timestamp_ms=ts, headers=hdrs)
                 count += 1
         return count
 

@@ -21,6 +21,7 @@ import threading
 import time
 from typing import List, Optional
 
+from ..obs import tracing
 from ..obs.metrics import default_registry
 from ..stream.broker import Broker
 from .broker import MqttBroker
@@ -72,9 +73,25 @@ class KafkaBridge:
             dest = m.stream_topic
 
             def deliver(topic, payload, qos, retain, _dest=dest):
+                # the publisher-thread trace context (fan-out latency so
+                # far = mqtt_deliver) becomes a stream-record header; the
+                # MQTT payload and the produced value stay byte-identical.
+                # BOTH marks happen BEFORE produce(): the append hands the
+                # live context to consumer threads, and a mark after the
+                # handoff would race theirs (mark is owner-serial by
+                # contract).  The produce latency itself is the
+                # kafka_extension_forward_seconds histogram below; on the
+                # trace it rides the downstream stage's span as queue time.
+                hdrs = None
+                ctx = tracing.current() if tracing.ENABLED else None
+                if ctx is not None:
+                    ctx.mark("mqtt_deliver")
+                    ctx.mark("bridge_produce")
+                    hdrs = tracing.headers_for(ctx)
                 t0 = time.perf_counter()
                 self.stream.produce(_dest, payload, key=topic.encode(),
-                                    timestamp_ms=int(time.time() * 1000))  # wallclock-ok: record timestamp, not a timeout
+                                    timestamp_ms=int(time.time() * 1000),  # wallclock-ok: record timestamp, not a timeout
+                                    headers=hdrs)
                 self._m_lag.observe(time.perf_counter() - t0)
                 self._m_fwd.inc()
                 with self._n_lock:

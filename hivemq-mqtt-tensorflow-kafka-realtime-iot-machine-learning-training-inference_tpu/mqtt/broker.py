@@ -17,6 +17,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..obs import tracing
 from ..obs.metrics import default_registry
 from .topic_tree import TopicTree, validate_filter
 
@@ -414,6 +415,27 @@ class MqttBroker:
         if "+" in topic or "#" in topic:
             raise ValueError(f"wildcards not allowed in publish topic: {topic!r}")
         self._m_in.inc()
+        # Trace injection: a record is born here.  Delivery is synchronous
+        # on THIS thread (fan-out after the lock is released), so the
+        # context rides a thread-local slot to every subscriber callback —
+        # the bridge reads it and forwards it as a stream-record header.
+        # MQTT 3 has no per-message metadata slot, so no wire change.
+        # Re-entrant publishes (a will fired mid-publish, a subscriber
+        # republishing) inherit the outer record's context rather than
+        # starting their own.
+        _tctx = _tprev = None
+        if tracing.ENABLED and tracing.current() is None:
+            _tctx = tracing.start("mqtt_publish")
+            if _tctx is not None:
+                _tprev = tracing.set_current(_tctx)
+        try:
+            return self._publish_locked_fanout(topic, payload, qos, retain)
+        finally:
+            if _tctx is not None:
+                tracing.set_current(_tprev)
+
+    def _publish_locked_fanout(self, topic: str, payload: bytes, qos: int,
+                               retain: bool) -> int:
         delivered = queued = 0
         live: List[Tuple[Session, int]] = []
         due_wills: list = []

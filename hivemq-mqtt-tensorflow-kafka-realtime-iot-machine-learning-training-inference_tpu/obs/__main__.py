@@ -1,0 +1,160 @@
+"""``python -m iotml.obs`` — observability CLI.
+
+    python -m iotml.obs trace SPANS.jsonl [--json] [--top N]
+                              [--min-stages N] [--require-e2e]
+
+``trace`` summarizes a span log written by `iotml.obs.tracing`
+(``IOTML_TRACE=1 IOTML_TRACE_PATH=spans.jsonl``) into a per-stage
+latency breakdown and flags the bottleneck stage — the question the
+reference stack's external Prometheus view cannot answer: *which stage
+ate the budget between the sensor reading and its anomaly score?*
+
+``--min-stages`` / ``--require-e2e`` turn the summary into an
+assertion (exit 1 on violation) for CI smoke runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+
+def _percentile(sorted_vals: List[int], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+    return float(sorted_vals[idx])
+
+
+def load_spans(path: str):
+    """Parse a span log: returns (stages, e2e) aggregation dicts."""
+    stages: Dict[str, List[int]] = {}
+    e2e: Dict[str, List[int]] = {}
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail line of a live run: skip
+            if doc.get("kind") == "span":
+                stages.setdefault(doc["stage"], []).append(int(doc["dur_us"]))
+            elif doc.get("kind") == "e2e":
+                e2e.setdefault(doc["closer"], []).append(int(doc["dur_us"]))
+    return stages, e2e
+
+
+def summarize(stages: Dict[str, List[int]], e2e: Dict[str, List[int]]) -> dict:
+    rows = []
+    for stage, durs in stages.items():
+        durs = sorted(durs)
+        rows.append({
+            "stage": stage,
+            "count": len(durs),
+            "mean_ms": sum(durs) / len(durs) / 1000.0,
+            "p50_ms": _percentile(durs, 0.50) / 1000.0,
+            "p95_ms": _percentile(durs, 0.95) / 1000.0,
+            "max_ms": durs[-1] / 1000.0,
+            "total_ms": sum(durs) / 1000.0,
+        })
+    # attribution by total time: the bottleneck is where the stream's
+    # aggregate latency budget went, not one unlucky record's max
+    rows.sort(key=lambda r: -r["total_ms"])
+    bottleneck = rows[0]["stage"] if rows else None
+    grand = sum(r["total_ms"] for r in rows) or 1.0
+    for r in rows:
+        r["share"] = r["total_ms"] / grand
+    e2e_rows = {closer: {
+        "count": len(durs),
+        "mean_ms": sum(durs) / len(durs) / 1000.0,
+        "p95_ms": _percentile(sorted(durs), 0.95) / 1000.0,
+        "max_ms": max(durs) / 1000.0,
+    } for closer, durs in e2e.items()}
+    return {"stages": rows, "e2e": e2e_rows, "bottleneck": bottleneck}
+
+
+def print_table(summary: dict) -> None:
+    rows = summary["stages"]
+    if not rows:
+        print("no spans found")
+        return
+    hdr = f"{'stage':<16} {'count':>8} {'mean_ms':>10} {'p50_ms':>10} " \
+          f"{'p95_ms':>10} {'max_ms':>10} {'total_ms':>11} {'share':>7}"
+    print(hdr)
+    print("-" * len(hdr))
+    for r in rows:
+        print(f"{r['stage']:<16} {r['count']:>8} {r['mean_ms']:>10.3f} "
+              f"{r['p50_ms']:>10.3f} {r['p95_ms']:>10.3f} "
+              f"{r['max_ms']:>10.3f} {r['total_ms']:>11.3f} "
+              f"{r['share']:>6.1%}")
+    for closer, r in sorted(summary["e2e"].items()):
+        print(f"\ne2e ingest->{closer}: {r['count']} records, "
+              f"mean {r['mean_ms']:.3f} ms, p95 {r['p95_ms']:.3f} ms, "
+              f"max {r['max_ms']:.3f} ms")
+    if summary["bottleneck"]:
+        b = rows[0]
+        print(f"\nbottleneck: {b['stage']} "
+              f"({b['share']:.0%} of aggregate stage time)")
+
+
+def cmd_trace(args) -> int:
+    try:
+        stages, e2e = load_spans(args.path)
+    except OSError as e:
+        print(f"cannot read span log: {e}", file=sys.stderr)
+        return 2
+    if args.top:
+        # keep the N slowest stages by total time (post-aggregation cap)
+        keep = sorted(stages, key=lambda s: -sum(stages[s]))[: args.top]
+        stages = {s: stages[s] for s in keep}
+    summary = summarize(stages, e2e)
+    if args.json:
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    else:
+        print_table(summary)
+    failures = []
+    if args.min_stages and len(summary["stages"]) < args.min_stages:
+        failures.append(f"expected >= {args.min_stages} distinct stages, "
+                        f"saw {len(summary['stages'])}: "
+                        f"{sorted(s['stage'] for s in summary['stages'])}")
+    if args.require_e2e:
+        closed = sum(r["count"] for r in summary["e2e"].values())
+        nonzero = any(r["max_ms"] > 0 for r in summary["e2e"].values())
+        if not closed or not nonzero:
+            failures.append("expected closed e2e spans with nonzero latency")
+    for f in failures:
+        print(f"TRACE CHECK FAILED: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m iotml.obs",
+        description="observability tools (span-log analysis)")
+    sub = ap.add_subparsers(dest="cmd")
+    tp = sub.add_parser(
+        "trace", help="summarize a JSONL span log into a per-stage "
+                      "latency breakdown and flag the bottleneck stage")
+    tp.add_argument("path", help="span log written under IOTML_TRACE_PATH")
+    tp.add_argument("--json", action="store_true",
+                    help="machine-readable summary")
+    tp.add_argument("--top", type=int, default=0,
+                    help="only the N slowest stages by total time")
+    tp.add_argument("--min-stages", type=int, default=0,
+                    help="exit 1 unless at least N distinct stages appear")
+    tp.add_argument("--require-e2e", action="store_true",
+                    help="exit 1 unless closed e2e spans with nonzero "
+                         "latency appear")
+    args = ap.parse_args(argv)
+    if args.cmd != "trace":
+        ap.print_help()
+        return 2
+    return cmd_trace(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

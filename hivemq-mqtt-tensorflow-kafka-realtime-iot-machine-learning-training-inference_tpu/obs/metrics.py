@@ -22,10 +22,19 @@ import time
 from typing import Dict, Optional, Sequence, Tuple
 
 
+def _escape_label_value(value) -> str:
+    """Prometheus text-format label escaping: backslash, double-quote and
+    newline must be escaped or the exposition line is unparseable (a
+    label value like `car="a\nb"` silently corrupts the whole scrape)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(labels: Optional[dict]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
     return "{" + inner + "}"
 
 
@@ -65,7 +74,11 @@ class Gauge(Counter):
 
 
 class Histogram:
-    """Fixed-bucket histogram (Prometheus cumulative-bucket convention)."""
+    """Fixed-bucket histogram (Prometheus cumulative-bucket convention).
+
+    Optionally labeled: ``observe(v, stage="decode")`` keeps one bucket
+    series per label set (the `iotml_stage_seconds{stage=...}` family);
+    unlabeled observations are the plain single-series histogram."""
 
     DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0)
 
@@ -73,20 +86,31 @@ class Histogram:
                  buckets: Sequence[float] = DEFAULT_BUCKETS):
         self.name, self.help = name, help_
         self.buckets = tuple(sorted(buckets))
-        self._counts = [0] * (len(self.buckets) + 1)
-        self._sum = 0.0
-        self._n = 0
+        # label-key tuple → [bucket counts..., +Inf count]; () = unlabeled
+        self._series: Dict[tuple, list] = {}
+        self._sums: Dict[tuple, float] = {}
+        self._ns: Dict[tuple, int] = {}
         self._lock = threading.Lock()
 
-    def observe(self, value: float):
+    def _counts_for(self, key: tuple) -> list:
+        counts = self._series.get(key)
+        if counts is None:
+            counts = self._series[key] = [0] * (len(self.buckets) + 1)
+            self._sums[key] = 0.0
+            self._ns[key] = 0
+        return counts
+
+    def observe(self, value: float, **labels):
+        key = tuple(sorted(labels.items()))
         with self._lock:
-            self._sum += value
-            self._n += 1
+            counts = self._counts_for(key)
+            self._sums[key] += value
+            self._ns[key] += 1
             for i, b in enumerate(self.buckets):
                 if value <= b:
-                    self._counts[i] += 1
+                    counts[i] += 1
                     return
-            self._counts[-1] += 1
+            counts[-1] += 1
 
     def time(self):
         """Context manager: observe elapsed seconds."""
@@ -105,16 +129,24 @@ class Histogram:
     def render(self) -> str:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         with self._lock:  # consistent bucket/sum/count snapshot under load
-            counts = list(self._counts)
-            total_sum, total_n = self._sum, self._n
-        cum = 0
-        for b, c in zip(self.buckets, counts):
-            cum += c
-            out.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
-        cum += counts[-1]
-        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
-        out.append(f"{self.name}_sum {total_sum}")
-        out.append(f"{self.name}_count {total_n}")
+            series = {k: list(v) for k, v in self._series.items()}
+            sums, ns = dict(self._sums), dict(self._ns)
+        if not series:
+            series[()] = [0] * (len(self.buckets) + 1)
+            sums[()], ns[()] = 0.0, 0
+        for key in sorted(series):
+            labels = dict(key)
+            cum = 0
+            for b, c in zip(self.buckets, series[key]):
+                cum += c
+                out.append(f"{self.name}_bucket"
+                           f"{_fmt_labels({**labels, 'le': b})} {cum}")
+            cum += series[key][-1]
+            out.append(f"{self.name}_bucket"
+                       f"{_fmt_labels({**labels, 'le': '+Inf'})} {cum}")
+            suffix = _fmt_labels(labels)
+            out.append(f"{self.name}_sum{suffix} {sums[key]}")
+            out.append(f"{self.name}_count{suffix} {ns[key]}")
         return "\n".join(out)
 
 
@@ -149,8 +181,13 @@ class Registry:
         for name, m in sorted(self._metrics.items()):
             if isinstance(m, Histogram):
                 with m._lock:
-                    out[f"{name}_sum"] = m._sum
-                    out[f"{name}_count"] = float(m._n)
+                    sums, ns = dict(m._sums), dict(m._ns)
+                if not sums:
+                    sums[()], ns[()] = 0.0, 0
+                for key in sorted(sums):
+                    suffix = _fmt_labels(dict(key))
+                    out[f"{name}_sum{suffix}"] = sums[key]
+                    out[f"{name}_count{suffix}"] = float(ns[key])
                 continue
             with m._lock:
                 vals = dict(m._vals)
@@ -188,21 +225,64 @@ live_detection_precision = default_registry.gauge(
 live_detection_recall = default_registry.gauge(
     "live_detection_recall",
     "live verdict recall vs stream labels (cumulative)")
+# stream-plane hot-path telemetry (ISSUE 2): batch/commit shape of the
+# consume path and the failure counters the serve loop's redelivery
+# story turns on — alongside the per-record trace spans (obs.tracing)
+fetch_batch_size = default_registry.histogram(
+    "iotml_fetch_batch_size", "records returned per non-empty consumer poll",
+    buckets=(1, 8, 32, 128, 512, 1024, 2048, 4096))
+commit_seconds = default_registry.histogram(
+    "iotml_commit_seconds", "consumer offset-commit latency")
+scorer_rewinds = default_registry.counter(
+    "iotml_scorer_rewinds_total",
+    "scorer rewind-to-committed redeliveries after a broker failover")
+replica_sync_rounds = default_registry.counter(
+    "iotml_replica_sync_rounds_total", "follower replication rounds")
+replica_copied = default_registry.counter(
+    "iotml_replica_copied_total", "messages copied leader -> follower")
+replica_sync_errors = default_registry.counter(
+    "iotml_replica_sync_errors_total",
+    "replication rounds that failed (leader dying / unreachable)")
 
 
 def start_http_server(port: int = 9100, registry: Registry = default_registry):
-    """Serve /metrics in Prometheus text format (daemon thread)."""
+    """Serve /metrics (Prometheus text format) and /healthz (per-stage
+    pipeline liveness from the trace collector) on a daemon thread."""
     import http.server
+    import json
+
+    def _healthz_body() -> bytes:
+        # late import: tracing imports this module for its histograms
+        from . import tracing
+
+        stages = tracing.liveness()
+        doc = {
+            "status": "ok",
+            "tracing": tracing.ENABLED,
+            # stage → seconds since its newest span: the stalled stage is
+            # the one whose age grows while its upstream stays fresh
+            "stages": {s: {"last_span_age_s": age}
+                       for s, age in stages.items()},
+        }
+        return json.dumps(doc, indent=2, sort_keys=True).encode()
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):
-            if self.path != "/metrics":
+            if self.path == "/metrics":
+                from . import tracing
+
+                tracing.flush()  # spans land in the histograms per scrape
+                body = registry.render().encode()
+                ctype = "text/plain; version=0.0.4"
+            elif self.path == "/healthz":
+                body = _healthz_body()
+                ctype = "application/json"
+            else:
                 self.send_response(404)
                 self.end_headers()
                 return
-            body = registry.render().encode()
             self.send_response(200)
-            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)

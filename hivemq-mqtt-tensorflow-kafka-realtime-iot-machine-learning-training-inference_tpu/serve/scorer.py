@@ -23,6 +23,7 @@ import jax
 import numpy as np
 
 from ..obs import metrics as obs_metrics
+from ..obs import tracing
 from ..data.dataset import SensorBatches
 from ..stream.producer import OutputSequence
 from ..train.loop import make_eval_step
@@ -194,6 +195,14 @@ class StreamScorer:
             # skips data; under sustained overload (every call truncated)
             # commits simply wait for the first completed drain.
             self.batches.consumer.commit()
+            if tracing.ENABLED:
+                # completed drain: every decoded record has been scored,
+                # so close each trace with its e2e (ingest → score) span.
+                # A truncated drain keeps traces pending with its
+                # suspended iterator — rows still inside the batcher's
+                # buffers must not report a score they haven't had.
+                for ctx in self.batches.take_traces():
+                    ctx.close("score")
         return self.scored - start
 
     def _score_super_batch(self, bs, base: int) -> None:
@@ -290,6 +299,7 @@ class StreamScorer:
                 n = self.score_available()
             except ConnectionError:
                 self.batches.consumer.rewind_to_committed()
+                obs_metrics.scorer_rewinds.inc()
                 rounds += 1
                 time.sleep(poll_interval_s)
                 continue

@@ -60,6 +60,12 @@ class Message(NamedTuple):
     value: bytes
     key: Optional[bytes] = None
     timestamp_ms: int = 0
+    #: optional ((name, value), ...) record headers — the trace-context
+    #: carrier (obs.tracing): metadata rides beside the payload so the
+    #: Avro bytes are untouched.  None (the untraced default) costs
+    #: nothing.  In-process only: MessageSet v1 on the wire has no
+    #: header slot, so wire/native clients drop them.
+    headers: Optional[tuple] = None
 
 
 @dataclasses.dataclass
@@ -75,7 +81,7 @@ class _Partition:
     __slots__ = ("log", "base_offset")
 
     def __init__(self):
-        self.log: List[tuple] = []  # (key, value, ts)
+        self.log: List[tuple] = []  # (key, value, ts, headers)
         self.base_offset = 0  # offset of log[0] after retention trimming
 
 
@@ -164,7 +170,8 @@ class Broker:
 
     # ------------------------------------------------------------ produce
     def produce(self, topic: str, value: bytes, key: Optional[bytes] = None,
-                partition: Optional[int] = None, timestamp_ms: int = 0) -> int:
+                partition: Optional[int] = None, timestamp_ms: int = 0,
+                headers: Optional[tuple] = None) -> int:
         """Append one record; returns its offset. Auto-creates 1-partition
         topics (matching Kafka's auto.create default used by the reference's
         local demos)."""
@@ -174,7 +181,7 @@ class Broker:
         with self._lock:
             p = self._partition_for(topic, key) if partition is None else partition
             part = self._parts[topic][p]
-            part.log.append((key, value, timestamp_ms))
+            part.log.append((key, value, timestamp_ms, headers))
             off = part.base_offset + len(part.log) - 1
             spec = self._topics[topic]
             if spec.retention_messages and len(part.log) > spec.retention_messages:
@@ -192,14 +199,17 @@ class Broker:
 
     def produce_many(self, topic: str, entries,
                      partition: Optional[int] = None) -> int:
-        """Bulk append [(key, value, timestamp_ms), ...] under ONE lock
-        acquisition; returns the offset of the last record appended.
+        """Bulk append [(key, value, timestamp_ms[, headers]), ...] under
+        ONE lock acquisition; returns the offset of the last record
+        appended.
 
         Same signature and return contract as the wire/native clients'
         produce_many (the Broker duck-type family), and the same
         per-record semantics as produce() (key-hash partitioning,
         retention trimming) — minus a lock round-trip and method dispatch
-        per message, the ingest bridges' hot path."""
+        per message, the ingest bridges' hot path.  The optional 4th
+        element carries record headers (trace context); wire/native
+        clients accept and drop it (no header slot on MessageSet v1)."""
         self._check_producer(topic)
         entries = list(entries)
         if topic not in self._topics:
@@ -208,11 +218,13 @@ class Broker:
         with self._lock:
             parts = self._parts[topic]
             spec = self._topics[topic]
-            for key, value, ts in entries:
+            for entry in entries:
+                key, value, ts = entry[0], entry[1], entry[2]
                 p = self._partition_for(topic, key) if partition is None \
                     else partition
                 part = parts[p]
-                part.log.append((key, value, ts))
+                part.log.append((key, value, ts,
+                                 entry[3] if len(entry) > 3 else None))
                 last_off = part.base_offset + len(part.log) - 1
             if spec.retention_messages:
                 for part in parts:
@@ -265,8 +277,8 @@ class Broker:
             start = max(offset, part.base_offset)
             idx = start - part.base_offset
             chunk = part.log[idx:idx + max_messages]
-        return [Message(topic, partition, start + i, value, key, ts)
-                for i, (key, value, ts) in enumerate(chunk)]
+        return [Message(topic, partition, start + i, value, key, ts, hdrs)
+                for i, (key, value, ts, hdrs) in enumerate(chunk)]
 
     # ------------------------------------------------- consumer-group API
     def commit(self, group: str, topic: str, partition: int, next_offset: int):

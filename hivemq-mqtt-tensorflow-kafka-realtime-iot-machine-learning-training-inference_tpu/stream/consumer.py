@@ -18,6 +18,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ..obs import metrics as obs_metrics
 from .broker import Broker, Message
 
 
@@ -93,6 +94,12 @@ class StreamConsumer:
                 cur[2] = batch[-1].offset + 1
                 out.extend(batch)
                 attempts = 0  # progress was made; give others another chance
+        if out:
+            # batch-shape telemetry: a drifting-down batch size under
+            # constant load means the consumer is outpacing the producers
+            # (or fetches are being truncated) — only non-empty polls
+            # observe, so idle polling does not flood the 1-bucket
+            obs_metrics.fetch_batch_size.observe(len(out))
         return out
 
     def poll_decoded(self, codec, strip: int = 5, max_messages: int = 4096,
@@ -172,15 +179,17 @@ class StreamConsumer:
         return [tuple(c) for c in self._cursors]
 
     def commit(self):
-        commit_many = getattr(self.broker, "commit_many", None)
-        if commit_many is not None:
-            # one request per topic instead of one per partition — over
-            # the wire each commit is a round trip into the broker process
-            by_topic: dict = {}
+        with obs_metrics.commit_seconds.time():
+            commit_many = getattr(self.broker, "commit_many", None)
+            if commit_many is not None:
+                # one request per topic instead of one per partition — over
+                # the wire each commit is a round trip into the broker
+                # process
+                by_topic: dict = {}
+                for t, p, off in self._cursors:
+                    by_topic.setdefault(t, []).append((p, off))
+                for t, entries in by_topic.items():
+                    commit_many(self.group, t, entries)
+                return
             for t, p, off in self._cursors:
-                by_topic.setdefault(t, []).append((p, off))
-            for t, entries in by_topic.items():
-                commit_many(self.group, t, entries)
-            return
-        for t, p, off in self._cursors:
-            self.broker.commit(self.group, t, p, off)
+                self.broker.commit(self.group, t, p, off)

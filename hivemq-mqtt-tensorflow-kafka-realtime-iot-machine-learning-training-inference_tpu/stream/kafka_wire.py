@@ -350,7 +350,10 @@ class ProducePartitionMixin:
         return zlib.crc32(key) % n
 
     def produce(self, topic: str, value: bytes, key: Optional[bytes] = None,
-                partition: Optional[int] = None, timestamp_ms: int = 0) -> int:
+                partition: Optional[int] = None, timestamp_ms: int = 0,
+                headers: Optional[tuple] = None) -> int:
+        # headers accepted for Broker duck-type parity and dropped: the
+        # wire protocol (MessageSet v1) has no header slot
         return self.produce_many(topic, [(key, value, timestamp_ms)],
                                  partition=partition)
 
@@ -585,9 +588,12 @@ class KafkaWireBroker(ProducePartitionMixin):
         return n
 
     def produce_many(self, topic: str, entries, partition=None) -> int:
-        """entries: [(key, value, timestamp_ms)] → offset of the last one."""
+        """entries: [(key, value, timestamp_ms[, headers])] → offset of the
+        last one.  Record headers (the trace-context carrier on the
+        in-process broker) are DROPPED here: MessageSet v1 has no header
+        slot, so traces end at a wire-broker boundary by design."""
         by_part: Dict[int, list] = {}
-        for key, value, ts in entries:
+        for key, value, ts, *_hdrs in entries:
             p = self._partition_for(topic, key) if partition is None else partition
             by_part.setdefault(p, []).append((0, key, value, ts))
         last = -1

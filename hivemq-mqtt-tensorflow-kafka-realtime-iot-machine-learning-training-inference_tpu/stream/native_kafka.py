@@ -191,10 +191,13 @@ class NativeKafkaBroker(ProducePartitionMixin):
             return 1
 
     def produce_many(self, topic: str, entries, partition=None) -> int:
-        """entries: [(key, value, timestamp_ms)] → offset of the last one."""
+        """entries: [(key, value, timestamp_ms[, headers])] → offset of
+        the last one.  Trailing record headers (trace context on the
+        in-process broker) are dropped — the native log has no header
+        column; traces end at the native-engine boundary by design."""
         with self._lock:
             by_part: Dict[int, list] = {}
-            for key, value, ts in entries:
+            for key, value, ts, *_hdrs in entries:
                 p = self._partition_for(topic, key) if partition is None \
                     else partition
                 by_part.setdefault(p, []).append((key, value, ts))

@@ -36,6 +36,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..obs import metrics as obs_metrics
 from .broker import Broker
 from .kafka_wire import KafkaWireBroker, KafkaWireServer
 
@@ -129,9 +130,11 @@ class FollowerReplica:
             except Exception as e:  # noqa: BLE001 - leader may be dying;
                 # the follower's job is to keep serving what it has
                 self.sync_errors.append(f"{type(e).__name__}: {e}")
+                obs_metrics.replica_sync_errors.inc()
                 time.sleep(self._interval * 4)
                 continue
             self.rounds += 1
+            obs_metrics.replica_sync_rounds.inc()
             if not moved:
                 time.sleep(self._interval)
 
@@ -176,10 +179,16 @@ class FollowerReplica:
                             f"{local_end}->{msgs[0].offset}; realigned")
                         self.local.reset_partition(t, p, msgs[0].offset)
                     for m in msgs:
+                        # headers mirrored too (None over the wire — the
+                        # protocol has no header slot; one-to-one for an
+                        # in-process leader)
                         self.local.produce(t, m.value, key=m.key,
                                            partition=p,
-                                           timestamp_ms=m.timestamp_ms)
+                                           timestamp_ms=m.timestamp_ms,
+                                           headers=m.headers)
                     copied += len(msgs)
+        if copied:
+            obs_metrics.replica_copied.inc(copied)
         if mirror_commits is None:
             mirror_commits = bool(copied) or (
                 time.monotonic() - self._last_commit_sync

@@ -21,6 +21,7 @@ import json
 from typing import Dict, List, Optional, Tuple
 
 from ..core.schema import CAR_SCHEMA, KSQL_CAR_SCHEMA
+from ..obs import tracing
 from ..ops.avro import AvroCodec
 from ..ops.framing import frame
 from ..stream.broker import Broker, Message
@@ -50,6 +51,34 @@ class StreamTask:
         """Return [(key, value, timestamp_ms)] outputs."""
         raise NotImplementedError
 
+    def _forward_traces(self, msgs, outs):
+        """Re-attach trace headers to a chunk's outputs and mark the
+        `streamproc` stage.  Tasks emit (key, value, ts) without their
+        source messages, so forwarding happens HERE, positionally — sound
+        only for 1:1 chunks (every task builds outputs in input order).
+        Filtering chunks (row drops) lose the association and the trace
+        simply ends at this stage: graceful degradation, sampled traces
+        are statistics, not an audit log.
+
+        The output carries a FORK of the input's context, marked on this
+        task's lineage — never a mark on the shared input object: the
+        input topic's other consumers (a sibling task, a batcher) fork
+        from the same header, and mutating its t_last after handoff
+        would skew their spans by a stage their pipeline never ran."""
+        if len(outs) != len(msgs):
+            return outs
+        fwd = []
+        for m, out in zip(msgs, outs):
+            ctx = tracing.from_headers(m.headers) if m.headers else None
+            if ctx is not None:
+                hop = ctx.fork()
+                hop.mark("streamproc")
+                fwd.append((out[0], out[1], out[2],
+                            tracing.headers_for(hop)))
+            else:
+                fwd.append(out)
+        return fwd
+
     def process_available(self, chunk: int = 4096) -> int:
         """Consume and transform everything currently available.
 
@@ -65,6 +94,8 @@ class StreamTask:
                 return n
             outs = self.process(msgs)
             if outs:
+                if tracing.ENABLED:
+                    outs = self._forward_traces(msgs, outs)
                 # ONE bulk append per chunk: a per-record produce() paid
                 # a lock round-trip + partitioner dispatch per message —
                 # ~24% of the whole KSQL pump at fleet rates.  Same

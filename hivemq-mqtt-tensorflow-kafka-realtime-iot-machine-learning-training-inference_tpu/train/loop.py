@@ -29,6 +29,7 @@ from flax import struct
 from flax.core import FrozenDict
 
 from ..obs import metrics as obs_metrics
+from ..obs import tracing
 
 
 @struct.dataclass
@@ -267,6 +268,14 @@ class Trainer:
                 for cb in callbacks:
                     cb.on_batch_end(b, m)
             dt = time.perf_counter() - t0
+            if tracing.ENABLED and hasattr(batches, "take_traces"):
+                # every record decoded this epoch went through the step:
+                # close with the e2e (ingest → train) span.  Epoch 2+ of a
+                # stream re-read decodes the same records again — each
+                # re-read is its own trace only if re-injected upstream,
+                # so typically only the first epoch closes spans.
+                for ctx in batches.take_traces():
+                    ctx.close("train")
             history["loss"].append(tot_loss / max(n, 1))
             history["accuracy"].append(tot_acc / max(n, 1))
             history["records"].append(records)
@@ -347,6 +356,13 @@ class Trainer:
             self.state, (losses, accs) = scanned(self.state, xs, ys, masks,
                                                  epochs)
         obs_metrics.records_trained.inc(records * epochs)
+        if tracing.ENABLED and hasattr(batches, "take_traces"):
+            # the whole fit ran as one device program: per-record close
+            # lands here, after the scan — the e2e span includes the
+            # compiled fit, which is exactly what ingest-to-train means
+            # for this path
+            for ctx in batches.take_traces():
+                ctx.close("train")
         # ONE sync for both metric vectors: each device_get is a full
         # tunnel round trip, and the second would wait on nothing new
         losses, accs = (np.asarray(a)

@@ -1,0 +1,29 @@
+"""Seeded R6 violations: a malformed framework metric name, an
+uppercase span name, and span recording under a held lock — directly
+and through a module-local helper chain."""
+
+import threading
+
+
+class Pump:
+    def __init__(self, registry, tracing):
+        self._lock = threading.Lock()
+        self.registry = tracing  # naming only; never executed
+        self._m = registry.counter("iotml-Records.Total")  # R6: bad name
+        self._h = registry.histogram("iotml_fetch_seconds")  # clean
+
+    def _note(self, ctx):
+        ctx.mark("decode")
+
+    def step_direct(self, ctx):
+        with self._lock:
+            ctx.mark("decode")                  # R6: span under lock
+
+    def step_transitive(self, ctx):
+        with self._lock:
+            self._note(ctx)                     # R6: records 1 frame down
+
+    def step_outside(self, ctx):
+        ctx.mark("Decode-Stage")                # R6: bad stage name
+        with self._lock:
+            return 1                            # clean: no span inside

@@ -34,6 +34,7 @@ def test_lint_flags_every_seeded_violation():
     assert by_file.get("bad_retry.py") == {"R2"}
     assert by_file.get("bad_blocking.py") == {"R4"}
     assert by_file.get("bad_owned_topic.py") == {"R5"}
+    assert by_file.get("bad_span_metric.py") == {"R6"}
     # a reason-less suppression is itself a finding AND does not suppress
     assert by_file.get("bad_suppression.py") == {"R3"}
     # the runtime fixture is lint-clean (locks held via `with` only)
@@ -57,6 +58,23 @@ def test_lint_r4_direct_and_transitive_but_not_outside():
     # step_outside's recv (lock not held) stays clean
     assert "recv" in findings[0].message
     assert "_next" in findings[1].message or "recv" in findings[1].message
+
+
+def test_lint_r6_naming_and_span_under_lock():
+    """R6 both halves: naming convention (metric + stage literals) and
+    span recording under a held lock, direct and through the module
+    call-graph walk (reused from R4)."""
+    path = os.path.join(FIXTURES, "bad_span_metric.py")
+    findings = lint_file(path)
+    assert [f.rule for f in findings] == ["R6"] * 4
+    assert [f.line for f in findings] == [12, 20, 24, 27]
+    msgs = [f.message for f in findings]
+    assert "iotml-Records.Total" in msgs[0]          # malformed family name
+    assert "while holding _lock" in msgs[1]          # direct mark under lock
+    assert "_note()" in msgs[2]                      # transitive chain named
+    assert "Decode-Stage" in msgs[3]                 # malformed stage name
+    # clean shapes stay clean: a conforming iotml_ name and a mark with
+    # no lock held produced no findings (exactly the 4 above)
 
 
 def test_lint_clean_on_the_tree():

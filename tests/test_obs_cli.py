@@ -28,6 +28,58 @@ def test_registry_render_prometheus_text():
     assert "iotml_train_step_seconds_count 3" in text
 
 
+def test_label_value_escaping_per_exposition_spec():
+    """Regression (ISSUE 2 satellite): label values containing a
+    backslash, a double-quote or a newline must render per the
+    Prometheus text-format escaping rules — the pre-fix _fmt_labels
+    emitted them raw, corrupting the whole scrape."""
+    reg = Registry()
+    c = reg.counter("iotml_poison_total")
+    c.inc(1, path='a"b', note="back\\slash", multi="line1\nline2")
+    text = reg.render()
+    assert 'path="a\\"b"' in text
+    assert 'note="back\\\\slash"' in text
+    assert 'multi="line1\\nline2"' in text
+    assert "\nline2" not in text  # no raw newline inside a label value
+    # the sample line parses as `name{k="v",...} value` with only
+    # escaped specials inside each quoted value
+    import re
+
+    sample = [ln for ln in text.splitlines()
+              if ln.startswith("iotml_poison_total{")]
+    assert len(sample) == 1
+    label_val = r'"(?:[^"\\\n]|\\\\|\\"|\\n)*"'
+    assert re.fullmatch(
+        r"iotml_poison_total\{[a-z_]+=%s(?:,[a-z_]+=%s)*\} 1\.0"
+        % (label_val, label_val), sample[0])
+
+
+def test_labeled_histogram_series_render():
+    """iotml_stage_seconds-style families: one bucket/sum/count series
+    per label set, plus the unlabeled backward-compatible shape."""
+    reg = Registry()
+    h = reg.histogram("iotml_stage_seconds", "per-stage", buckets=(0.1, 1.0))
+    h.observe(0.05, stage="decode")
+    h.observe(0.5, stage="decode")
+    h.observe(0.5, stage="score")
+    text = reg.render()
+    assert 'iotml_stage_seconds_bucket{le="0.1",stage="decode"} 1' in text
+    assert 'iotml_stage_seconds_bucket{le="+Inf",stage="decode"} 2' in text
+    assert 'iotml_stage_seconds_count{stage="decode"} 2' in text
+    assert 'iotml_stage_seconds_count{stage="score"} 1' in text
+    assert text.count("# TYPE iotml_stage_seconds histogram") == 1
+    snap = reg.collect()
+    assert snap['iotml_stage_seconds_count{stage="decode"}'] == 2.0
+    # unlabeled histograms keep the exact legacy exposition shape
+    reg2 = Registry()
+    h2 = reg2.histogram("iotml_train_step_seconds", buckets=(0.1, 1.0))
+    h2.observe(0.05)
+    t2 = reg2.render()
+    assert 'iotml_train_step_seconds_bucket{le="0.1"} 1' in t2
+    assert "iotml_train_step_seconds_count 1" in t2
+    assert reg2.collect()["iotml_train_step_seconds_count"] == 1.0
+
+
 def test_metrics_http_server():
     reg = Registry()
     reg.counter("iotml_test_total").inc(3)

@@ -106,16 +106,40 @@ def test_consumer_survives_leader_death_mid_drain():
         consumer = StreamConsumer(client, [f"T:{p}:0" for p in range(2)],
                                   group="g2")
         seen = []
-        while len(seen) < total // 2:
+        deadline = time.monotonic() + 20
+        while len(seen) < total // 2 and time.monotonic() < deadline:
             for m in consumer.poll(200):
                 seen.append((m.partition, m.offset, m.value))
+        assert len(seen) >= total // 2
         consumer.commit()
-        # replicate the commit, then the leader dies abruptly
-        rep.sync_once()
+        # Wait until the BACKGROUND replication loop has mirrored the
+        # commit (poll-until-deadline on the actual catch-up condition).
+        # Driving rep.sync_once() from this thread — the pre-deflake
+        # version — races the loop's own concurrent round, and a blind
+        # sleep just moves the race; the condition is what we wait on.
+        want = {p: off for _, p, off in consumer.positions()}
+
+        def commit_mirrored():
+            return all(rep.local.committed("g2", "T", p) == want[p]
+                       for p in range(2))
+
+        deadline = time.monotonic() + 15
+        while not commit_mirrored() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert commit_mirrored()
+        # the leader dies abruptly
         srv.kill()
-        deadline = time.time() + 20
-        while len(seen) < total and time.time() < deadline:
-            for m in consumer.poll(200):
+        deadline = time.monotonic() + 20
+        while len(seen) < total and time.monotonic() < deadline:
+            try:
+                batch = consumer.poll(200)
+            except ConnectionError:
+                # kill() can race an in-flight fetch AND its one
+                # post-reconnect retry (half-closed leader socket):
+                # transient during failover — re-poll until the deadline
+                time.sleep(0.05)
+                continue
+            for m in batch:
                 seen.append((m.partition, m.offset, m.value))
         assert len(seen) == total
         # exactly once across the failover: offsets contiguous per

@@ -1,0 +1,406 @@
+"""Record-level tracing (iotml.obs.tracing): context propagation device
+→ MQTT → bridge → KSQL → consumer → scorer/train via record headers,
+the lock-free span collector, the Prometheus/JSONL/healthz exporters and
+the ``python -m iotml.obs trace`` CLI.
+
+The acceptance pipeline (ISSUE 2): a traced local run produces a span
+log with >= 5 distinct stages, the CLI prints a per-stage breakdown and
+flags the bottleneck, and the stage/e2e histograms render valid
+exposition text — while the DISABLED default records nothing and
+allocates nothing on the record path.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+from iotml.data.dataset import SensorBatches
+from iotml.gen.simulator import FleetGenerator, FleetScenario
+from iotml.mqtt.bridge import KafkaBridge
+from iotml.mqtt.broker import MqttBroker
+from iotml.obs import metrics as obs_metrics
+from iotml.obs import tracing
+from iotml.stream.broker import Broker
+from iotml.stream.consumer import StreamConsumer
+from iotml.streamproc.tasks import JsonToAvro, RekeyByCar
+
+
+@pytest.fixture
+def traced(tmp_path):
+    """Tracing on, full sampling, span log in tmp; restored after."""
+    path = str(tmp_path / "spans.jsonl")
+    tracing.reset()
+    tracing.configure(enabled=True, sample=1.0, path=path)
+    try:
+        yield path
+    finally:
+        tracing.configure(enabled=False, sample=1.0)
+        tracing.reset()
+        tracing._PATH = None
+
+
+def _sensor_json(i: int) -> bytes:
+    rec = {"coolant_temp": 20.0 + i, "intake_air_temp": 21.0,
+           "intake_air_flow_speed": 1.0, "battery_percentage": 70.0,
+           "battery_voltage": 220.0, "current_draw": 5.0, "speed": 20.0,
+           "engine_vibration_amplitude": 2000.0, "throttle_pos": 0.4,
+           "tire_pressure_1_1": 30, "tire_pressure_1_2": 30,
+           "tire_pressure_2_1": 30, "tire_pressure_2_2": 30,
+           "accelerometer_1_1_value": 0.5, "accelerometer_1_2_value": 0.5,
+           "accelerometer_2_1_value": 0.5, "accelerometer_2_2_value": 0.5,
+           "control_unit_firmware": 1000, "failure_occurred": "false"}
+    return json.dumps(rec).encode()
+
+
+def _mqtt_to_avro_pipeline(n=30):
+    """devsim-shaped publishes → MQTT broker → bridge → KSQL tasks."""
+    mqtt = MqttBroker()
+    stream = Broker()
+    KafkaBridge(mqtt, stream, partitions=2)
+    for i in range(n):
+        mqtt.publish(f"vehicles/sensor/data/car{i % 5}", _sensor_json(i),
+                     qos=1)
+    JsonToAvro(stream, src="sensor-data",
+               dst="SENSOR_DATA_S_AVRO").process_available()
+    RekeyByCar(stream, src="SENSOR_DATA_S_AVRO",
+               dst="SENSOR_DATA_S_AVRO_REKEY",
+               partitions=2).process_available()
+    return stream
+
+
+# ------------------------------------------------------------- unit level
+def test_context_marks_and_closes_spans(traced):
+    ctx = tracing.start("mqtt_publish")
+    assert ctx is not None
+    ctx.mark("consume")
+    ctx.close("score")
+    ctx.close("score")  # idempotent: double close records nothing new
+    assert tracing.flush() == {"spans": 3, "e2e": 1}
+    rows = [json.loads(l) for l in open(traced)]
+    stages = [r["stage"] for r in rows if r["kind"] == "span"]
+    assert stages == ["mqtt_publish", "consume", "score"]
+    e2e = [r for r in rows if r["kind"] == "e2e"]
+    assert len(e2e) == 1 and e2e[0]["closer"] == "score"
+    # one trace id threads every row
+    assert len({r["trace"] for r in rows}) == 1
+
+
+def test_disabled_records_nothing_and_attaches_no_headers():
+    tracing.reset()
+    assert tracing.ENABLED is False  # the off-by-default contract
+    assert tracing.start("mqtt_publish") is None
+    assert tracing.headers_for(None) is None
+    broker = Broker()
+    broker.produce("t", b"v")
+    assert broker.fetch("t", 0, 0)[0].headers is None
+    assert tracing.flush() == {"spans": 0, "e2e": 0}
+
+
+def test_sampling_zero_traces_nothing(traced):
+    tracing.configure(sample=0.0)
+    try:
+        assert tracing.start("mqtt_publish") is None
+    finally:
+        tracing.configure(sample=1.0)
+
+
+def test_wire_encode_decode_roundtrip(traced):
+    ctx = tracing.start("mqtt_publish")
+    raw = ctx.encode()
+    back = tracing.TraceContext.decode(raw)
+    assert back is not None
+    assert back.trace_id == ctx.trace_id
+    assert back.wall0_ns == ctx.wall0_ns
+    assert tracing.TraceContext.decode(b"junk") is None
+    # headers carry either the live object or the byte form
+    assert tracing.from_headers(((tracing.HEADER_KEY, ctx),)) is ctx
+    assert tracing.from_headers(((tracing.HEADER_KEY, raw),)).trace_id \
+        == ctx.trace_id
+    assert tracing.from_headers(None) is None
+
+
+def test_broker_carries_headers_through_produce_and_fetch():
+    broker = Broker()
+    broker.create_topic("t", partitions=2)
+    hdr = (("iotml_trace", "x"),)
+    broker.produce("t", b"v1", key=b"k", headers=hdr)
+    broker.produce_many("t", [(b"k", b"v2", 0, hdr), (b"k", b"v3", 0)])
+    msgs = []
+    for p in range(2):
+        msgs += broker.fetch("t", p, 0)
+    by_val = {m.value: m.headers for m in msgs}
+    assert by_val[b"v1"] == hdr and by_val[b"v2"] == hdr
+    assert by_val[b"v3"] is None
+
+
+# ------------------------------------------------------- pipeline level
+def _e2e_score_count() -> float:
+    # the registry is process-global (accumulates across tests): count
+    # deltas, never absolutes
+    return obs_metrics.default_registry.collect().get(
+        "iotml_e2e_ingest_to_score_seconds_count", 0.0)
+
+
+def test_trace_propagates_mqtt_to_scorer_stages(traced):
+    before = _e2e_score_count()
+    stream = _mqtt_to_avro_pipeline(n=30)
+    consumer = StreamConsumer(stream, ["SENSOR_DATA_S_AVRO:0:0"], group="g")
+    batches = SensorBatches(consumer, batch_size=10)
+    assert sum(b.n_valid for b in batches) == 30
+    for ctx in batches.take_traces():
+        ctx.close("score")
+    tracing.flush()
+    rows = [json.loads(l) for l in open(traced)]
+    stages = {r["stage"] for r in rows if r["kind"] == "span"}
+    # the acceptance bar: >= 5 distinct stages through the real pipeline
+    assert {"mqtt_publish", "mqtt_deliver", "bridge_produce",
+            "streamproc", "consume", "score"} <= stages
+    e2e = [r for r in rows if r["kind"] == "e2e"]
+    assert len(e2e) == 30
+    assert all(r["dur_us"] > 0 for r in e2e)
+    # histograms made it into the registry with valid exposition
+    text = obs_metrics.default_registry.render()
+    assert 'iotml_stage_seconds_count{stage="consume"}' in text
+    assert _e2e_score_count() - before == 30
+
+
+def test_scorer_closes_traces_end_to_end(traced):
+    from iotml.models.autoencoder import CAR_AUTOENCODER
+    from iotml.serve.scorer import StreamScorer
+    from iotml.stream.producer import OutputSequence
+    from iotml.train.loop import Trainer
+
+    before = _e2e_score_count()
+    stream = _mqtt_to_avro_pipeline(n=30)
+    consumer = StreamConsumer(stream, ["SENSOR_DATA_S_AVRO:0:0"],
+                              group="serve")
+    batches = SensorBatches(consumer, batch_size=10)
+    trainer = Trainer(CAR_AUTOENCODER)
+    trainer._ensure_state(np.zeros((10, 18), np.float32))
+    scorer = StreamScorer(CAR_AUTOENCODER, trainer.state.params, batches,
+                          OutputSequence(stream, "model-predictions",
+                                         partition=0))
+    assert scorer.score_available() == 30
+    tracing.flush()
+    rows = [json.loads(l) for l in open(traced)]
+    closers = [r["closer"] for r in rows if r["kind"] == "e2e"]
+    assert closers.count("score") == 30
+    assert _e2e_score_count() - before == 30
+
+
+def test_trainer_closes_traces_with_train_e2e(traced):
+    from iotml.models.autoencoder import CAR_AUTOENCODER
+    from iotml.train.loop import Trainer
+
+    gen = FleetGenerator(FleetScenario(num_cars=20, seed=3))
+    stream = Broker()
+    gen.publish(stream, "SENSOR_DATA_S_AVRO", n_ticks=3, partitions=1)
+    consumer = StreamConsumer(stream, ["SENSOR_DATA_S_AVRO:0:0"],
+                              group="train")
+    batches = SensorBatches(consumer, batch_size=10, only_normal=True)
+    Trainer(CAR_AUTOENCODER).fit(batches, epochs=1)
+    tracing.flush()
+    rows = [json.loads(l) for l in open(traced)]
+    stages = {r["stage"] for r in rows if r["kind"] == "span"}
+    assert "devsim_publish" in stages and "train" in stages
+    assert any(r["kind"] == "e2e" and r["closer"] == "train" for r in rows)
+
+
+def test_two_pipelines_close_their_own_forks(traced):
+    """The demo's normal shape — train over a topic, then score the SAME
+    topic with another consumer group.  The header carries one shared
+    context; each pipeline must fork and close its own copy, or the
+    first closer steals the trace and the scorer leg goes dark
+    (regression: pre-fork, zero 'score' e2e spans came out of the demo).
+    Epoch re-reads within ONE pipeline still trace once (dedup)."""
+    from iotml.models.autoencoder import CAR_AUTOENCODER
+    from iotml.train.loop import Trainer
+
+    stream = _mqtt_to_avro_pipeline(n=30)
+    # pipeline 1: train, 2 epochs (the epoch re-read must not re-close)
+    c1 = StreamConsumer(stream, ["SENSOR_DATA_S_AVRO:0:0"], group="train")
+    b1 = SensorBatches(c1, batch_size=10, only_normal=True)
+    Trainer(CAR_AUTOENCODER).fit(b1, epochs=2)
+    # pipeline 2: an independent consumer group over the same topic
+    c2 = StreamConsumer(stream, ["SENSOR_DATA_S_AVRO:0:0"], group="serve")
+    b2 = SensorBatches(c2, batch_size=10)
+    assert sum(b.n_valid for b in b2) == 30
+    for ctx in b2.take_traces():
+        ctx.close("score")
+    tracing.flush()
+    rows = [json.loads(l) for l in open(traced)]
+    closers = [r["closer"] for r in rows if r["kind"] == "e2e"]
+    assert closers.count("train") == 30  # once, not once per epoch
+    assert closers.count("score") == 30  # NOT stolen by the train close
+    # both pipelines logged under the same trace ids (one id, two closers)
+    by_kind = {}
+    for r in rows:
+        if r["kind"] == "e2e":
+            by_kind.setdefault(r["trace"], set()).add(r["closer"])
+    assert all(v == {"train", "score"} for v in by_kind.values())
+
+
+def test_truncated_drain_defers_close_until_complete(traced):
+    """A max_rows-truncated drain must NOT close traces — rows are still
+    buffered in the suspended iterator; the completing drain closes all."""
+    from iotml.models.autoencoder import CAR_AUTOENCODER
+    from iotml.serve.scorer import StreamScorer
+    from iotml.stream.producer import OutputSequence
+    from iotml.train.loop import Trainer
+
+    stream = _mqtt_to_avro_pipeline(n=30)
+    consumer = StreamConsumer(stream, ["SENSOR_DATA_S_AVRO:0:0"],
+                              group="serve")
+    batches = SensorBatches(consumer, batch_size=10)
+    trainer = Trainer(CAR_AUTOENCODER)
+    trainer._ensure_state(np.zeros((10, 18), np.float32))
+    scorer = StreamScorer(CAR_AUTOENCODER, trainer.state.params, batches,
+                          OutputSequence(stream, "model-predictions",
+                                         partition=0))
+    assert scorer.score_available(max_rows=10) >= 10
+    tracing.flush()
+    rows = [json.loads(l) for l in open(traced)] if os.path.exists(traced) \
+        else []
+    assert not any(r["kind"] == "e2e" for r in rows)
+    scorer.score_available()  # completes the drain
+    tracing.flush()
+    rows = [json.loads(l) for l in open(traced)]
+    assert sum(r["kind"] == "e2e" for r in rows) == 30
+
+
+def test_large_drain_holds_every_pending_fork(traced):
+    """Regression: the pending-forks bound must cover a full drain at
+    full sampling — a 4096-cap silently dropped ~900 of a 5000-record
+    backlog's e2e spans before the closer ever saw them."""
+    gen = FleetGenerator(FleetScenario(num_cars=100, seed=5))
+    stream = Broker()
+    gen.publish(stream, "SENSOR_DATA_S_AVRO", n_ticks=50, partitions=1)
+    consumer = StreamConsumer(stream, ["SENSOR_DATA_S_AVRO:0:0"],
+                              group="big")
+    batches = SensorBatches(consumer, batch_size=100)
+    assert sum(b.n_valid for b in batches) == 5000
+    forks = batches.take_traces()
+    assert len(forks) == 5000
+    for ctx in forks:
+        ctx.close("score")
+    assert tracing.flush()["e2e"] == 5000
+
+
+def test_collector_is_lock_free_under_lockcheck():
+    """Recording a span takes no lock: under the runtime lockcheck the
+    record path must not create or acquire any CheckedLock (the R6 lint
+    closes the same invariant statically)."""
+    from iotml.analysis import lockcheck
+
+    if lockcheck.state() is not None:
+        pytest.skip("session-level lockcheck active")
+    tracing.reset()
+    tracing.configure(enabled=True, sample=1.0)
+    st = lockcheck.install()
+    try:
+        ctx = tracing.start("mqtt_publish")
+        ctx.mark("consume")
+        ctx.close("score")
+        assert st.cycles() == []
+        assert not any(v.kind == "io-under-lock" for v in st.violations)
+    finally:
+        lockcheck.uninstall()
+        tracing.configure(enabled=False)
+        tracing.reset()
+
+
+def test_liveness_reports_stage_ages(traced):
+    ctx = tracing.start("mqtt_publish")
+    ctx.close("score")
+    ages = tracing.liveness()
+    assert set(ages) >= {"mqtt_publish", "score"}
+    assert all(a >= 0 for a in ages.values())
+
+
+def test_healthz_endpoint_serves_stage_liveness(traced):
+    ctx = tracing.start("mqtt_publish")
+    ctx.close("score")
+    srv = obs_metrics.start_http_server(port=0)
+    try:
+        port = srv.server_address[1]
+        doc = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5).read())
+        assert doc["status"] == "ok" and doc["tracing"] is True
+        assert "mqtt_publish" in doc["stages"]
+        assert doc["stages"]["score"]["last_span_age_s"] >= 0
+        # the scrape path drains spans into the histograms too
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert 'iotml_stage_seconds_count{stage="mqtt_publish"}' in body
+    finally:
+        srv.shutdown()
+
+
+def test_env_configuration(monkeypatch, tmp_path):
+    monkeypatch.setenv("IOTML_TRACE", "1")
+    monkeypatch.setenv("IOTML_TRACE_SAMPLE", "0.25")
+    monkeypatch.setenv("IOTML_TRACE_PATH", str(tmp_path / "t.jsonl"))
+    tracing.configure_from_env()
+    try:
+        assert tracing.ENABLED is True
+        assert tracing._SAMPLE == 0.25
+        assert tracing._PATH == str(tmp_path / "t.jsonl")
+    finally:
+        tracing.configure(enabled=False, sample=1.0)
+        tracing._PATH = None
+    # the toggles are process toggles, not pipeline config: the loud
+    # failure typo check must accept them
+    from iotml.config import load_config
+
+    cfg, _ = load_config(env={"IOTML_TRACE": "1",
+                              "IOTML_TRACE_SAMPLE": "0.01",
+                              "IOTML_TRACE_PATH": "/tmp/x.jsonl"})
+    assert cfg.train.epochs == 20  # resolved fine, toggles ignored
+
+
+# ------------------------------------------------------------------- CLI
+def test_obs_trace_cli_summarizes_and_flags_bottleneck(traced, tmp_path):
+    stream = _mqtt_to_avro_pipeline(n=30)
+    consumer = StreamConsumer(stream, ["SENSOR_DATA_S_AVRO:0:0"], group="g")
+    batches = SensorBatches(consumer, batch_size=10)
+    list(batches)
+    for ctx in batches.take_traces():
+        ctx.close("score")
+    tracing.flush()
+    proc = subprocess.run(
+        [sys.executable, "-m", "iotml.obs", "trace", traced,
+         "--min-stages", "5", "--require-e2e"],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "bottleneck:" in proc.stdout
+    for stage in ("mqtt_publish", "streamproc", "consume", "score"):
+        assert stage in proc.stdout
+    assert "e2e ingest->score" in proc.stdout
+    # --json emits the machine-readable summary
+    proc = subprocess.run(
+        [sys.executable, "-m", "iotml.obs", "trace", traced, "--json"],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    doc = json.loads(proc.stdout)
+    assert doc["bottleneck"] in {s["stage"] for s in doc["stages"]}
+    assert doc["e2e"]["score"]["count"] == 30
+
+
+def test_obs_trace_cli_check_failure_exit_code(tmp_path):
+    path = tmp_path / "sparse.jsonl"
+    path.write_text(json.dumps(
+        {"kind": "span", "trace": "00", "stage": "consume",
+         "start_us": 0, "dur_us": 5, "wall0_ns": 0}) + "\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "iotml.obs", "trace", str(path),
+         "--min-stages", "5", "--require-e2e"],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 1
+    assert "TRACE CHECK FAILED" in proc.stderr
