@@ -31,6 +31,13 @@ Rules (see ARCHITECTURE.md §analysis for the full table):
       (``ctx.mark``/``ctx.close``/``tracing.start``/``tracing.flush``)
       must not happen while a lock is held — the trace collector is
       lock-free by contract (checked with R4's call-graph walk).
+  R7  chaos faultpoint discipline: ``chaos.point()`` shims and
+      ``iotml.chaos`` imports may appear only in the allowlisted
+      production modules (CHAOS_ALLOWED_MODULES), and those modules may
+      import nothing from ``iotml.chaos`` except the shim module
+      ``faults`` — scenario/runner code (and its heavyweight deps) must
+      never leak into hot paths, and new injection sites are a reviewed
+      allowlist change, not a drive-by.
 
 Suppression: append ``# lint-ok: RN <reason>`` to the flagged line (for
 R4, to the ``with`` line holding the lock).  A suppression WITHOUT a
@@ -69,6 +76,17 @@ BLOCKING_CALLS = frozenset({
 # replica/timeout paths); the rest of the tree may use wall clocks.
 R1_PATH_SEGMENTS = ("stream", "mqtt")
 
+# R7: the only production modules that may compile in chaos faultpoints
+# (matched on the trailing (package, file) of the path), and the only
+# chaos module they may import.  Files under an iotml/chaos/ directory
+# are the subsystem itself and exempt.
+CHAOS_ALLOWED_MODULES = frozenset({
+    ("stream", "kafka_wire.py"), ("stream", "broker.py"),
+    ("stream", "replica.py"), ("mqtt", "broker.py"),
+    ("serve", "scorer.py"), ("train", "live.py"),
+})
+CHAOS_SHIM_MODULE = "faults"
+
 # R6 (naming): metric families and span/stage names are lowercase
 # snake_case; framework-owned names (iotml-prefixed) must follow the
 # full `iotml_[a-z0-9_]+` convention.  Reference-parity families
@@ -93,6 +111,9 @@ RULES: Dict[str, str] = {
     "R5": "engine-owned topic produced outside streamproc/",
     "R6": "metric/span name violates the iotml_[a-z0-9_]+ naming "
           "convention, or a span is recorded while a lock is held",
+    "R7": "chaos shim (chaos.point / iotml.chaos import) outside the "
+          "faultpoint allowlist, or a production import of a chaos "
+          "module other than the shim (iotml.chaos.faults)",
 }
 
 _SUPPRESS_RE = re.compile(r"#\s*lint-ok:\s*(R\d)\b[ \t]*(.*)")
@@ -324,12 +345,60 @@ class _FileLinter(ast.NodeVisitor):
         parts = rel.replace(os.sep, "/").split("/")
         self.r1_scoped = any(seg in parts for seg in R1_PATH_SEGMENTS)
         self.in_streamproc = "streamproc" in parts
+        # R7 scoping: the chaos package itself is exempt; everything
+        # else is held to the allowlist
+        self.in_chaos = "chaos" in parts
+        self.chaos_allowed = self.in_chaos or (
+            len(parts) >= 2 and (parts[-2], parts[-1])
+            in CHAOS_ALLOWED_MODULES)
         self._lock_stack: List[Tuple[str, int, bool]] = []  # (name, line, suppressed)
 
     def _emit(self, rule: str, node: ast.AST, message: str) -> None:
         if rule not in self.rules or self.sup.suppressed(rule, node):
             return
         self.findings.append(Finding(self.path, node.lineno, rule, message))
+
+    # ----------------------------------------------------------- R7 imports
+    def _check_chaos_import(self, node: ast.AST, dotted: str,
+                            names: Optional[List[str]] = None) -> None:
+        """`dotted` is the imported module path (relative dots stripped);
+        `names` the from-import aliases (None for a plain import)."""
+        segs = [s for s in dotted.split(".") if s]
+        if "chaos" in segs and not self.in_chaos:
+            if not self.chaos_allowed:
+                self._emit("R7", node,
+                           "iotml.chaos import outside the faultpoint "
+                           "allowlist (CHAOS_ALLOWED_MODULES): injection "
+                           "sites are a reviewed allowlist change")
+            elif not (segs[-1] == CHAOS_SHIM_MODULE
+                      or (segs[-1] == "chaos" and names is not None
+                          and all(n == CHAOS_SHIM_MODULE for n in names))):
+                self._emit("R7", node,
+                           "production code may import nothing from "
+                           "iotml.chaos except the shim module "
+                           f"'{CHAOS_SHIM_MODULE}' — scenario/runner "
+                           "code must not leak into hot paths")
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        names = [a.name for a in node.names]
+        self._check_chaos_import(node, node.module or "", names)
+        # the evasion form: `from iotml import chaos` / `from .. import
+        # chaos` carries the package in the ALIAS list, not the module
+        # path — importing the package (rather than the shim) is a
+        # violation everywhere outside the subsystem itself
+        segs = [s for s in (node.module or "").split(".") if s]
+        if "chaos" not in segs and "chaos" in names and not self.in_chaos:
+            self._emit("R7", node,
+                       "importing the iotml.chaos package itself: "
+                       "production code may import only the shim module "
+                       f"('{CHAOS_SHIM_MODULE}'), and only in "
+                       "CHAOS_ALLOWED_MODULES")
+        self.generic_visit(node)
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            self._check_chaos_import(node, alias.name)
+        self.generic_visit(node)
 
     # R4 needs with-scope tracking, so visit With explicitly
     def visit_With(self, node: ast.With) -> None:
@@ -432,6 +501,18 @@ class _FileLinter(ast.NodeVisitor):
                        "convention ([a-z][a-z0-9_]*): the span CLI and "
                        "the stage-label histograms aggregate by this "
                        "string")
+
+        # R7 — faultpoint shim compiled outside the allowlist
+        if name == "point" and not self.chaos_allowed \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in ("chaos", CHAOS_SHIM_MODULE) \
+                and _str_arg0(node) is not None:
+            self._emit("R7", node,
+                       f"chaos.point({_str_arg0(node)!r}) outside the "
+                       "faultpoint allowlist (CHAOS_ALLOWED_MODULES): "
+                       "new injection sites are a reviewed allowlist "
+                       "change, not a drive-by")
 
         # R5 — engine-owned topic produced outside streamproc/
         if not self.in_streamproc and name in ("produce", "produce_many",

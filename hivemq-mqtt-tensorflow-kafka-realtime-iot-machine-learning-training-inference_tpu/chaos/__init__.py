@@ -1,0 +1,31 @@
+"""iotml.chaos — deterministic fault injection for the whole pipeline.
+
+The reference's only failure story is "Kubernetes restarts the pod"
+and its own TODO list says "Test HiveMQ and Kafka failover".  This
+subsystem is that test, made a first-class tool: named faultpoints
+compiled into the stream/mqtt/serve/train hot paths (`faults.point`),
+seeded *replayable* fault schedules (`scenarios`), and an in-process
+runner that drives devsim → MQTT → bridge → broker(+replica) →
+scorer under a scenario and then PROVES the delivery contracts from
+the PR 2 span log and broker state (`runner`).
+
+Determinism rules (the whole point — a failure run you cannot replay
+is a failure run you cannot debug):
+
+- a schedule is a pure function of (scenario, seed, records): built
+  from one `random.Random(seed)`, expressed in *hit counts* of named
+  faultpoints and *published-record counts* — never wall-clock time;
+- the runner drives every pipeline stage synchronously from one
+  thread, so faultpoint hit sequences are reproducible;
+- two runs with the same (scenario, seed, records) produce
+  byte-identical schedules and identical invariant verdicts.
+
+Production code imports exactly ONE module from this package — the
+shim `iotml.chaos.faults` — and only in the allowlisted modules; lint
+rule R7 (iotml.analysis) holds both directions of that boundary.
+This `__init__` stays import-light for the same reason: the shim
+must not drag scenario/runner (and their jax deps) into hot paths.
+
+CLI:  ``python -m iotml.chaos run --scenario leader-kill-mid-drain
+--seed 7 --records 2000`` (and ``--list`` / ``schedule``).
+"""

@@ -1,0 +1,265 @@
+"""Faultpoint registry + injection engine — the production-facing shim.
+
+This is the ONE chaos module production code may import (lint R7).  A
+faultpoint is a named site compiled into a hot path as a one-line
+``chaos.point("name")`` call; with chaos disarmed (the default, and the
+only state tests/production ever see unless explicitly armed) the call
+reads one module global and returns None — no allocation, no lock.
+
+Armed, the engine counts every traversal of every faultpoint and fires
+the scenario's action when a site's hit count enters a scheduled
+window.  Generic actions are applied right here so call sites stay one
+line:
+
+- ``error``  — raise (ConnectionError/OSError/RuntimeError by name):
+  simulated crash / dead socket / unavailable partition;
+- ``delay``  — ``time.sleep(seconds)``: stall / slow link.
+
+Site-specific actions (``drop``, ``dup``, ``short_write``, ``skip``)
+are *returned* to the call site, which knows what dropping or
+duplicating means at that point in the protocol.  A ``drop`` is
+recorded in the engine's intentional-loss ledger (count + the current
+trace id when tracing is live) unless the scenario marks it
+unaccounted — the seeded "silent loss" bug the invariant checker must
+catch.
+
+Arming: ``arm(ChaosEngine(schedule.events))`` in-process (the runner
+does this), or the environment toggles ``IOTML_CHAOS=1`` +
+``IOTML_CHAOS_SCENARIO`` / ``IOTML_CHAOS_SEED`` for any iotml process
+(registered in ``iotml.config``'s ``non_config`` set — they configure
+the harness around the pipeline, not the pipeline).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, NamedTuple, Optional, Set
+
+from ..obs import metrics as _metrics
+from ..obs import tracing
+
+#: every compiled-in injection site, name → what firing there means.
+#: Scenarios are validated against this registry at engine build time so
+#: a typo'd faultpoint fails loudly instead of silently never firing.
+KNOWN_POINTS: Dict[str, str] = {
+    "kafka_wire.send": "wire-client socket send: drop connection (error), "
+                       "delay, short_write",
+    "kafka_wire.recv": "wire-client socket recv: drop connection (error), "
+                       "delay",
+    "broker.produce": "broker append path: produce error, delay",
+    "broker.fetch": "broker fetch path: stall (delay), partition "
+                    "unavailable (error)",
+    "replica.sync": "follower replication round: pause (delay), skip",
+    "mqtt.deliver": "MQTT fan-out delivery: drop, dup, delay",
+    "scorer.poll": "scorer drain loop: stall (delay), simulated crash "
+                   "(error -> rewind-to-committed redelivery)",
+    "trainer.poll": "continuous-trainer poll loop: stall (delay), error",
+}
+
+#: runner-orchestrated pseudo-points: process-level acts (killing a wire
+#: server is not an inline code path) scheduled by published-record
+#: count and executed by the chaos runner between ticks.
+RUNNER_POINTS: Dict[str, str] = {
+    "runner.kill_leader": "abrupt leader wire-server death (accept loop "
+                          "+ every live connection) -> client failover "
+                          "promotes the follower",
+}
+
+#: actions each site actually interprets — validated at engine build so
+#: a typo'd action fails as loudly as a typo'd faultpoint (it would
+#: otherwise count as injected while doing nothing, a lying report).
+POINT_ACTIONS: Dict[str, frozenset] = {
+    "kafka_wire.send": frozenset({"error", "delay", "short_write"}),
+    "kafka_wire.recv": frozenset({"error", "delay"}),
+    "broker.produce": frozenset({"error", "delay"}),
+    "broker.fetch": frozenset({"error", "delay"}),
+    "replica.sync": frozenset({"skip", "delay", "error"}),
+    "mqtt.deliver": frozenset({"drop", "dup", "delay"}),
+    "scorer.poll": frozenset({"error", "delay"}),
+    "trainer.poll": frozenset({"error", "delay"}),
+    "runner.kill_leader": frozenset({"kill_leader"}),
+}
+
+_EXCEPTIONS = {"ConnectionError": ConnectionError, "OSError": OSError,
+               "RuntimeError": RuntimeError}
+
+chaos_injected = _metrics.default_registry.counter(
+    "iotml_chaos_injected_total",
+    "faults injected by the chaos engine (label fault=point:action)")
+
+
+class Action(NamedTuple):
+    """A fired fault handed back to its call site."""
+
+    kind: str
+    params: dict
+
+
+class ChaosEngine:
+    """Hit-counting fault scheduler over a compiled scenario.
+
+    Thread-safe: hit counters and ledgers mutate under one lock; the
+    blocking/raising part of an action is applied AFTER the lock is
+    released (a chaos delay must stall the faulted path, never every
+    thread traversing any faultpoint)."""
+
+    def __init__(self, events):
+        self._lock = threading.Lock()
+        self._windows: Dict[str, List[tuple]] = {}
+        self.runner_events: List = []
+        for ev in sorted(events, key=lambda e: (e.at, e.point, e.action)):
+            if ev.point not in POINT_ACTIONS:
+                raise ValueError(
+                    f"unknown faultpoint {ev.point!r} (known: "
+                    f"{sorted(KNOWN_POINTS) + sorted(RUNNER_POINTS)})")
+            if ev.action not in POINT_ACTIONS[ev.point]:
+                raise ValueError(
+                    f"faultpoint {ev.point!r} does not interpret action "
+                    f"{ev.action!r} (supported: "
+                    f"{sorted(POINT_ACTIONS[ev.point])})")
+            exc = dict(ev.params).get("exc")
+            if exc is not None and exc not in _EXCEPTIONS:
+                raise ValueError(
+                    f"unknown exception {exc!r} for {ev.point} "
+                    f"(have: {sorted(_EXCEPTIONS)})")
+            if ev.point in RUNNER_POINTS:
+                self.runner_events.append(ev)
+            else:
+                self._windows.setdefault(ev.point, []).append(
+                    (ev.at, ev.at + max(ev.repeat, 1), ev))
+        # at most ONE non-delay event may cover any given hit: a call
+        # site consumes a single action, so overlapping site-level
+        # events would count as injected without executing — the
+        # diverging-report lie this engine exists to rule out.  Delays
+        # compose with anything (they apply inline, cumulatively).
+        for point, windows in self._windows.items():
+            hard = sorted(((lo, hi, ev) for lo, hi, ev in windows
+                           if ev.action != "delay"),
+                          key=lambda w: (w[0], w[1]))
+            for (alo, ahi, aev), (blo, bhi, bev) in zip(hard, hard[1:]):
+                if blo < ahi:
+                    raise ValueError(
+                        f"overlapping non-delay events on {point!r}: "
+                        f"{aev.action}@[{alo},{ahi}) and "
+                        f"{bev.action}@[{blo},{bhi}) — only one "
+                        f"site-level action can execute per hit")
+        self.hits: Dict[str, int] = {}
+        self.injected: Dict[str, int] = {}
+        self.dropped_count = 0
+        self.dropped_traces: Set[int] = set()
+
+    # ----------------------------------------------------------- firing
+    def fire(self, name: str) -> Optional[Action]:
+        """EVERY event whose window covers this hit fires — a drop
+        scheduled inside a delay window both delays and drops.  The
+        canonical schedule is ground truth: what it lists must be what
+        runs, or the byte-identical replay guarantee is a lie."""
+        matched = []
+        with self._lock:
+            hit = self.hits.get(name, 0) + 1
+            self.hits[name] = hit
+            for lo, hi, ev in self._windows.get(name, ()):
+                if lo <= hit < hi:
+                    matched.append(ev)
+            if not matched:
+                return None
+            for ev in matched:
+                label = f"{name}:{ev.action}"
+                self.injected[label] = self.injected.get(label, 0) + 1
+                if ev.action == "drop" and \
+                        dict(ev.params).get("account", True):
+                    # intentional loss: ledger it so the invariant
+                    # checker can tell "chaos ate it" from "the
+                    # pipeline lost it"
+                    self.dropped_count += 1
+                    ctx = tracing.current()
+                    if ctx is not None:
+                        self.dropped_traces.add(ctx.trace_id)
+        # blocking/raising OUTSIDE the engine lock: delays apply first
+        # (cumulatively), then the at-most-one (build-validated)
+        # non-delay event raises or is returned to the call site
+        site: Optional[Action] = None
+        err = None
+        for ev in matched:
+            chaos_injected.inc(fault=f"{name}:{ev.action}")
+            params = dict(ev.params)
+            if ev.action == "delay":
+                time.sleep(float(params.get("seconds", 0.001)))
+            elif ev.action == "error":
+                err = _EXCEPTIONS.get(params.get("exc", "ConnectionError"),
+                                      ConnectionError)
+            else:
+                site = Action(ev.action, params)
+        if err is not None:
+            raise err(f"chaos[{name}]: injected fault")
+        return site
+
+    def due_runner_events(self, records_published: int) -> List:
+        """Pop runner-orchestrated events whose record count has come."""
+        with self._lock:
+            due = [e for e in self.runner_events
+                   if e.at <= records_published]
+            self.runner_events = [e for e in self.runner_events
+                                  if e.at > records_published]
+        return due
+
+    def note_runner_fired(self, ev) -> None:
+        """Count a runner-orchestrated event as injected — the runner,
+        not a faultpoint shim, executes process-level actions."""
+        label = f"{ev.point}:{ev.action}"
+        with self._lock:
+            self.injected[label] = self.injected.get(label, 0) + 1
+        chaos_injected.inc(fault=label)
+
+
+#: the armed engine, or None.  Module-global read is the entire
+#: disarmed faultpoint cost.
+_engine: Optional[ChaosEngine] = None
+
+
+def point(name: str) -> Optional[Action]:
+    """The faultpoint shim compiled into hot paths."""
+    eng = _engine
+    if eng is None:
+        return None
+    return eng.fire(name)
+
+
+def engine() -> Optional[ChaosEngine]:
+    return _engine
+
+
+def arm(eng: ChaosEngine) -> ChaosEngine:
+    global _engine
+    _engine = eng
+    return eng
+
+
+def disarm() -> None:
+    global _engine
+    _engine = None
+
+
+def arm_from_env(env: Optional[dict] = None) -> Optional[ChaosEngine]:
+    """Arm from IOTML_CHAOS/IOTML_CHAOS_{SEED,SCENARIO} — lets any iotml
+    process (a test run, a CLI) execute under a seeded schedule.  No-op
+    unless IOTML_CHAOS is truthy, so importing this module costs one
+    env read in normal processes."""
+    env = os.environ if env is None else env
+    # same truthiness convention as IOTML_TRACE: only an explicit
+    # opt-in arms fault injection — IOTML_CHAOS=false/no/off must
+    # disable, never arm-with-defaults
+    if env.get("IOTML_CHAOS", "").strip().lower() not in \
+            ("1", "true", "yes", "on"):
+        return None
+    from .scenarios import build  # lazy: scenarios never load when disarmed
+
+    schedule = build(env.get("IOTML_CHAOS_SCENARIO", "mqtt-flap"),
+                     seed=int(env.get("IOTML_CHAOS_SEED", "7")),
+                     records=int(env.get("IOTML_CHAOS_RECORDS", "1000")))
+    return arm(ChaosEngine(schedule.events))
+
+
+arm_from_env()

@@ -1,0 +1,454 @@
+"""Scenario runner + invariant verdicts for chaos schedules.
+
+Drives the reproduction's own pipeline — devsim fleet → MQTT broker →
+Kafka bridge → stream broker (→ follower replica, wire topology) →
+KSQL-equivalent convert → scorer — in-process and single-threaded
+under an armed `faults.ChaosEngine`, then PROVES the delivery
+contracts the stack documents:
+
+- ``scored_or_accounted``: every trace id born at publish is closed by
+  a ``score`` e2e span OR sits in the chaos engine's intentional-loss
+  ledger (span log form of "at-least-once or accounted").
+- ``at_least_once_counts``: scored >= published − intentionally
+  dropped (the count form, the only form on the wire topology — trace
+  headers end at the TCP boundary by design).
+- ``commits_monotonic``: every committed offset stream, per (broker,
+  group, topic, partition), is non-decreasing — a rewinding commit
+  would re-deliver unbounded history or, worse, mask a lost fence.
+- ``predictions_contiguous``: the predictions topic holds exactly one
+  record per scored row (OutputSequence's gap check + the at-least-
+  once duplicate window both counted in ``scored``).
+- ``final_commit_at_end``: after the final drain, committed offsets
+  equal the log end — nothing polled-but-unscored was fenced behind a
+  premature commit.
+- ``promotion_loss_bounded`` (wire): the records the promoted follower
+  is missing at the instant of leader death are at most the measured
+  replication lag — with the runner's sync-before-kill, exactly zero.
+
+Determinism: one thread drives every stage (the follower's sync loop
+is stepped synchronously, never started as a thread), so faultpoint
+hit sequences — and therefore verdicts — replay exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+from . import faults, scenarios
+from .scenarios import CARS_PER_TICK, Schedule
+
+#: trace-birth stages (PR 2): a trace with one of these spans entered
+#: the pipeline and is owed a score or an accounting.
+BIRTH_STAGES = ("mqtt_publish", "devsim_publish")
+
+IN_TOPIC = "SENSOR_DATA_S_AVRO"
+PRED_TOPIC = "model-predictions"
+GROUP = "chaos-scorer"
+
+
+@dataclasses.dataclass(frozen=True)
+class Invariant:
+    name: str
+    ok: bool
+    detail: str
+
+    def verdict(self) -> str:
+        return f"{'PASS' if self.ok else 'FAIL'}  {self.name}: {self.detail}"
+
+
+@dataclasses.dataclass
+class ChaosReport:
+    scenario: str
+    seed: int
+    records: int
+    topology: str
+    published: int
+    scored: int
+    rewinds: int
+    dropped_accounted: int
+    injected: Dict[str, int]
+    invariants: List[Invariant]
+    span_path: Optional[str]
+
+    @property
+    def ok(self) -> bool:
+        return all(i.ok for i in self.invariants)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["ok"] = self.ok
+        return d
+
+
+# ----------------------------------------------------------- invariants
+def _check_commits_monotonic(commit_log: List[tuple]) -> Invariant:
+    streams: Dict[tuple, int] = {}
+    bad = []
+    for tag, group, topic, part, off in commit_log:
+        key = (tag, group, topic, part)
+        if off < streams.get(key, -1):
+            bad.append((key, streams[key], off))
+        streams[key] = max(streams.get(key, -1), off)
+    return Invariant(
+        "commits_monotonic", not bad,
+        f"{len(commit_log)} commits over {len(streams)} offset streams"
+        + (f"; REGRESSIONS {bad[:4]}" if bad else ", all non-decreasing"))
+
+
+def _check_spans_accounted(span_path: str,
+                           dropped_traces) -> Invariant:
+    born, closed = set(), set()
+    with open(span_path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if doc.get("kind") == "span" and doc.get("stage") in BIRTH_STAGES:
+                born.add(doc["trace"])
+            elif doc.get("kind") == "e2e" and doc.get("closer") == "score":
+                closed.add(doc["trace"])
+    ledger = {f"{tid:016x}" for tid in dropped_traces}
+    missing = born - closed - ledger
+    return Invariant(
+        "scored_or_accounted", not missing,
+        f"{len(born)} traces born, {len(closed & born)} scored, "
+        f"{len(ledger & born)} accounted as chaos drops"
+        + (f"; {len(missing)} SILENTLY LOST "
+           f"(e.g. {sorted(missing)[:3]})" if missing else ""))
+
+
+def _check_counts(published: int, scored: int, dropped: int) -> Invariant:
+    ok = scored >= published - dropped
+    return Invariant(
+        "at_least_once_counts", ok,
+        f"published={published} scored={scored} "
+        f"intentionally_dropped={dropped}"
+        + ("" if ok else f"; {published - dropped - scored} records "
+                         f"unaccounted for"))
+
+
+def _check_predictions(broker, scored: int) -> Invariant:
+    end = broker.end_offset(PRED_TOPIC, 0)
+    ok = end == scored
+    return Invariant(
+        "predictions_contiguous", ok,
+        f"predictions end offset {end} == rows scored {scored}"
+        if ok else f"predictions end offset {end} != rows scored {scored}")
+
+
+def _check_final_commit(broker, topic: str, parts: int) -> Invariant:
+    gaps = []
+    for p in range(parts):
+        committed = broker.committed(GROUP, topic, p)
+        end = broker.end_offset(topic, p)
+        if committed != end:
+            gaps.append((p, committed, end))
+    return Invariant(
+        "final_commit_at_end", not gaps,
+        "committed == log end on every partition" if not gaps
+        else f"partitions behind/ahead at end: {gaps}")
+
+
+def _record_commits(broker, log: List[tuple], tag: str) -> None:
+    """Shadow a Broker instance's commit with a history-recording
+    wrapper — the monotonicity invariant needs the sequence, and the
+    broker (correctly) stores only the latest value."""
+    orig = broker.commit
+
+    def commit(group, topic, partition, next_offset):
+        log.append((tag, group, topic, partition, next_offset))
+        return orig(group, topic, partition, next_offset)
+
+    broker.commit = commit
+
+
+# --------------------------------------------------------------- runner
+class ChaosRunner:
+    """Compile a scenario, drive the pipeline under it, return the
+    report.  ``span_path`` keeps the JSONL span log (default: a temp
+    file, path reported) for the CLI's stage-latency breakdown."""
+
+    def __init__(self, scenario: str, seed: int = 7, records: int = 1000,
+                 span_path: Optional[str] = None):
+        self.schedule: Schedule = scenarios.build(scenario, seed=seed,
+                                                  records=records)
+        self.span_path = span_path
+
+    # ------------------------------------------------------------ entry
+    def run(self) -> ChaosReport:
+        from ..obs import tracing
+
+        eng = faults.arm(faults.ChaosEngine(self.schedule.events))
+        trace_inproc = self.schedule.topology == "inproc"
+        prev = (tracing.ENABLED, tracing._SAMPLE, tracing._PATH)
+        span_path = self.span_path
+        if trace_inproc:
+            if span_path is None:
+                fd, span_path = tempfile.mkstemp(prefix="iotml_chaos_",
+                                                 suffix=".jsonl")
+                os.close(fd)
+            open(span_path, "w").close()  # fresh log per run
+            tracing.flush()  # drain any prior spans into the OLD sinks
+            tracing.configure(enabled=True, sample=1.0, path=span_path)
+            tracing.reset()
+        try:
+            if self.schedule.topology == "wire":
+                report = self._run_wire(eng)
+            else:
+                report = self._run_inproc(eng, span_path)
+        finally:
+            faults.disarm()
+            if trace_inproc:
+                tracing.flush()
+                tracing.configure(enabled=prev[0], sample=prev[1],
+                                  path=prev[2] if prev[2] else "")
+        return report
+
+    # ------------------------------------------------- shared pipeline
+    @staticmethod
+    def _make_scorer(broker, consumer):
+        import numpy as np
+
+        from ..data.dataset import SensorBatches
+        from ..models.autoencoder import CAR_AUTOENCODER
+        from ..serve.scorer import StreamScorer
+        from ..stream.producer import OutputSequence
+        from ..train.loop import Trainer
+
+        trainer = Trainer(CAR_AUTOENCODER)
+        trainer._ensure_state(np.zeros((100, 18), np.float32))
+        batches = SensorBatches(consumer, batch_size=100)
+        out = OutputSequence(broker, PRED_TOPIC, partition=0)
+        return StreamScorer(CAR_AUTOENCODER, trainer.state.params,
+                            batches, out)
+
+    # ---------------------------------------------------------- inproc
+    def _run_inproc(self, eng: faults.ChaosEngine,
+                    span_path: str) -> ChaosReport:
+        from ..gen.simulator import FleetGenerator, FleetScenario
+        from ..mqtt.bridge import KafkaBridge
+        from ..mqtt.broker import MqttBroker
+        from ..obs import tracing
+        from ..stream.broker import Broker
+        from ..stream.consumer import StreamConsumer
+        from ..streamproc.tasks import JsonToAvro
+
+        mqtt = MqttBroker()
+        stream = Broker()
+        commit_log: List[tuple] = []
+        _record_commits(stream, commit_log, "stream")
+        KafkaBridge(mqtt, stream, partitions=2)
+        task = JsonToAvro(stream, src="sensor-data", dst=IN_TOPIC,
+                          partitions=2)
+        parts = stream.topic(IN_TOPIC).partitions
+        consumer = StreamConsumer(
+            stream, [f"{IN_TOPIC}:{p}:0" for p in range(parts)],
+            group=GROUP)
+        scorer = self._make_scorer(stream, consumer)
+
+        gen = FleetGenerator(FleetScenario(num_cars=CARS_PER_TICK,
+                                           seed=self.schedule.seed))
+        published = rewinds = 0
+        ticks = max(1, -(-self.schedule.records // CARS_PER_TICK))
+
+        def drive_once():
+            nonlocal rewinds
+            try:
+                task.process_available()
+            except ConnectionError:
+                task.consumer.rewind_to_committed()
+                rewinds += 1
+            try:
+                return scorer.score_available()
+            except ConnectionError:
+                consumer.rewind_to_committed()
+                rewinds += 1
+                return -1
+
+        for _ in range(ticks):
+            published += self._publish_tick_mqtt(gen, mqtt)
+            drive_once()
+            tracing.flush()  # incremental: bound the per-thread buffers
+        for _ in range(64):  # final drain: outlast any remaining window
+            n = drive_once()
+            if n == 0 and consumer.at_end() and task.consumer.at_end():
+                break
+        tracing.flush()
+
+        invariants = [
+            _check_spans_accounted(span_path, eng.dropped_traces),
+            _check_counts(published, scorer.scored, eng.dropped_count),
+            _check_commits_monotonic(commit_log),
+            _check_predictions(stream, scorer.scored),
+            _check_final_commit(stream, IN_TOPIC, parts),
+        ]
+        return ChaosReport(
+            scenario=self.schedule.name, seed=self.schedule.seed,
+            records=self.schedule.records, topology="inproc",
+            published=published, scored=scorer.scored, rewinds=rewinds,
+            dropped_accounted=eng.dropped_count,
+            injected=dict(sorted(eng.injected.items())),
+            invariants=invariants, span_path=span_path)
+
+    @staticmethod
+    def _publish_tick_mqtt(gen, mqtt) -> int:
+        cols = gen.step_columns()
+        from ..core.schema import CAR_SCHEMA
+
+        n = len(cols["car"])
+        for i in range(n):
+            rec = gen.row_record(cols, i, CAR_SCHEMA)
+            rec["failure_occurred"] = str(cols["failure_occurred"][i])
+            mqtt.publish(
+                f"vehicles/sensor/data/{gen.scenario.car_id(i)}",
+                json.dumps(rec).encode(), qos=1)
+        return n
+
+    # ------------------------------------------------------------ wire
+    def _run_wire(self, eng: faults.ChaosEngine) -> ChaosReport:
+        from ..core.schema import KSQL_CAR_SCHEMA
+        from ..gen.simulator import FleetGenerator, FleetScenario
+        from ..ops.avro import AvroCodec
+        from ..ops.framing import frame
+        from ..stream.broker import Broker
+        from ..stream.consumer import StreamConsumer
+        from ..stream.kafka_wire import KafkaWireBroker, KafkaWireServer
+        from ..stream.replica import FollowerReplica
+
+        leader = Broker()
+        commit_log: List[tuple] = []
+        _record_commits(leader, commit_log, "leader")
+        lsrv = KafkaWireServer(leader).start()
+        rep = FollowerReplica(f"127.0.0.1:{lsrv.port}",
+                              topics=[IN_TOPIC, PRED_TOPIC],
+                              groups=(GROUP,))
+        _record_commits(rep.local, commit_log, "follower")
+        # the follower SERVES from the start, but its sync loop is
+        # stepped synchronously by this thread — determinism over
+        # realism (the background loop is exercised by tests/test_replica)
+        rep.server.start()
+        bootstrap = f"127.0.0.1:{lsrv.port},127.0.0.1:{rep.port}"
+        producer = KafkaWireBroker(bootstrap, client_id="chaos-devsim")
+        consumer_client = KafkaWireBroker(bootstrap,
+                                          client_id="chaos-scorer")
+        parts = 2
+        producer.create_topic(IN_TOPIC, partitions=parts)
+        producer.create_topic(PRED_TOPIC, partitions=1)
+        consumer = StreamConsumer(
+            consumer_client, [f"{IN_TOPIC}:{p}:0" for p in range(parts)],
+            group=GROUP)
+        scorer = self._make_scorer(producer, consumer)
+
+        gen = FleetGenerator(FleetScenario(num_cars=CARS_PER_TICK,
+                                           seed=self.schedule.seed))
+        codec = AvroCodec(KSQL_CAR_SCHEMA)
+        published = rewinds = 0
+        killed = False
+        promotion: Optional[Tuple[int, int]] = None
+        ticks = max(1, -(-self.schedule.records // CARS_PER_TICK))
+
+        def run_due_events():
+            nonlocal killed, promotion
+            for ev in eng.due_runner_events(published):
+                if ev.action == "kill_leader" and not killed:
+                    # deterministic failover: drain replication to zero
+                    # lag (direct sync mirrors the commit tables too),
+                    # measure the loss window, then die abruptly
+                    while rep.sync_once() > 0:
+                        pass
+                    lag = sum(rep.lag().values())
+                    tail = sum(
+                        leader.end_offset(t, p) - rep.local.end_offset(t, p)
+                        for t in (IN_TOPIC, PRED_TOPIC)
+                        for p in range(leader.topic(t).partitions))
+                    promotion = (lag, tail)
+                    lsrv.kill()
+                    killed = True
+                    eng.note_runner_fired(ev)
+
+        def drive_once():
+            nonlocal rewinds
+            try:
+                return scorer.score_available()
+            except ConnectionError:
+                consumer.rewind_to_committed()
+                rewinds += 1
+                return -1
+
+        try:
+            for _ in range(ticks):
+                run_due_events()
+                cols = gen.step_columns()
+                entries = []
+                for i in range(len(cols["car"])):
+                    rec = gen.row_record(cols, i, KSQL_CAR_SCHEMA)
+                    entries.append(
+                        (gen.scenario.car_id(i).encode(),
+                         frame(codec.encode(rec)), 0))
+                for attempt in range(3):
+                    try:
+                        producer.produce_many(IN_TOPIC, entries)
+                        break
+                    except ConnectionError:
+                        # the client has already failed over; redeliver
+                        # to the promoted follower.  Kills land between
+                        # ticks so the dead leader cannot have applied
+                        # the batch; a scenario that injects wire errors
+                        # mid-produce gets at-least-once (a duplicated
+                        # batch inflates `scored` past `published`,
+                        # which every invariant tolerates by contract)
+                        if attempt == 2:
+                            raise
+                published += len(entries)
+                if not killed:
+                    rep.sync_once()
+                drive_once()
+            run_due_events()
+            for _ in range(64):
+                n = drive_once()
+                if n == 0 and consumer.at_end():
+                    break
+        finally:
+            for client in (producer, consumer_client):
+                try:
+                    client.close()
+                except OSError:
+                    pass
+            rep.stop()
+            if not killed:
+                lsrv.kill()
+
+        live = rep.local  # the promoted broker serves the end state
+        lag, tail = promotion if promotion is not None else (-1, -1)
+        invariants = [
+            _check_counts(published, scorer.scored, eng.dropped_count),
+            _check_commits_monotonic(commit_log),
+            _check_predictions(live, scorer.scored),
+            _check_final_commit(live, IN_TOPIC, parts),
+            Invariant(
+                "promotion_loss_bounded",
+                killed and 0 <= tail <= max(lag, 0),
+                ("leader was never killed" if not killed else
+                 f"unreplicated tail at leader death: {tail} records "
+                 f"within the measured lag {lag} (the runner's "
+                 f"sync-before-kill drives both to zero)"
+                 if 0 <= tail <= max(lag, 0) else
+                 f"unreplicated tail at leader death: {tail} records "
+                 f"EXCEEDS the measured lag {lag} — records the "
+                 f"promoted follower never saw")),
+        ]
+        return ChaosReport(
+            scenario=self.schedule.name, seed=self.schedule.seed,
+            records=self.schedule.records, topology="wire",
+            published=published, scored=scorer.scored, rewinds=rewinds,
+            dropped_accounted=eng.dropped_count,
+            injected=dict(sorted(eng.injected.items())),
+            invariants=invariants, span_path=None)

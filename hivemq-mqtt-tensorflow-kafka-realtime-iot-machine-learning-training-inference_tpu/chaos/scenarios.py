@@ -1,0 +1,195 @@
+"""Seeded, declarative fault schedules — replayable bit-for-bit.
+
+A scenario is a *builder*: ``(random.Random(seed), records) -> events``.
+Events are expressed in deterministic counters only — the Nth traversal
+of a faultpoint (``at`` = hit index, optionally covering ``repeat``
+consecutive hits) or, for runner-orchestrated pseudo-points, the Nth
+published record.  No wall clock anywhere: the same (scenario, seed,
+records) triple always compiles to the byte-identical schedule
+(``Schedule.text()`` is the canonical form CI diffs), which is the same
+reproducibility discipline the data-pipeline literature applies to
+training input (PAPERS.md: a run you can't replay is a run you can't
+debug).
+
+Built-ins:
+
+- ``leader-kill-mid-drain`` (wire): the follower syncs, the leader
+  wire-server dies abruptly mid-stream, clients fail over.
+- ``mqtt-flap``: flapping device links — seeded MQTT delivery drops
+  (accounted as intentional loss) plus short delay bursts.
+- ``slow-bridge``: sustained delay windows on the MQTT→stream hop.
+- ``dup-storm``: duplicate deliveries — at-least-once must absorb them.
+- ``partition-blackout``: a window of consecutive broker fetches fails
+  with ConnectionError (partition unavailable) and must be retried
+  through.
+- ``scorer-crash-resume``: the scorer's drain loop dies mid-stream and
+  must resume via rewind-to-committed redelivery.
+- ``loss-bug-fixture``: a seeded SILENT drop (not ledgered) — exists so
+  tests can prove the invariant checker actually fails on real loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+from typing import Callable, Dict, List, Tuple
+
+#: fleet size per simulator tick — shared with the runner so builders
+#: can reason in ticks (records / CARS_PER_TICK) when a faultpoint is
+#: hit once per tick (scorer.poll) rather than once per record.
+CARS_PER_TICK = 25
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    """One scheduled fault: fire `action` at the `at`-th traversal of
+    `point` (1-based), covering `repeat` consecutive traversals.  For
+    runner pseudo-points `at` is a published-record count."""
+
+    at: int
+    point: str
+    action: str
+    params: Tuple[Tuple[str, object], ...] = ()
+    repeat: int = 1
+
+    def line(self) -> str:
+        """Canonical text form — what byte-identical schedules diff."""
+        p = json.dumps(dict(self.params), sort_keys=True,
+                       separators=(",", ":"))
+        return f"{self.at:>8} x{self.repeat:<4} {self.point} " \
+               f"{self.action} {p}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Schedule:
+    name: str
+    seed: int
+    records: int
+    topology: str  # "inproc" | "wire"
+    events: Tuple[FaultEvent, ...]
+
+    def lines(self) -> List[str]:
+        head = [f"# scenario={self.name} seed={self.seed} "
+                f"records={self.records} topology={self.topology}"]
+        return head + [e.line() for e in self.events]
+
+    def text(self) -> str:
+        return "\n".join(self.lines()) + "\n"
+
+
+# ------------------------------------------------------------- builders
+def _leader_kill(rng: random.Random, records: int) -> list:
+    lo, hi = max(1, records // 3), max(2, (2 * records) // 3)
+    events = [FaultEvent(rng.randint(lo, hi), "runner.kill_leader",
+                         "kill_leader")]
+    # flavor: a few slow client recvs around the failover window
+    for _ in range(3):
+        events.append(FaultEvent(rng.randint(1, max(2, records // 20)),
+                                 "kafka_wire.recv", "delay",
+                                 params=(("seconds", 0.001),)))
+    return events
+
+
+def _mqtt_flap(rng: random.Random, records: int) -> list:
+    n_drops = max(2, records // 100)
+    hits = sorted(rng.sample(range(1, records + 1),
+                             min(n_drops, records)))
+    events = [FaultEvent(h, "mqtt.deliver", "drop") for h in hits]
+    for _ in range(2):  # short link stalls riding along
+        events.append(FaultEvent(rng.randint(1, max(2, records - 10)),
+                                 "mqtt.deliver", "delay",
+                                 params=(("seconds", 0.001),), repeat=5))
+    return events
+
+
+def _slow_bridge(rng: random.Random, records: int) -> list:
+    events = []
+    at = 1
+    for _ in range(3):
+        at = rng.randint(at, max(at + 1, min(records, at + records // 3)))
+        win = rng.randint(10, 30)
+        events.append(FaultEvent(at, "mqtt.deliver", "delay",
+                                 params=(("seconds", 0.002),), repeat=win))
+        at += win + 1
+    return events
+
+
+def _dup_storm(rng: random.Random, records: int) -> list:
+    n = max(5, records // 50)
+    hits = sorted(rng.sample(range(1, records + 1), min(n, records)))
+    return [FaultEvent(h, "mqtt.deliver", "dup") for h in hits]
+
+
+def _partition_blackout(rng: random.Random, records: int) -> list:
+    # a contiguous window of broker fetches fails (fetch hits accrue
+    # fast: every poll round fetches each partition)
+    at = rng.randint(5, 40)
+    return [FaultEvent(at, "broker.fetch", "error",
+                       params=(("exc", "ConnectionError"),),
+                       repeat=rng.randint(6, 12))]
+
+
+def _scorer_crash_resume(rng: random.Random, records: int) -> list:
+    # scorer.poll is hit once per drain chunk (~once per tick)
+    ticks = max(4, records // CARS_PER_TICK)
+    h1 = rng.randint(2, max(3, ticks // 2))
+    h2 = h1 + rng.randint(2, max(3, ticks // 2))
+    return [FaultEvent(h1, "scorer.poll", "error"),
+            FaultEvent(h2, "scorer.poll", "error")]
+
+
+def _loss_bug_fixture(rng: random.Random, records: int) -> list:
+    # the seeded bug: one delivery silently lost — NOT ledgered, so the
+    # scored-or-accounted invariant must fail (the checker's own test)
+    at = rng.randint(2, max(3, records - 2))
+    return [FaultEvent(at, "mqtt.deliver", "drop",
+                       params=(("account", False),))]
+
+
+#: name -> (builder, topology, description).  Topology is a static
+#: property of each scenario (which runner harness drives it), not
+#: something worth compiling a schedule to discover.
+SCENARIOS: Dict[str, Tuple[Callable, str, str]] = {
+    "leader-kill-mid-drain": (
+        _leader_kill, "wire",
+        "leader wire-server dies mid-stream; follower replica promoted "
+        "via client failover"),
+    "mqtt-flap": (
+        _mqtt_flap, "inproc",
+        "flapping device links: seeded MQTT delivery drops (accounted) "
+        "+ delay bursts"),
+    "slow-bridge": (
+        _slow_bridge, "inproc",
+        "sustained delay windows on the MQTT->stream hop"),
+    "dup-storm": (
+        _dup_storm, "inproc",
+        "duplicate MQTT deliveries; at-least-once must absorb them"),
+    "partition-blackout": (
+        _partition_blackout, "inproc",
+        "a window of broker fetches fails with ConnectionError; "
+        "consumers retry through"),
+    "scorer-crash-resume": (
+        _scorer_crash_resume, "inproc",
+        "scorer drain dies mid-stream; resumes via rewind-to-committed "
+        "redelivery"),
+    "loss-bug-fixture": (
+        _loss_bug_fixture, "inproc",
+        "SEEDED BUG: one silent (unledgered) drop — the invariant "
+        "checker must FAIL on it"),
+}
+
+
+def build(name: str, seed: int = 7, records: int = 1000) -> Schedule:
+    """Compile a scenario into its deterministic schedule."""
+    if name not in SCENARIOS:
+        raise KeyError(f"unknown scenario {name!r} "
+                       f"(have: {sorted(SCENARIOS)})")
+    if records < CARS_PER_TICK:
+        raise ValueError(f"records must be >= {CARS_PER_TICK} "
+                         f"(one fleet tick), got {records}")
+    builder, topology, _ = SCENARIOS[name]
+    events = builder(random.Random(seed), records)
+    events = tuple(sorted(events, key=lambda e: (e.at, e.point, e.action)))
+    return Schedule(name=name, seed=seed, records=records,
+                    topology=topology, events=events)

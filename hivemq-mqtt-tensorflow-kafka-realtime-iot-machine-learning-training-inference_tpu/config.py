@@ -199,12 +199,15 @@ def load_config(argv: Optional[Sequence[str]] = None,
     sections = {f.name for f in dataclasses.fields(cfg)}
     # process-level toggles that are NOT config: the test platform pin
     # (tests/conftest.py), the runtime lock-order detector switches
-    # (iotml.analysis.lockcheck) and the record-trace switches
-    # (iotml.obs.tracing) ride the IOTML_ prefix but configure the
+    # (iotml.analysis.lockcheck), the record-trace switches
+    # (iotml.obs.tracing) and the fault-injection switches
+    # (iotml.chaos.faults) ride the IOTML_ prefix but configure the
     # harness around the process, not the pipeline inside it
     non_config = {"IOTML_CONFIG", "IOTML_TEST_PLATFORM",
                   "IOTML_LOCKCHECK", "IOTML_LOCKCHECK_STRICT",
                   "IOTML_TRACE", "IOTML_TRACE_SAMPLE", "IOTML_TRACE_PATH",
+                  "IOTML_CHAOS", "IOTML_CHAOS_SEED",
+                  "IOTML_CHAOS_SCENARIO", "IOTML_CHAOS_RECORDS",
                   "IOTML_DEVSIM_DIR"}
     for key, value in env.items():
         if not key.startswith("IOTML_") or key in non_config:

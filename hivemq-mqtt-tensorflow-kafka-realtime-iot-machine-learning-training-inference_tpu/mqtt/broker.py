@@ -17,6 +17,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..chaos import faults as chaos
 from ..obs import tracing
 from ..obs.metrics import default_registry
 from .topic_tree import TopicTree, validate_filter
@@ -474,8 +475,18 @@ class MqttBroker:
                     continue
                 live.append((sess, eff))
         for sess, eff in live:  # outside the lock: a slow socket blocks
+            # chaos faultpoint: a "drop" models the flapping device link
+            # (the publish happened, the delivery is lost — ledgered as
+            # intentional loss), a "dup" the QoS-1 retry duplicate the
+            # at-least-once contract must absorb; delays apply inline
+            act = chaos.point("mqtt.deliver")
+            if act is not None and act.kind == "drop":
+                continue
             sess.deliver(topic, payload, eff, False)  # only its publisher
             delivered += 1
+            if act is not None and act.kind == "dup":
+                sess.deliver(topic, payload, eff, False)
+                delivered += 1
         for w in due_wills:  # due delayed wills, also outside the lock
             self.publish(*w)
         if delivered:

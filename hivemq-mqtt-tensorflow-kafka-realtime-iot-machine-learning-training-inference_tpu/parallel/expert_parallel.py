@@ -19,6 +19,8 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .mesh import shard_map
+
 from ..train.loop import TrainState
 
 EXPERT_LEAVES = ("w1", "b1", "w2", "b2")
@@ -76,7 +78,7 @@ def make_ep_train_step(model, tx, mesh: Mesh, data_axis: str = "data",
 
     def build_loss(params):
         specs = expert_param_specs(params, ep_axis)
-        return jax.shard_map(
+        return shard_map(
             local_loss, mesh=mesh,
             in_specs=(specs, x_spec), out_specs=(P(), P()),
             check_vma=False)

@@ -22,11 +22,13 @@ from typing import Optional
 import jax
 import numpy as np
 
+from ..chaos import faults as chaos
 from ..obs import metrics as obs_metrics
 from ..obs import tracing
 from ..data.dataset import SensorBatches
 from ..stream.producer import OutputSequence
 from ..train.loop import make_eval_step
+from ..utils.backoff import ExpBackoff
 from .fastfmt import format_rows
 
 
@@ -174,6 +176,9 @@ class StreamScorer:
             # batch.first_index restarts per iterator; rebase globally
             it, it_base = iter(self.batches), self.scored
         while True:
+            chaos.point("scorer.poll")  # injected stall/crash lands at a
+            # super-batch boundary: exactly where a real broker death
+            # surfaces, upstream of the commit (redelivery covers it)
             bs = list(itertools.islice(it, self.max_super_batches))
             if not bs:
                 break
@@ -294,6 +299,14 @@ class StreamScorer:
         benign — predictions are keyed by global index (see class
         docstring), the same at-least-once window a crash-restart has."""
         rounds = 0
+        # bounded exponential backoff with jitter for the rewind loop: a
+        # leader that STAYS dead turned the fixed poll_interval_s retry
+        # into a busy-spin of doomed reconnect+redrain attempts (chaos
+        # blackout scenarios exercise exactly this); healthy idle polling
+        # keeps the flat cadence
+        base = max(poll_interval_s, 0.01)  # poll_interval_s=0 is a legal
+        # busy-poll for tests; the FAILURE path still must not busy-spin
+        backoff = ExpBackoff(base_s=base, cap_s=max(2.0, base))
         while max_rounds is None or rounds < max_rounds:
             try:
                 n = self.score_available()
@@ -301,8 +314,9 @@ class StreamScorer:
                 self.batches.consumer.rewind_to_committed()
                 obs_metrics.scorer_rewinds.inc()
                 rounds += 1
-                time.sleep(poll_interval_s)
+                time.sleep(backoff.next_delay())
                 continue
+            backoff.reset()
             rounds += 1
             if n == 0:
                 time.sleep(poll_interval_s)

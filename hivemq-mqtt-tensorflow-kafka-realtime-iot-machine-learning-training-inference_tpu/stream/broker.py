@@ -27,6 +27,8 @@ import threading
 import zlib
 from typing import Dict, List, NamedTuple, Optional
 
+from ..chaos import faults as chaos
+
 
 class TopicOwnershipError(PermissionError):
     """Produce to an engine-owned topic without the owner's grant.
@@ -175,6 +177,7 @@ class Broker:
         """Append one record; returns its offset. Auto-creates 1-partition
         topics (matching Kafka's auto.create default used by the reference's
         local demos)."""
+        chaos.point("broker.produce")
         self._check_producer(topic)
         if topic not in self._topics:
             self.create_topic(topic)
@@ -210,6 +213,7 @@ class Broker:
         per message, the ingest bridges' hot path.  The optional 4th
         element carries record headers (trace context); wire/native
         clients accept and drop it (no header slot on MessageSet v1)."""
+        chaos.point("broker.produce")
         self._check_producer(topic)
         entries = list(entries)
         if topic not in self._topics:
@@ -272,6 +276,8 @@ class Broker:
     def fetch(self, topic: str, partition: int, offset: int,
               max_messages: int = 1024) -> List[Message]:
         """Read up to max_messages starting at offset (monotone, no blocking)."""
+        chaos.point("broker.fetch")  # before the lock: a chaos stall must
+        # park this fetcher, never every thread contending the broker
         part = self._parts[topic][partition]
         with self._lock:
             start = max(offset, part.base_offset)

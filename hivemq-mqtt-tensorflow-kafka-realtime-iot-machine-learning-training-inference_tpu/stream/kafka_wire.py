@@ -34,6 +34,7 @@ import threading
 import zlib
 from typing import Dict, List, Optional, Tuple
 
+from ..chaos import faults as chaos
 from ..utils.net import recv_exact
 from .broker import Broker, Message, TopicSpec
 
@@ -445,9 +446,17 @@ class KafkaWireBroker(ProducePartitionMixin):
         return recv_exact(self._sock, n, "broker closed connection")
 
     def _send_frame(self, payload: bytes) -> None:
-        self._sock.sendall(struct.pack(">i", len(payload)) + payload)
+        act = chaos.point("kafka_wire.send")
+        data = struct.pack(">i", len(payload)) + payload
+        if act is not None and act.kind == "short_write":
+            # a torn frame on the wire: the server sees a truncated
+            # request and drops the connection, this client fails over
+            self._sock.sendall(data[: len(data) // 2])
+            raise OSError("chaos[kafka_wire.send]: short write")
+        self._sock.sendall(data)
 
     def _recv_frame(self) -> bytes:
+        chaos.point("kafka_wire.recv")
         (size,) = struct.unpack(">i", self._recv_exact(4))
         return self._recv_exact(size)
 

@@ -36,7 +36,9 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..chaos import faults as chaos
 from ..obs import metrics as obs_metrics
+from ..utils.backoff import ExpBackoff
 from .broker import Broker
 from .kafka_wire import KafkaWireBroker, KafkaWireServer
 
@@ -121,6 +123,15 @@ class FollowerReplica:
 
     # ------------------------------------------------------ replication
     def _run(self) -> None:
+        # bounded exponential backoff with jitter for reconnect attempts
+        # against a dead/dying leader: the fixed `interval * 4` retry
+        # busy-spun through a long outage (a chaos blackout scenario
+        # turns that into thousands of doomed reconnects), and unjittered
+        # retries from a follower fleet re-thundering-herd the leader the
+        # instant it returns
+        base = max(self._interval * 2, 0.01)  # poll_interval_s=0 is a
+        # legal busy-poll; the reconnect path still must not busy-spin
+        backoff = ExpBackoff(base_s=base, cap_s=max(2.0, base))
         while not self._stop.is_set():
             try:
                 # cadence-throttled mirroring: sync_once(None) lets the
@@ -131,8 +142,9 @@ class FollowerReplica:
                 # the follower's job is to keep serving what it has
                 self.sync_errors.append(f"{type(e).__name__}: {e}")
                 obs_metrics.replica_sync_errors.inc()
-                time.sleep(self._interval * 4)
+                time.sleep(backoff.next_delay())
                 continue
+            backoff.reset()
             self.rounds += 1
             obs_metrics.replica_sync_rounds.inc()
             if not moved:
@@ -144,6 +156,9 @@ class FollowerReplica:
         direct calls mirror the commit tables unconditionally
         (deterministic); the background loop passes None to apply the
         commit_interval_s cadence instead."""
+        act = chaos.point("replica.sync")
+        if act is not None and act.kind == "skip":
+            return 0  # injected pause: this round replicates nothing
         names = self._topics if self._topics is not None \
             else self._leader.topics()
         copied = 0

@@ -24,6 +24,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from ..chaos import faults as chaos
 from ..data.dataset import SensorBatches
 from ..obs import metrics as obs_metrics
 from ..stream.consumer import StreamConsumer
@@ -140,6 +141,7 @@ class ContinuousTrainer:
         start = self.rounds
         while (stop is None or not stop()) and \
                 (max_rounds is None or self.rounds - start < max_rounds):
+            chaos.point("trainer.poll")
             if self.available() < self.min_available:
                 time.sleep(poll_interval_s)
                 continue

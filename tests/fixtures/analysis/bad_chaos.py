@@ -1,0 +1,13 @@
+"""Seeded R7 violations: chaos machinery leaking outside the faultpoint
+allowlist.  This module is NOT on CHAOS_ALLOWED_MODULES, so both the
+imports and the shim call below must be flagged — and the scenarios
+import would be flagged even on an allowlisted module (only the shim
+`faults` may cross into production code)."""
+
+from iotml.chaos import scenarios  # noqa: F401  (R7: not the shim)
+from iotml.chaos import faults as chaos  # R7: shim outside the allowlist
+
+
+def hot_path(consumer):
+    chaos.point("broker.fetch")  # R7: faultpoint outside the allowlist
+    return consumer.poll()

@@ -35,6 +35,7 @@ def test_lint_flags_every_seeded_violation():
     assert by_file.get("bad_blocking.py") == {"R4"}
     assert by_file.get("bad_owned_topic.py") == {"R5"}
     assert by_file.get("bad_span_metric.py") == {"R6"}
+    assert by_file.get("bad_chaos.py") == {"R7"}
     # a reason-less suppression is itself a finding AND does not suppress
     assert by_file.get("bad_suppression.py") == {"R3"}
     # the runtime fixture is lint-clean (locks held via `with` only)
@@ -75,6 +76,57 @@ def test_lint_r6_naming_and_span_under_lock():
     assert "Decode-Stage" in msgs[3]                 # malformed stage name
     # clean shapes stay clean: a conforming iotml_ name and a mark with
     # no lock held produced no findings (exactly the 4 above)
+
+
+def test_lint_r7_chaos_allowlist_and_shim_discipline(tmp_path):
+    """R7 all three shapes: a non-shim chaos import, a shim import
+    outside the allowlist, and a chaos.point() call outside the
+    allowlist — plus the only-the-shim rule holding ON an allowlisted
+    module."""
+    path = os.path.join(FIXTURES, "bad_chaos.py")
+    findings = lint_file(path)
+    assert [f.rule for f in findings] == ["R7"] * 3
+    assert [f.line for f in findings] == [7, 8, 12]
+    assert "allowlist" in findings[1].message
+    assert "broker.fetch" in findings[2].message
+    # an allowlisted module importing scenario machinery is still flagged
+    bad = tmp_path / "broker.py"
+    bad.write_text("from ..chaos import scenarios\n")
+    findings = lint_file(str(bad), rel="iotml/stream/broker.py")
+    assert [f.rule for f in findings] == ["R7"]
+    assert "shim" in findings[0].message
+    # the evasion form — the package via the alias list, not the module
+    # path — is flagged everywhere, allowlisted or not
+    for rel in ("iotml/stream/broker.py", "iotml/serve/live.py"):
+        for stmt in ("from iotml import chaos\n", "from .. import chaos\n"):
+            evade = tmp_path / "evade.py"
+            evade.write_text(stmt)
+            findings = lint_file(str(evade), rel=rel)
+            assert [f.rule for f in findings] == ["R7"], (rel, stmt)
+    # while the real allowlisted shim import form stays clean
+    ok = tmp_path / "ok_broker.py"
+    ok.write_text("from ..chaos import faults as chaos\n"
+                  "def fetch():\n    chaos.point('broker.fetch')\n")
+    assert lint_file(str(ok), rel="iotml/stream/broker.py") == []
+
+
+def test_r7_allowlist_matches_the_tree():
+    """Every module on CHAOS_ALLOWED_MODULES actually compiles in a
+    faultpoint, and every compiled-in faultpoint name is registered —
+    the allowlist and the registry cannot drift from the code."""
+    import re
+
+    from iotml.chaos import faults
+
+    root = lint_mod.default_root()
+    used = set()
+    for pkg, fn in lint_mod.CHAOS_ALLOWED_MODULES:
+        src = open(os.path.join(root, pkg, fn)).read()
+        names = re.findall(r"chaos\.point\(\"([^\"]+)\"\)", src)
+        assert names, f"{pkg}/{fn} is allowlisted but has no faultpoint"
+        used.update(names)
+    assert used == set(faults.KNOWN_POINTS), (
+        "faultpoint registry out of sync with compiled-in sites")
 
 
 def test_lint_clean_on_the_tree():

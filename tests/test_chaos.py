@@ -1,0 +1,379 @@
+"""iotml.chaos: deterministic schedules, the disarmed no-op contract,
+the injection engine's window/action/ledger semantics, reconnect
+backoff (the rewind loops chaos blackouts exercise), and one
+end-to-end invariant-checked run per built-in scenario — including the
+seeded loss-bug fixture the checker must FAIL on."""
+
+import subprocess
+import sys
+import threading
+import time
+import random
+
+import pytest
+
+from iotml.chaos import faults
+from iotml.chaos.faults import Action, ChaosEngine
+from iotml.chaos.scenarios import SCENARIOS, FaultEvent, build
+from iotml.config import load_config
+from iotml.utils.backoff import ExpBackoff
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """Every test starts and ends with chaos disarmed (module global)."""
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+# ------------------------------------------------------------- schedules
+def test_schedules_are_deterministic_and_seed_sensitive():
+    for name in SCENARIOS:
+        a = build(name, seed=11, records=500)
+        b = build(name, seed=11, records=500)
+        assert a.text() == b.text(), name  # byte-identical replay
+        assert a.events, name
+    # and the seed actually matters where the builder draws randomness
+    assert build("mqtt-flap", seed=1, records=500).text() != \
+        build("mqtt-flap", seed=2, records=500).text()
+
+
+def test_schedule_cli_byte_identical():
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cmd = [sys.executable, "-m", "iotml.chaos", "schedule",
+           "--scenario", "mqtt-flap", "--seed", "7", "--records", "400"]
+    a = subprocess.run(cmd, capture_output=True, cwd=repo)
+    b = subprocess.run(cmd, capture_output=True, cwd=repo)
+    assert a.returncode == b.returncode == 0, a.stderr
+    assert a.stdout == b.stdout
+    assert b"mqtt.deliver" in a.stdout
+
+
+def test_build_rejects_unknowns():
+    with pytest.raises(KeyError):
+        build("no-such-scenario", seed=1, records=100)
+    with pytest.raises(ValueError):
+        build("mqtt-flap", seed=1, records=3)  # below one fleet tick
+
+
+def test_engine_rejects_unknown_faultpoint():
+    with pytest.raises(ValueError, match="unknown faultpoint"):
+        ChaosEngine([FaultEvent(1, "nope.nope", "drop")])
+
+
+def test_engine_rejects_typoed_action_and_exception():
+    """A typo'd action/exception must fail at build time — it would
+    otherwise count as injected while doing nothing (a lying report)."""
+    with pytest.raises(ValueError, match="does not interpret"):
+        ChaosEngine([FaultEvent(1, "mqtt.deliver", "drip")])
+    with pytest.raises(ValueError, match="does not interpret"):
+        ChaosEngine([FaultEvent(1, "broker.fetch", "drop")])
+    with pytest.raises(ValueError, match="unknown exception"):
+        ChaosEngine([FaultEvent(1, "broker.fetch", "error",
+                                params=(("exc", "ValurError"),))])
+
+
+def test_engine_rejects_overlapping_site_actions():
+    """A call site consumes ONE action per hit, so two non-delay events
+    covering the same hit could not both execute — rejected at build
+    (delays compose with anything and stay legal)."""
+    with pytest.raises(ValueError, match="overlapping non-delay"):
+        ChaosEngine([FaultEvent(5, "mqtt.deliver", "drop"),
+                     FaultEvent(5, "mqtt.deliver", "dup")])
+    with pytest.raises(ValueError, match="overlapping non-delay"):
+        ChaosEngine([FaultEvent(3, "broker.fetch", "error", repeat=4),
+                     FaultEvent(5, "broker.fetch", "error")])
+    # delay + drop on the same hit is the legal composition
+    ChaosEngine([FaultEvent(5, "mqtt.deliver", "delay", repeat=10),
+                 FaultEvent(7, "mqtt.deliver", "drop")])
+
+
+# ------------------------------------------------------ disarmed contract
+def test_disarmed_point_is_noop():
+    """The tier-1 contract: shims in place, chaos unset -> nothing
+    happens.  (The rest of the suite runs the whole pipeline through
+    these shims disarmed, which is the behavior-unchanged proof.)"""
+    assert faults.engine() is None
+    before = faults.chaos_injected.value(fault="broker.fetch:error")
+    for name in faults.KNOWN_POINTS:
+        assert faults.point(name) is None
+    assert faults.engine() is None
+    assert faults.chaos_injected.value(fault="broker.fetch:error") == before
+
+
+def test_arm_from_env_gates_on_toggle():
+    # only an explicit opt-in arms: every disable spelling the other
+    # IOTML_ toggles accept must NOT arm chaos with a default scenario
+    for off in ("", "0", "false", "no", "off", "False"):
+        assert faults.arm_from_env({"IOTML_CHAOS": off}) is None, off
+    eng = faults.arm_from_env({"IOTML_CHAOS": "1",
+                               "IOTML_CHAOS_SCENARIO": "dup-storm",
+                               "IOTML_CHAOS_SEED": "3"})
+    assert eng is not None and faults.engine() is eng
+
+
+def test_chaos_toggles_never_leak_into_config_tree():
+    """IOTML_CHAOS* are process toggles in config's non_config set: the
+    resolver must neither reject them (typo'd IOTML_ vars fail loudly
+    by design) nor apply them anywhere in the config tree."""
+    cfg, _ = load_config(argv=[], env={
+        "IOTML_CHAOS": "1", "IOTML_CHAOS_SEED": "9",
+        "IOTML_CHAOS_SCENARIO": "mqtt-flap",
+        "IOTML_CHAOS_RECORDS": "500"})
+    clean, _ = load_config(argv=[], env={})
+    assert cfg.as_dict() == clean.as_dict()
+    assert cfg.applied == set()
+
+
+# ------------------------------------------------------------ the engine
+def test_engine_windows_actions_and_ledger(monkeypatch):
+    slept = []
+    monkeypatch.setattr("iotml.chaos.faults.time.sleep", slept.append)
+    eng = faults.arm(ChaosEngine([
+        FaultEvent(2, "broker.fetch", "delay",
+                   params=(("seconds", 0.5),), repeat=2),
+        FaultEvent(5, "broker.fetch", "error",
+                   params=(("exc", "OSError"),)),
+        FaultEvent(1, "mqtt.deliver", "dup"),
+        FaultEvent(2, "mqtt.deliver", "drop"),
+        FaultEvent(3, "mqtt.deliver", "drop",
+                   params=(("account", False),)),
+    ]))
+    assert faults.point("broker.fetch") is None          # hit 1: clean
+    assert faults.point("broker.fetch") is None          # hit 2: delay
+    assert faults.point("broker.fetch") is None          # hit 3: delay
+    assert slept == [0.5, 0.5]
+    assert faults.point("broker.fetch") is None          # hit 4: clean
+    with pytest.raises(OSError):
+        faults.point("broker.fetch")                     # hit 5: error
+    assert faults.point("mqtt.deliver") == Action("dup", {})
+    assert faults.point("mqtt.deliver") == \
+        Action("drop", {})                               # accounted
+    assert faults.point("mqtt.deliver") == \
+        Action("drop", {"account": False})               # the seeded bug
+    assert eng.dropped_count == 1  # only the accounted drop ledgered
+    assert eng.injected == {"broker.fetch:delay": 2,
+                            "broker.fetch:error": 1,
+                            "mqtt.deliver:dup": 1,
+                            "mqtt.deliver:drop": 2}
+
+
+def test_engine_fires_every_overlapping_event(monkeypatch):
+    """The schedule is ground truth: an event scheduled INSIDE another
+    event's repeat-window must still fire (a drop inside a delay window
+    both delays and drops), or the executed faults silently diverge
+    from the canonical schedule text."""
+    slept = []
+    monkeypatch.setattr("iotml.chaos.faults.time.sleep", slept.append)
+    eng = faults.arm(ChaosEngine([
+        FaultEvent(11, "mqtt.deliver", "delay",
+                   params=(("seconds", 0.25),), repeat=5),
+        FaultEvent(12, "mqtt.deliver", "drop"),
+    ]))
+    actions = [faults.point("mqtt.deliver") for _ in range(16)]
+    assert eng.injected == {"mqtt.deliver:delay": 5,
+                            "mqtt.deliver:drop": 1}
+    assert eng.dropped_count == 1
+    assert slept == [0.25] * 5
+    assert actions[11] == Action("drop", {})  # hit 12: delayed AND dropped
+    assert [a for a in actions if a is not None] == [actions[11]]
+    # replaying every hit of a full built schedule executes exactly the
+    # events the canonical text lists (the review-found divergence case)
+    eng = faults.arm(ChaosEngine(build("mqtt-flap", seed=2,
+                                       records=100).events))
+    for _ in range(100):
+        faults.point("mqtt.deliver")
+    assert eng.injected["mqtt.deliver:drop"] == 2  # both scheduled drops
+
+
+def test_trainer_faultpoint_fires(tmp_path):
+    """The trainer.poll shim is live: an armed delay fires once per
+    run() iteration (the only faultpoint not driven by the runner)."""
+    from iotml.stream.broker import Broker
+    from iotml.train.artifacts import ArtifactStore
+    from iotml.train.live import ContinuousTrainer
+
+    broker = Broker()
+    broker.create_topic("t", partitions=1)
+    trainer = ContinuousTrainer(broker, "t",
+                                ArtifactStore(str(tmp_path)),
+                                take_batches=1)
+    eng = faults.arm(ChaosEngine([
+        FaultEvent(1, "trainer.poll", "delay",
+                   params=(("seconds", 0.0),))]))
+    calls = iter([False, True])
+    trainer.run(stop=lambda: next(calls), poll_interval_s=0.0)
+    assert eng.injected == {"trainer.poll:delay": 1}
+
+
+# -------------------------------------------------------------- backoff
+def test_expbackoff_envelope_and_reset():
+    b = ExpBackoff(base_s=0.1, cap_s=2.0, factor=2.0,
+                   rng=random.Random(0))
+    delays = [b.next_delay() for _ in range(8)]
+    raw = [min(2.0, 0.1 * 2 ** n) for n in range(8)]
+    for d, r in zip(delays, raw):
+        assert r / 2 <= d <= r  # jitter in [raw/2, raw]
+    assert max(delays) <= 2.0
+    assert b.attempt == 8
+    b.reset()
+    assert b.attempt == 0
+    assert b.next_delay() <= 0.1
+    with pytest.raises(ValueError):
+        ExpBackoff(base_s=0.5, cap_s=0.1)
+    with pytest.raises(ValueError):
+        ExpBackoff(factor=1.0)
+
+
+def test_scorer_rewind_loop_backs_off(monkeypatch):
+    """run_forever's ConnectionError branch sleeps on the bounded
+    exponential schedule, not the fixed poll interval (which a dead
+    leader turned into a busy-spin)."""
+    from iotml.serve.scorer import StreamScorer
+
+    slept = []
+    monkeypatch.setattr("iotml.serve.scorer.time.sleep", slept.append)
+
+    class _Consumer:
+        rewound = 0
+
+        def rewind_to_committed(self):
+            self.rewound += 1
+
+    class _Batches:
+        consumer = _Consumer()
+
+    scorer = object.__new__(StreamScorer)
+    scorer.batches = _Batches()
+
+    def dead_leader(max_rows=None):
+        raise ConnectionError("leader stays dead")
+
+    scorer.score_available = dead_leader
+    scorer.run_forever(poll_interval_s=0.01, max_rounds=6)
+    assert scorer.batches.consumer.rewound == 6
+    assert len(slept) == 6
+    # poll_interval_s=0 (a legal busy-poll) must not crash the
+    # failure-path backoff construction
+    scorer.run_forever(poll_interval_s=0.0, max_rounds=2)
+    assert scorer.batches.consumer.rewound == 8
+    # envelope: starts at the poll interval, grows, never passes the cap
+    assert slept[0] <= 0.01
+    assert slept[5] >= min(2.0, 0.01 * 2 ** 5) / 2 > slept[0]
+    assert max(slept) <= 2.0
+
+
+def test_replica_reconnect_backs_off(monkeypatch):
+    """A follower whose leader STAYS dead retries on the growing
+    schedule (was: fixed interval*4 forever)."""
+    from iotml.stream.broker import Broker
+    from iotml.stream.kafka_wire import KafkaWireServer
+    from iotml.stream.replica import FollowerReplica
+
+    slept = []
+    real_sleep = time.sleep  # the patch below hits the time module itself
+
+    def fake_sleep(s):
+        slept.append(s)
+        real_sleep(0.001)
+
+    monkeypatch.setattr("iotml.stream.replica.time.sleep", fake_sleep)
+    broker = Broker()
+    broker.create_topic("T", partitions=1)
+    broker.produce("T", b"x")
+    srv = KafkaWireServer(broker).start()
+    rep = FollowerReplica(f"127.0.0.1:{srv.port}", topics=["T"],
+                          poll_interval_s=0.01).start()
+    try:
+        deadline = time.monotonic() + 10
+        while rep.rounds < 1 and time.monotonic() < deadline:
+            real_sleep(0.01)
+        srv.kill()
+        while len(rep.sync_errors) < 6 and time.monotonic() < deadline:
+            real_sleep(0.01)
+        assert len(rep.sync_errors) >= 6
+    finally:
+        rep.stop()
+    # backoff sleeps (base 0.02) dominate the idle sleeps (0.01): the
+    # 6th consecutive failure sleeps >= min(2.0, 0.02*2^5)/2 = 0.32
+    assert max(slept) >= 0.16
+    assert max(slept) <= 2.0
+
+
+# ------------------------------------------------- end-to-end scenarios
+def _run(scenario, seed=7, records=100, tmp_path=None, **kw):
+    from iotml.chaos.runner import ChaosRunner
+
+    if tmp_path is not None and "span_path" not in kw:
+        # keep test span logs under pytest's tmp dir, not /tmp litter
+        kw["span_path"] = str(tmp_path / "spans.jsonl")
+    return ChaosRunner(scenario, seed=seed, records=records, **kw).run()
+
+
+def _failed(report):
+    return [i.name for i in report.invariants if not i.ok]
+
+
+@pytest.mark.parametrize("scenario", [
+    "mqtt-flap", "slow-bridge", "dup-storm", "partition-blackout",
+    "scorer-crash-resume"])
+def test_inproc_scenarios_hold_the_invariants(scenario, tmp_path):
+    report = _run(scenario, records=100, tmp_path=tmp_path)
+    assert report.ok, _failed(report)
+    assert sum(report.injected.values()) > 0
+    assert report.published == 100
+    if scenario == "mqtt-flap":
+        assert report.dropped_accounted > 0
+        assert report.scored == 100 - report.dropped_accounted
+    if scenario == "dup-storm":
+        assert report.scored > 100  # duplicates absorbed, not lost
+    if scenario in ("partition-blackout", "scorer-crash-resume"):
+        assert report.rewinds > 0  # redelivery actually exercised
+
+
+def test_leader_kill_scenario_holds_the_invariants():
+    report = _run("leader-kill-mid-drain", records=100)
+    assert report.ok, _failed(report)
+    assert report.injected.get("runner.kill_leader:kill_leader") == 1
+    assert report.scored >= report.published == 100
+    names = [i.name for i in report.invariants]
+    assert "promotion_loss_bounded" in names
+
+
+def test_loss_bug_fixture_fails_the_checker(tmp_path):
+    """The checker checked: a committed-then-silently-dropped record
+    (the seeded unledgered drop) must FAIL, naming the lost trace."""
+    report = _run("loss-bug-fixture", records=100, tmp_path=tmp_path)
+    assert not report.ok
+    failed = _failed(report)
+    assert "scored_or_accounted" in failed
+    detail = next(i.detail for i in report.invariants
+                  if i.name == "scored_or_accounted")
+    assert "SILENTLY LOST" in detail
+
+
+def test_same_seed_same_verdict(tmp_path):
+    """Determinism end to end: schedule, fault counts, published/scored
+    totals and every verdict replay exactly."""
+    a = _run("mqtt-flap", seed=5, records=75,
+             span_path=str(tmp_path / "a.jsonl"))
+    b = _run("mqtt-flap", seed=5, records=75,
+             span_path=str(tmp_path / "b.jsonl"))
+    assert build("mqtt-flap", seed=5, records=75).text() == \
+        build("mqtt-flap", seed=5, records=75).text()
+    assert (a.published, a.scored, a.injected, a.dropped_accounted) == \
+        (b.published, b.scored, b.injected, b.dropped_accounted)
+    assert [(i.name, i.ok) for i in a.invariants] == \
+        [(i.name, i.ok) for i in b.invariants]
+    assert a.ok and b.ok
+
+
+def test_runner_restores_tracing_state(tmp_path):
+    from iotml.obs import tracing
+
+    before = (tracing.ENABLED, tracing._SAMPLE, tracing._PATH)
+    _run("dup-storm", records=50, tmp_path=tmp_path)
+    assert (tracing.ENABLED, tracing._SAMPLE, tracing._PATH) == before

@@ -66,10 +66,19 @@ def test_parse_avsc_roundtrip_both_variants():
         assert parsed.label_field == schema.label_field
 
 
+_REFERENCE_AVSC = ("/root/reference/python-scripts/AUTOENCODER-TensorFlow-IO-"
+                   "Kafka/cardata-v1.avsc")
+
+
 def test_parse_reference_avsc_file():
     """The KSQL-derived schema the reference ML apps actually load."""
-    avsc = open("/root/reference/python-scripts/AUTOENCODER-TensorFlow-IO-"
-                "Kafka/cardata-v1.avsc").read()
+    import os
+
+    if not os.path.exists(_REFERENCE_AVSC):
+        # the conftest guard checks only the checkout root; a partial
+        # mount (root present, file absent) must skip, not fail
+        pytest.skip("reference avsc not mounted")
+    avsc = open(_REFERENCE_AVSC).read()
     schema = parse_avsc(avsc)
     assert len(schema.fields) == 19
     assert schema.label_field == "FAILURE_OCCURRED"
