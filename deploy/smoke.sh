@@ -9,26 +9,31 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 0/4 concurrency & protocol-invariant lint (iotml.analysis)"
+echo "== 0/5 concurrency & protocol-invariant lint (iotml.analysis)"
 python -m iotml.analysis lint
 
-echo "== 1/4 chaos drill: seeded failure scenario, invariant-checked"
+echo "== 1/5 chaos drill: seeded failure scenario, invariant-checked"
 JAX_PLATFORMS=cpu python -m iotml.chaos run --scenario mqtt-flap \
   --seed 7 --records 500
 
-echo "== 2/4 validate manifests against the codebase"
+echo "== 2/5 supervised restart: live scorer-crash drill (the scorer"
+echo "        thread dies twice; the supervisor must heal the pipeline)"
+JAX_PLATFORMS=cpu python -m iotml.supervise drill --drill scorer-crash \
+  --seed 7 --records 500
+
+echo "== 3/5 validate manifests against the codebase"
 python deploy/validate_manifests.py
 
 if command -v docker >/dev/null 2>&1; then
-  echo "== 3/4 docker build iotml:latest"
+  echo "== 4/5 docker build iotml:latest"
   docker build -t iotml:latest .
-  echo "== 4/4 manifest-driven train+predict inside the image"
+  echo "== 5/5 manifest-driven train+predict inside the image"
   docker run --rm -e JAX_PLATFORMS=cpu iotml:latest \
     deploy/run_manifest_job.py
 else
-  echo "== 3/4 docker not found — executing manifest commands locally"
+  echo "== 4/5 docker not found — executing manifest commands locally"
   JAX_PLATFORMS=cpu python deploy/run_manifest_job.py
-  echo "== 4/4 (image build skipped: no docker; Dockerfile is built by CI" \
+  echo "== 5/5 (image build skipped: no docker; Dockerfile is built by CI" \
        "or any docker host with: docker build -t iotml:latest .)"
 fi
 echo "deploy smoke: OK"

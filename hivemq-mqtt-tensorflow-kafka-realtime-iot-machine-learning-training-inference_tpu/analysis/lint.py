@@ -38,6 +38,13 @@ Rules (see ARCHITECTURE.md §analysis for the full table):
       ``faults`` — scenario/runner code (and its heavyweight deps) must
       never leak into hot paths, and new injection sites are a reviewed
       allowlist change, not a drive-by.
+  R8  supervised-thread discipline: every ``threading.Thread(...)``
+      constructed outside ``iotml/supervise/`` must be ``daemon=True``,
+      carry an explicit ``name=``, and be registered with the
+      supervisor registry (wrapped in ``register_thread(...)``) — the
+      self-healing runtime can only supervise what it can enumerate,
+      and a fire-and-forget anonymous thread is exactly the erosion
+      the supervise subsystem exists to stop.
 
 Suppression: append ``# lint-ok: RN <reason>`` to the flagged line (for
 R4, to the ``with`` line holding the lock).  A suppression WITHOUT a
@@ -114,6 +121,9 @@ RULES: Dict[str, str] = {
     "R7": "chaos shim (chaos.point / iotml.chaos import) outside the "
           "faultpoint allowlist, or a production import of a chaos "
           "module other than the shim (iotml.chaos.faults)",
+    "R8": "threading.Thread outside iotml/supervise/ must be daemon, "
+          "named, and wrapped in register_thread(...) (supervisor "
+          "registry)",
 }
 
 _SUPPRESS_RE = re.compile(r"#\s*lint-ok:\s*(R\d)\b[ \t]*(.*)")
@@ -211,6 +221,18 @@ def _is_time_time(node: ast.Call) -> bool:
     f = node.func
     return (isinstance(f, ast.Attribute) and f.attr == "time"
             and isinstance(f.value, ast.Name) and f.value.id == "time")
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    """``<any name>.Thread(...)`` or a bare imported ``Thread(...)``.
+    Matching ANY module name (not just ``threading``) closes the
+    ``import threading as t; t.Thread(...)`` evasion — conservative in
+    the lint's usual direction: flag, and let a false positive justify
+    itself with a suppression."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr == "Thread" and isinstance(f.value, ast.Name)
+    return isinstance(f, ast.Name) and f.id == "Thread"
 
 
 def _lockish_name(expr: ast.expr) -> Optional[str]:
@@ -345,12 +367,21 @@ class _FileLinter(ast.NodeVisitor):
         parts = rel.replace(os.sep, "/").split("/")
         self.r1_scoped = any(seg in parts for seg in R1_PATH_SEGMENTS)
         self.in_streamproc = "streamproc" in parts
-        # R7 scoping: the chaos package itself is exempt; everything
-        # else is held to the allowlist
-        self.in_chaos = "chaos" in parts
+        # R7 scoping: the chaos package itself is exempt, and so is the
+        # supervise package — its live drills are the threaded peer of
+        # chaos.runner (harness code arming engines against real
+        # platforms), not a hot path
+        self.in_chaos = "chaos" in parts or "supervise" in parts
         self.chaos_allowed = self.in_chaos or (
             len(parts) >= 2 and (parts[-2], parts[-1])
             in CHAOS_ALLOWED_MODULES)
+        # R8 scoping: the supervise package OWNS thread lifecycles (the
+        # registry itself, the monitor) and is exempt from wrapping
+        self.in_supervise = "supervise" in parts
+        #: Thread(...) call nodes already seen as a register_thread(...)
+        #: argument — outer calls visit before inner ones, so by the
+        #: time visit_Call reaches the Thread node it is marked
+        self._registered_threads: Set[int] = set()
         self._lock_stack: List[Tuple[str, int, bool]] = []  # (name, line, suppressed)
 
     def _emit(self, rule: str, node: ast.AST, message: str) -> None:
@@ -513,6 +544,31 @@ class _FileLinter(ast.NodeVisitor):
                        "faultpoint allowlist (CHAOS_ALLOWED_MODULES): "
                        "new injection sites are a reviewed allowlist "
                        "change, not a drive-by")
+
+        # R8 — supervised-thread discipline.  Outer calls visit before
+        # their argument nodes, so marking register_thread's Thread
+        # argument here is always ahead of that Thread's own visit.
+        if name == "register_thread":
+            for arg in node.args:
+                if isinstance(arg, ast.Call) and _is_thread_ctor(arg):
+                    self._registered_threads.add(id(arg))
+        if not self.in_supervise and _is_thread_ctor(node):
+            kw = {k.arg: k.value for k in node.keywords}
+            missing = []
+            d = kw.get("daemon")
+            if not (isinstance(d, ast.Constant) and d.value is True):
+                missing.append("daemon=True")
+            if "name" not in kw:
+                missing.append("an explicit name=")
+            if id(node) not in self._registered_threads:
+                missing.append("a register_thread(...) wrapper "
+                               "(iotml.supervise.registry)")
+            if missing:
+                self._emit("R8", node,
+                           "unsupervised thread: needs "
+                           + ", ".join(missing)
+                           + " — the self-healing runtime can only "
+                             "supervise what it can enumerate")
 
         # R5 — engine-owned topic produced outside streamproc/
         if not self.in_streamproc and name in ("produce", "produce_many",

@@ -55,7 +55,10 @@ def _stopper(max_seconds: float):
                 break
         ev.set()
 
-    threading.Thread(target=watch_stdin, daemon=True).start()
+    from ..supervise.registry import register_thread
+
+    register_thread(threading.Thread(target=watch_stdin, daemon=True,
+                                     name="iotml-stdin-watch")).start()
     deadline = time.time() + max_seconds if max_seconds else None
     return lambda: ev.is_set() or (deadline is not None
                                    and time.time() > deadline)

@@ -200,7 +200,10 @@ class Platform:
                     except OSError:
                         pass
 
-        self._fleet_thread = threading.Thread(target=run, daemon=True)
+        from ..supervise.registry import register_thread
+
+        self._fleet_thread = register_thread(threading.Thread(
+            target=run, daemon=True, name="iotml-fleet"))
         self._fleet_thread.start()
 
     def stop_fleet(self) -> None:
@@ -217,6 +220,88 @@ class Platform:
         n = self.ksql.pump_now()
         self.connect.pump_now()
         return n
+
+    # ------------------------------------------------------- supervision
+    def supervised(self, poll_interval_s: Optional[float] = None):
+        """A Supervisor owning this platform's component lifecycles.
+
+        ``start()`` alone launches every component fire-and-forget (the
+        pre-supervision behavior, kept for tests); this wraps each
+        component's serving thread(s) in a probed unit so a crashed
+        accept loop / pump loop / event loop is detected and its thread
+        respawned under backoff — the kubelet role the reference
+        delegates to Kubernetes Deployments (SURVEY §2.6/§2.7).  The
+        MQTT→Kafka bridge has no thread of its own (it runs inside the
+        MQTT delivery path) and needs no unit.  Returns the Supervisor
+        (caller starts/stops it); unit states surface on ``/healthz``."""
+        import os as _os
+
+        from ..supervise.registry import register_thread
+        from ..supervise.supervisor import Supervisor
+
+        if poll_interval_s is None:
+            # platform default is laxer than the Supervisor's (these are
+            # thread-aliveness probes, not failover detection), but the
+            # IOTML_SUPERVISE_POLL_S knob must still win when set
+            poll_interval_s = float(_os.environ.get(
+                "IOTML_SUPERVISE_POLL_S", "0.25"))
+        sup = Supervisor(poll_interval_s=poll_interval_s,
+                         name="platform-supervisor")
+
+        def thread_alive(get_thread):
+            def probe():
+                t = get_thread()
+                return t is not None and t.is_alive()
+            return probe
+
+        def respawn(get_thread, spawn):
+            def restart():
+                t = get_thread()
+                if t is None or not t.is_alive():
+                    spawn()
+            return restart
+
+        sup.add_probed(
+            "kafka-wire", thread_alive(lambda: self.kafka._thread),
+            restart=respawn(lambda: self.kafka._thread,
+                            self.kafka.start))
+        sup.add_probed(
+            "mqtt-front", thread_alive(lambda: self.mqtt._thread),
+            restart=respawn(lambda: self.mqtt._thread, self.mqtt.start))
+
+        def spawn_ksql_pump():
+            # respawn ONLY the pump thread: KsqlServer.start() would
+            # also duplicate the live REST serving thread
+            self.ksql._pump_thread = register_thread(threading.Thread(
+                target=self.ksql._pump_loop, daemon=True,
+                name="iotml-ksql-pump"))
+            self.ksql._pump_thread.start()
+
+        sup.add_probed(
+            "ksql-tasks", thread_alive(lambda: self.ksql._pump_thread),
+            restart=respawn(lambda: self.ksql._pump_thread,
+                            spawn_ksql_pump))
+
+        def spawn_connect_driver():
+            self.connect._driver = register_thread(threading.Thread(
+                target=self.connect._drive, daemon=True,
+                name="iotml-connect-driver"))
+            self.connect._driver.start()
+
+        sup.add_probed(
+            "connect-driver", thread_alive(lambda: self.connect._driver),
+            restart=respawn(lambda: self.connect._driver,
+                            spawn_connect_driver))
+        for name, rest in (("schema-registry", self.registry_server),
+                           ("control-center", self.control_center)):
+            sup.add_probed(
+                name, thread_alive(lambda r=rest: r._thread),
+                restart=respawn(lambda r=rest: r._thread,
+                                lambda r=rest: r.start()))
+        if self._fleet_thread is not None:
+            sup.add_probed(
+                "fleet", thread_alive(lambda: self._fleet_thread))
+        return sup
 
     def stop(self) -> None:
         self._fleet_stop.set()
@@ -259,6 +344,11 @@ def main(argv=None) -> int:
                     help="keep at most N messages per partition "
                          "(0 = unbounded; the reference retains ~100s). "
                          "Validated by the broker (negative rejected).")
+    ap.add_argument("--supervise", action="store_true",
+                    help="run component lifecycles under the "
+                         "iotml.supervise supervisor (crashed serving "
+                         "threads restart under backoff; unit states on "
+                         "/healthz).  Also enabled by IOTML_SUPERVISE=1.")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
 
@@ -277,6 +367,11 @@ def main(argv=None) -> int:
     plat.start(metrics_port=args.metrics_port)
     if args.fleet:
         plat.start_fleet(args.fleet, rate_hz=args.rate)
+    import os as _os
+
+    supervise = args.supervise or _os.environ.get(
+        "IOTML_SUPERVISE", "").strip().lower() in ("1", "true", "yes", "on")
+    sup = plat.supervised().start() if supervise else None
     if not args.quiet:
         print("iotml platform up:")
         for k, v in plat.endpoints().items():
@@ -284,11 +379,16 @@ def main(argv=None) -> int:
         if args.fleet:
             print(f"  fleet            {args.fleet} cars @ {args.rate} Hz → "
                   f"mqtt topic vehicles/sensor/data/<car>")
+        if sup is not None:
+            print(f"  supervisor       {len(sup.units())} units "
+                  f"(self-healing; states on /healthz)")
         print("Ctrl-C to stop.")
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
+        if sup is not None:
+            sup.stop()
         plat.stop()
         if not args.quiet:
             print("stopped.")

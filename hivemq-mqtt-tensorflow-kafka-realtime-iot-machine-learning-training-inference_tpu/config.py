@@ -200,15 +200,18 @@ def load_config(argv: Optional[Sequence[str]] = None,
     # process-level toggles that are NOT config: the test platform pin
     # (tests/conftest.py), the runtime lock-order detector switches
     # (iotml.analysis.lockcheck), the record-trace switches
-    # (iotml.obs.tracing) and the fault-injection switches
-    # (iotml.chaos.faults) ride the IOTML_ prefix but configure the
-    # harness around the process, not the pipeline inside it
+    # (iotml.obs.tracing), the fault-injection switches
+    # (iotml.chaos.faults) and the supervision switches (iotml.cli.up /
+    # iotml.supervise) ride the IOTML_ prefix but configure the harness
+    # around the process, not the pipeline inside it
     non_config = {"IOTML_CONFIG", "IOTML_TEST_PLATFORM",
                   "IOTML_LOCKCHECK", "IOTML_LOCKCHECK_STRICT",
                   "IOTML_TRACE", "IOTML_TRACE_SAMPLE", "IOTML_TRACE_PATH",
                   "IOTML_CHAOS", "IOTML_CHAOS_SEED",
                   "IOTML_CHAOS_SCENARIO", "IOTML_CHAOS_RECORDS",
-                  "IOTML_DEVSIM_DIR"}
+                  "IOTML_DEVSIM_DIR",
+                  "IOTML_SUPERVISE", "IOTML_SUPERVISE_POLL_S",
+                  "IOTML_SUPERVISE_MAX_RESTARTS"}
     for key, value in env.items():
         if not key.startswith("IOTML_") or key in non_config:
             continue

@@ -87,8 +87,11 @@ class ConnectServer(RestServer):
 
     # --------------------------------------------------------- lifecycle
     def start(self):
+        from ..supervise.registry import register_thread
+
         super().start()
-        self._driver = threading.Thread(target=self._drive, daemon=True)
+        self._driver = register_thread(threading.Thread(
+            target=self._drive, daemon=True, name="iotml-connect-driver"))
         self._driver.start()
         return self
 

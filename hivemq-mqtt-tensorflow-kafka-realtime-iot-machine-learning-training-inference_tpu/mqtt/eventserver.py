@@ -149,9 +149,12 @@ class MqttEventServer:
 
     # --------------------------------------------------------- lifecycle
     def start(self) -> "MqttEventServer":
+        from ..supervise.registry import register_thread
+
         self._running = True
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name=f"mqtt-evloop-{self.port}")
+        self._thread = register_thread(threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"mqtt-evloop-{self.port}"))
         self._thread.start()
         return self
 

@@ -172,8 +172,11 @@ class NativeIngestBridge:
         return self._n_fwd
 
     def start(self) -> "NativeIngestBridge":
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name=f"mqtt-native-{self.port}")
+        from ..supervise.registry import register_thread
+
+        self._thread = register_thread(threading.Thread(
+            target=self._run, daemon=True,
+            name=f"mqtt-native-{self.port}"))
         self._thread.start()
         return self
 

@@ -462,8 +462,11 @@ class MqttServer(socketserver.ThreadingTCPServer):
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "MqttServer":
-        self._thread = threading.Thread(target=self.serve_forever,
-                                        daemon=True)
+        from ..supervise.registry import register_thread
+
+        self._thread = register_thread(threading.Thread(
+            target=self.serve_forever, daemon=True,
+            name=f"iotml-mqtt-wire-{self.port}"))
         self._thread.start()
         return self
 
@@ -515,7 +518,11 @@ class MqttClient:
         # the connect timeout must not survive into the reader thread: an
         # idle subscriber would hit recv timeout after 10s and die silently
         self._sock.settimeout(None)
-        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        from ..supervise.registry import register_thread
+
+        self._reader = register_thread(threading.Thread(
+            target=self._read_loop, daemon=True,
+            name=f"iotml-mqtt-reader-{client_id}"))
         self._reader.start()
         # honor our announced keepalive: the server evicts at 1.5× with no
         # inbound packet, so an idle client must ping on its own — one
@@ -527,9 +534,9 @@ class MqttClient:
         # guarantee (at most ONE outstanding PINGREQ at a time)
         self._ping_lock = threading.Lock()
         if keepalive:
-            self._keeper = threading.Thread(
+            self._keeper = register_thread(threading.Thread(
                 target=self._keepalive_loop, args=(keepalive / 2,),
-                daemon=True)
+                daemon=True, name=f"iotml-mqtt-keepalive-{client_id}"))
             self._keeper.start()
 
     def _keepalive_loop(self, interval_s: float) -> None:

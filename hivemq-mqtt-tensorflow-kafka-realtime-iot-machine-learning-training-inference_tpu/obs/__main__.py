@@ -131,10 +131,71 @@ def cmd_trace(args) -> int:
     return 1 if failures else 0
 
 
+def cmd_dlq(args) -> int:
+    """Peek a dead-letter topic: decode the JSON envelopes the
+    streamproc DLQ writes and show what poisoned the pipeline."""
+    from ..stream.kafka_wire import KafkaWireBroker
+    from ..streamproc.dlq import DLQ_SUFFIX, decode_envelope
+
+    topic = args.topic if args.topic.endswith(DLQ_SUFFIX) \
+        else args.topic + DLQ_SUFFIX
+    try:
+        client = KafkaWireBroker(args.bootstrap, client_id="iotml-dlq-peek")
+    except OSError as e:
+        print(f"cannot reach broker {args.bootstrap!r}: {e}",
+              file=sys.stderr)
+        return 2
+    try:
+        try:
+            parts = client.topic(topic).partitions
+        except KeyError:
+            print(f"no dead letters: topic {topic!r} does not exist")
+            return 0
+        rows = []
+        for p in range(parts):
+            off = client.begin_offset(topic, p)
+            end = client.end_offset(topic, p)
+            while off < end and len(rows) < args.limit:
+                msgs = client.fetch(topic, p, off, max_messages=256)
+                if not msgs:
+                    break
+                for m in msgs:
+                    off = m.offset + 1
+                    try:
+                        rows.append(decode_envelope(m.value))
+                    except (ValueError, KeyError, TypeError):
+                        rows.append({"source": topic, "partition": p,
+                                     "offset": m.offset,
+                                     "error": "unparseable DLQ envelope",
+                                     "raw": m.value})
+                    if len(rows) >= args.limit:
+                        break
+    finally:
+        client.close()
+    if args.json:
+        for doc in rows:
+            doc = dict(doc)
+            doc["raw"] = doc.get("raw", b"")[:256].decode(errors="replace")
+            print(json.dumps(doc, sort_keys=True))
+        return 0
+    if not rows:
+        print(f"{topic}: empty")
+        return 0
+    print(f"{topic}: showing {len(rows)} dead letter(s)")
+    for doc in rows:
+        raw = doc.get("raw", b"")[:80]
+        print(f"  {doc.get('source')}:{doc.get('partition')}"
+              f"@{doc.get('offset')} [{doc.get('task') or '-'}] "
+              f"{doc.get('error')}"
+              + (f" trace={doc['trace']}" if doc.get("trace") else ""))
+        print(f"    raw[:80]: {raw!r}")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m iotml.obs",
-        description="observability tools (span-log analysis)")
+        description="observability tools (span-log analysis, DLQ peek)")
     sub = ap.add_subparsers(dest="cmd")
     tp = sub.add_parser(
         "trace", help="summarize a JSONL span log into a per-stage "
@@ -149,11 +210,25 @@ def main(argv=None) -> int:
     tp.add_argument("--require-e2e", action="store_true",
                     help="exit 1 unless closed e2e spans with nonzero "
                          "latency appear")
+    dp = sub.add_parser(
+        "dlq", help="peek a dead-letter topic's poisoned-record "
+                    "envelopes over the Kafka wire protocol")
+    dp.add_argument("--bootstrap", required=True,
+                    help="broker address host:port[,host:port...]")
+    dp.add_argument("--topic", default="sensor-data",
+                    help="source topic (the _DLQ suffix is appended "
+                         "unless already present)")
+    dp.add_argument("--limit", type=int, default=20,
+                    help="show at most N dead letters")
+    dp.add_argument("--json", action="store_true",
+                    help="one JSON envelope per line")
     args = ap.parse_args(argv)
-    if args.cmd != "trace":
-        ap.print_help()
-        return 2
-    return cmd_trace(args)
+    if args.cmd == "trace":
+        return cmd_trace(args)
+    if args.cmd == "dlq":
+        return cmd_dlq(args)
+    ap.print_help()
+    return 2
 
 
 if __name__ == "__main__":

@@ -243,6 +243,40 @@ replica_copied = default_registry.counter(
 replica_sync_errors = default_registry.counter(
     "iotml_replica_sync_errors_total",
     "replication rounds that failed (leader dying / unreachable)")
+# replication + failover observability (ISSUE 4): the loss window and
+# the fencing epoch as LIVE gauges, so dashboards see a promotion and
+# the at-risk record count without polling replica.lag() themselves
+replica_lag = default_registry.gauge(
+    "iotml_replica_lag_records",
+    "per-topic records the leader has that the follower does not "
+    "(the loss window if the leader died now)")
+failover_epoch = default_registry.gauge(
+    "iotml_failover_epoch",
+    "current leadership fencing epoch (bumped at every promotion)")
+# supervision (iotml.supervise): the kubelet-equivalent's own telemetry
+supervisor_unit_up = default_registry.gauge(
+    "iotml_supervisor_unit_up",
+    "1 while a supervised unit is live, 0 while down/degraded")
+supervisor_restarts = default_registry.counter(
+    "iotml_supervisor_restarts_total",
+    "restarts issued per supervised unit")
+supervisor_wedged = default_registry.counter(
+    "iotml_supervisor_wedged_total",
+    "wedge detections (live thread, stale heartbeat/stage) per unit")
+supervisor_degraded = default_registry.gauge(
+    "iotml_supervisor_degraded",
+    "1 when the restart-storm budget is exhausted and the supervisor "
+    "gave the unit up")
+supervisor_failovers = default_registry.counter(
+    "iotml_supervisor_failovers_total",
+    "on_death failover hooks fired (leader promotions)")
+# dead-letter queue (streamproc.dlq): poisoned frames routed, by source
+dlq_total = default_registry.counter(
+    "iotml_dlq_total",
+    "undecodable records routed to a dead-letter topic, by source topic")
+dlq_route_errors = default_registry.counter(
+    "iotml_dlq_route_errors_total",
+    "dead letters that could not be routed (degraded to a plain drop)")
 
 
 def start_http_server(port: int = 9100, registry: Registry = default_registry):
@@ -264,6 +298,29 @@ def start_http_server(port: int = 9100, registry: Registry = default_registry):
             "stages": {s: {"last_span_age_s": age}
                        for s, age in stages.items()},
         }
+        # supervision + failover state (ISSUE 4): unit states from any
+        # live supervisor, the replica loss window, and the fencing
+        # epoch.  Late import with a guard: an unsupervised process must
+        # not pay for (or crash on) the supervise package.
+        try:
+            from ..supervise import registry as _sup_registry
+
+            units = _sup_registry.snapshot()
+            if units:
+                doc["supervisor"] = units
+                doc["status"] = "degraded" if any(
+                    u.get("state") == "degraded"
+                    for u in units.values()) else doc["status"]
+        except Exception:  # noqa: BLE001 - health endpoint stays up
+            pass
+        with replica_lag._lock:
+            lag_vals = dict(replica_lag._vals)
+        if lag_vals:
+            doc["replica_lag_records"] = {
+                dict(k).get("topic", ""): v for k, v in lag_vals.items()}
+        epoch = failover_epoch.value()
+        if epoch:
+            doc["failover_epoch"] = epoch
         return json.dumps(doc, indent=2, sort_keys=True).encode()
 
     class Handler(http.server.BaseHTTPRequestHandler):
@@ -290,7 +347,11 @@ def start_http_server(port: int = 9100, registry: Registry = default_registry):
         def log_message(self, *a):  # quiet
             pass
 
+    from ..supervise.registry import register_thread
+
     srv = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
-    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t = register_thread(threading.Thread(
+        target=srv.serve_forever, daemon=True,
+        name=f"iotml-metrics-{srv.server_address[1]}"))
     t.start()
     return srv

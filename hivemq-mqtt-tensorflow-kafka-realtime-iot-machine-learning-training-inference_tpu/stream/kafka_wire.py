@@ -27,6 +27,7 @@ CreateTopics v0; ApiVersions v0; SaslHandshake v0 + raw PLAIN token frame
 
 from __future__ import annotations
 
+import re
 import socket
 import socketserver
 import struct
@@ -56,6 +57,7 @@ ERR_TOPIC_AUTHORIZATION_FAILED = 29
 ERR_UNSUPPORTED_VERSION = 35
 ERR_TOPIC_EXISTS = 36
 ERR_SASL_AUTH_FAILED = 58
+ERR_FENCED_LEADER_EPOCH = 74  # Kafka's own fencing error code
 
 _SUPPORTED = {PRODUCE: (2, 2), FETCH: (2, 2), LIST_OFFSETS: (1, 1),
               METADATA: (1, 1), OFFSET_COMMIT: (2, 2), OFFSET_FETCH: (1, 1),
@@ -80,6 +82,38 @@ class SaslAuthError(ConnectionError):
     or non-empty auth response) — as opposed to dying mid-handshake.
     Failover must not retry rejected credentials against every
     bootstrap server; connectivity errors it may."""
+
+
+class FencedEpochError(ConnectionError):
+    """A produce/commit was refused because the leadership epochs
+    disagree — either this client slept through a failover (its epoch
+    is stale) or it reached a RESURRECTED OLD LEADER (the server's
+    epoch is stale).  Both directions protect the log from splitting.
+    Subclasses ConnectionError so every existing redelivery loop
+    (scorer rewind, replica reconnect) treats it as a failover signal;
+    the client has already re-resolved topology before raising."""
+
+
+# ---------------------------------------------------------- epoch carrier
+# The fencing epoch rides the request header's client_id as a trailing
+# `@e<N>` tag — the one header field the classic encoding lets us extend
+# without changing a single wire type, so standard Kafka clients (no
+# tag → unfenced legacy path) remain byte-compatible with the server.
+_EPOCH_TAG_RE = re.compile(r"^(.*)@e(\d+)$")
+
+
+def tag_client_id(client_id: str, epoch: Optional[int]) -> str:
+    return client_id if epoch is None else f"{client_id}@e{int(epoch)}"
+
+
+def parse_client_epoch(client_id: Optional[str]) -> Tuple[str, Optional[int]]:
+    """(bare client id, stamped epoch or None) from a header client_id."""
+    if not client_id:
+        return client_id or "", None
+    m = _EPOCH_TAG_RE.match(client_id)
+    if m is None:
+        return client_id, None
+    return m.group(1), int(m.group(2))
 
 
 # ------------------------------------------------------------- primitives
@@ -374,7 +408,8 @@ class KafkaWireBroker(ProducePartitionMixin):
     def __init__(self, servers: str, client_id: str = "iotml",
                  sasl_username: Optional[str] = None,
                  sasl_password: Optional[str] = None,
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0, topology=None,
+                 epoch: Optional[int] = None):
         self.client_id = client_id
         self._lock = threading.Lock()
         self._corr = 0
@@ -391,10 +426,60 @@ class KafkaWireBroker(ProducePartitionMixin):
         self._timeout_s = timeout_s
         self._sasl_creds = ((sasl_username, sasl_password or "")
                             if sasl_username is not None else None)
+        # supervised topology (iotml.supervise.Topology duck-type): when
+        # given, every (re)connect re-resolves the ACTIVE leader + epoch
+        # from it instead of walking the static bootstrap order, and the
+        # epoch is stamped into each request's client id so the server
+        # can fence a stale party (see FencedEpochError).
+        self._topology = topology
+        self._epoch = epoch
         self._sock = None
-        self._connect_any()
+        self._connect_any()  # resolves topology first (its only caller)
         self._meta: Dict[str, int] = {}  # topic → partition count
         self._rr: Dict[str, int] = {}
+
+    # ------------------------------------------------------ epoch fencing
+    @property
+    def epoch(self) -> Optional[int]:
+        return self._epoch
+
+    def set_epoch(self, epoch: Optional[int]) -> None:
+        """Stamp `epoch` into subsequent request headers (None = legacy
+        unfenced client)."""
+        self._epoch = epoch
+
+    def _refresh_topology(self) -> None:
+        """Re-resolve (servers, epoch) from the published topology.
+        Caller must hold the lock (or be __init__, pre-threading)."""
+        if self._topology is None:
+            return
+        from ..utils.net import parse_bootstrap
+
+        servers, epoch = self._topology.resolve()
+        self._servers = list(parse_bootstrap(",".join(servers)))
+        self._servers_repr = ",".join(servers)
+        self._epoch = epoch
+
+    def _fenced(self, what: str) -> "FencedEpochError":
+        """Build the fence error AFTER re-resolving topology and
+        reconnecting, so the caller's retry (its redelivery loop) talks
+        to the real leader at the current epoch instead of failing
+        identically forever."""
+        stale = self._epoch
+        # lint-ok: R4 single-socket client by design (same contract as
+        # _request): reconnect I/O is bounded by timeout_s and requests
+        # are serialized over one connection anyway.
+        with self._lock:
+            try:
+                self._connect_any()  # re-resolves topology first
+            except OSError:
+                # nothing reachable right now: the next request's
+                # reconnect path retries; the fence error still stands
+                pass
+        return FencedEpochError(
+            f"{what} fenced: leadership epoch mismatch (client was at "
+            f"epoch {stale}, now {self._epoch}); topology re-resolved — "
+            f"the caller owns redelivery")
 
     # ---------------------------------------------------------- transport
     def _connect_any(self) -> None:
@@ -406,6 +491,11 @@ class KafkaWireBroker(ProducePartitionMixin):
         failures); a server dying mid-handshake is connectivity and
         falls through to the next server.  Either way the dead/rejected
         socket is closed, never leaked."""
+        # a supervised client re-reads the published topology on every
+        # reconnect: after a promotion the first server tried is the new
+        # leader (and the stamp below carries the new epoch), not
+        # whatever the static bootstrap order said at construction
+        self._refresh_topology()
         last_err: Optional[Exception] = None
         if self._sock is not None:
             try:
@@ -466,8 +556,9 @@ class KafkaWireBroker(ProducePartitionMixin):
         lock.  Returns (corr, resp bytes)."""
         self._corr += 1
         corr = self._corr
-        self._send_frame(_req_header(api_key, api_version, corr,
-                                     self.client_id) + body)
+        self._send_frame(_req_header(
+            api_key, api_version, corr,
+            tag_client_id(self.client_id, self._epoch)) + body)
         return corr, self._recv_frame()
 
     def _request(self, api_key: int, api_version: int, body: bytes) -> _Reader:
@@ -629,6 +720,11 @@ class KafkaWireBroker(ProducePartitionMixin):
         tops = r.array(lambda rd: (rd.string(), rd.array(part_resp)))
         for _, parts in tops:
             for p, err, base in parts:
+                if err == ERR_FENCED_LEADER_EPOCH:
+                    # stale party detected (this client OR a resurrected
+                    # old leader): nothing was appended — re-resolve and
+                    # hand redelivery back to the caller
+                    raise self._fenced(f"produce to {topic}:{p}")
                 if err != ERR_NONE:
                     raise RuntimeError(f"produce to {topic}:{p} failed: {err}")
                 last = max(last, base + len(by_part[p]) - 1)
@@ -787,6 +883,12 @@ class KafkaWireBroker(ProducePartitionMixin):
             return True
         if errs == {ERR_ILLEGAL_GENERATION}:
             return False  # fenced: nothing was written
+        if errs == {ERR_FENCED_LEADER_EPOCH}:
+            # leadership-epoch fence (distinct from the generation fence
+            # above: this is the whole SERVER relationship being stale,
+            # not one group member) — nothing written, caller re-commits
+            # from its own cursors against the re-resolved leader
+            raise self._fenced(f"offset commit {sorted(by_topic)}")
         bad = [(t, pid) for t, pid, err in results if err != ERR_NONE]
         raise RuntimeError(
             f"partial offset commit: partitions {bad} refused (outside this "
@@ -1013,7 +1115,9 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                     continue
                 r = _Reader(frame)
                 api_key, api_version, corr = r.i16(), r.i16(), r.i32()
-                r.string()  # client id
+                # the client id's trailing @e<N> tag carries the client's
+                # leadership epoch (absent for standard/legacy clients)
+                _cid, client_epoch = parse_client_epoch(r.string())
                 w = _Writer()
                 w.i32(corr)
                 lo_hi = _SUPPORTED.get(api_key)
@@ -1035,7 +1139,8 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                             lambda wr, kv: wr.i16(kv[0]).i16(kv[1][0])
                             .i16(kv[1][1]))
                 else:
-                    self._dispatch(broker, api_key, r, w)
+                    self._dispatch(broker, api_key, r, w,
+                                   client_epoch=client_epoch)
                 resp = bytes(w.buf)
                 self.request.sendall(struct.pack(">i", len(resp)) + resp)
         except (ConnectionError, OSError, struct.error):
@@ -1048,8 +1153,18 @@ class _KafkaConn(socketserver.BaseRequestHandler):
         return topic in broker.topics() and \
             0 <= pid < broker.topic(topic).partitions
 
+    def _epoch_mismatch(self, client_epoch: Optional[int]) -> bool:
+        """True when the fencing epochs disagree.  A stamped epoch below
+        the server's means the CLIENT slept through a failover; above it
+        means THIS SERVER is a resurrected old leader — either way the
+        log-mutating request must be refused, or the log splits.
+        Unstamped (legacy/standard-Kafka) clients pass unfenced."""
+        server_epoch = self.server.epoch     # type: ignore[attr-defined]
+        return client_epoch is not None and client_epoch != server_epoch
+
     # ------------------------------------------------------------ handlers
-    def _dispatch(self, broker: Broker, api_key: int, r: _Reader, w: _Writer):
+    def _dispatch(self, broker: Broker, api_key: int, r: _Reader, w: _Writer,
+                  client_epoch: Optional[int] = None):
         if api_key == METADATA:
             n = r.i32()
             names = [r.string() for _ in range(max(n, 0))] if n >= 0 else None
@@ -1079,6 +1194,18 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                 return (rd.i32(), rd.bytes_())
 
             tops = r.array(lambda rd: (rd.string(), rd.array(part)))
+            if self._epoch_mismatch(client_epoch):
+                # fence BEFORE touching the broker: a stale-epoch produce
+                # must append nothing anywhere
+                resp = [(tname,
+                         [(pid, ERR_FENCED_LEADER_EPOCH, -1)
+                          for pid, _ in parts])
+                        for tname, parts in tops]
+                w.array(resp, lambda wr, t: (wr.string(t[0]), wr.array(
+                    t[1], lambda pw, p: pw.i32(p[0]).i16(p[1]).i64(p[2])
+                    .i64(-1))))
+                w.i32(0)  # throttle
+                return
             resp = []
             for tname, parts in tops:
                 presp = []
@@ -1171,10 +1298,16 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                 return (rd.i32(), rd.i64(), rd.string())
 
             tops = r.array(lambda rd: (rd.string(), rd.array(part)))
+            if self._epoch_mismatch(client_epoch):
+                # stale-epoch commit: writing it would let a zombie
+                # fence-bypass the promoted log's offset streams
+                resp = [(tname, [(pid, ERR_FENCED_LEADER_EPOCH)
+                                 for pid, _, _ in parts])
+                        for tname, parts in tops]
             # generation == -1: simple consumer, no fencing (the classic
             # path).  A real generation routes through the group coordinator
             # so a member fenced by a rebalance cannot clobber offsets.
-            if generation >= 0:
+            elif generation >= 0:
                 coord = self.server.group_coordinator(group)
                 positions = [(t, pid, off)
                              for t, parts in tops for pid, off, _ in parts]
@@ -1329,16 +1462,28 @@ class KafkaWireServer(socketserver.ThreadingTCPServer):
 
     def __init__(self, broker: Broker, host: str = "127.0.0.1",
                  port: int = 0,
-                 credentials: Optional[Tuple[str, str]] = None):
+                 credentials: Optional[Tuple[str, str]] = None,
+                 epoch: int = 0):
         super().__init__((host, port), _KafkaConn)
         self.broker = broker
         self.credentials = credentials
         self.port = self.server_address[1]
+        #: leadership fencing epoch this server believes it serves at.
+        #: Promotion bumps it (FollowerReplica.promote); a restarted old
+        #: leader comes back with its stale value and fences itself
+        #: against epoch-stamped produce/commit traffic.
+        self.epoch = int(epoch)
         self._thread: Optional[threading.Thread] = None
         self._coordinators: dict = {}
         self._coord_lock = threading.Lock()
         self._live_conns: set = set()
         self._conn_lock = threading.Lock()
+
+    def set_epoch(self, epoch: int) -> None:
+        if epoch < self.epoch:
+            raise ValueError(f"epoch must be monotonic: have {self.epoch}, "
+                             f"got {epoch}")
+        self.epoch = int(epoch)
 
     def group_coordinator(self, group_id: str,
                           session_timeout_s: Optional[float] = None):
@@ -1356,8 +1501,11 @@ class KafkaWireServer(socketserver.ThreadingTCPServer):
             return coord
 
     def start(self) -> "KafkaWireServer":
-        self._thread = threading.Thread(target=self.serve_forever,
-                                        daemon=True)
+        from ..supervise.registry import register_thread
+
+        self._thread = register_thread(threading.Thread(
+            target=self.serve_forever, daemon=True,
+            name=f"iotml-kafka-wire-{self.port}"))
         self._thread.start()
         return self
 

@@ -42,6 +42,11 @@ from ..utils.backoff import ExpBackoff
 from .broker import Broker
 from .kafka_wire import KafkaWireBroker, KafkaWireServer
 
+#: wire-server epoch of an UNPROMOTED follower: no stamped epoch can
+#: equal it, so every fenced client is refused until promote() installs
+#: a real leadership epoch.
+FOLLOWER_EPOCH = -1
+
 
 class FollowerReplica:
     """Pull-replicate a leader's topics into a local wire-served log.
@@ -77,7 +82,13 @@ class FollowerReplica:
         #: it accumulates the whole stream forever.
         self._retention = retention_messages
         self.local = Broker()
-        self.server = KafkaWireServer(self.local, host=host, port=port)
+        # epoch -1 = "not a leader": an epoch-stamped produce/commit
+        # reaching this follower BEFORE promotion is fenced (the
+        # pre-promotion half of split-log protection — a failed-over
+        # client must not write to a log that replication still owns);
+        # unstamped legacy clients keep the fixture-open semantics
+        self.server = KafkaWireServer(self.local, host=host, port=port,
+                                      epoch=FOLLOWER_EPOCH)
         user, pw = sasl if sasl is not None else (None, None)
         self._leader = KafkaWireBroker(leader, client_id="iotml-replica",
                                        sasl_username=user, sasl_password=pw)
@@ -86,12 +97,21 @@ class FollowerReplica:
         self._interval = poll_interval_s
         self._commit_interval = commit_interval_s
         self._last_commit_sync = float("-inf")  # monotonic domain
+        self._last_lag_probe = float("-inf")    # lag-gauge cadence
         self._batch = fetch_batch
         self._stop = threading.Event()
+        # pause/resume barrier: pause() parks the background loop
+        # BETWEEN rounds (ack'd via _paused), so tests and promote() can
+        # drive sync_once()/kill the leader with no concurrent round in
+        # flight — the supervised barrier that replaced the old
+        # sleep-and-hope race (tests/test_replica.py)
+        self._pause = threading.Event()
+        self._paused = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._parts: Dict[str, int] = {}
         self.sync_errors: list = []
         self.rounds = 0
+        self.promoted = False
 
     # -------------------------------------------------------- lifecycle
     @property
@@ -99,10 +119,27 @@ class FollowerReplica:
         return self.server.port
 
     def start(self) -> "FollowerReplica":
+        from ..supervise.registry import register_thread
+
         self.server.start()
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = register_thread(threading.Thread(
+            target=self._run, daemon=True,
+            name=f"iotml-replica-sync-{self.port}"))
         self._thread.start()
         return self
+
+    def pause(self, timeout_s: float = 10.0) -> bool:
+        """Park the background sync loop at the round barrier; returns
+        once the in-flight round (if any) has finished.  No-op (True)
+        when the loop isn't running — synchronous drivers (the chaos
+        runner) are their own barrier."""
+        self._pause.set()
+        if self._thread is None or not self._thread.is_alive():
+            return True
+        return self._paused.wait(timeout=timeout_s)
+
+    def resume(self) -> None:
+        self._pause.clear()
 
     def stop(self) -> None:
         self._stop.set()
@@ -133,6 +170,14 @@ class FollowerReplica:
         # legal busy-poll; the reconnect path still must not busy-spin
         backoff = ExpBackoff(base_s=base, cap_s=max(2.0, base))
         while not self._stop.is_set():
+            if self._pause.is_set():
+                # barrier: acknowledge, then park between rounds until
+                # resumed or stopped (promote() stops while parked)
+                self._paused.set()
+                while self._pause.is_set() and not self._stop.is_set():
+                    time.sleep(0.005)
+                self._paused.clear()
+                continue
             try:
                 # cadence-throttled mirroring: sync_once(None) lets the
                 # round decide — mirror when it copied messages, or when
@@ -147,6 +192,18 @@ class FollowerReplica:
             backoff.reset()
             self.rounds += 1
             obs_metrics.replica_sync_rounds.inc()
+            # live loss-window gauge at the commit-mirror cadence:
+            # lag() costs one ListOffsets per partition, so the poll
+            # loop must not pay it per round (idle rounds at
+            # poll_interval_s rates), but dashboards need it without
+            # anyone calling lag() by hand
+            now = time.monotonic()
+            if now - self._last_lag_probe >= self._commit_interval:
+                self._last_lag_probe = now
+                try:
+                    self.lag()  # updates iotml_replica_lag_records
+                except (OSError, RuntimeError, KeyError):
+                    pass  # leader dying: the sync error path owns this
             if not moved:
                 time.sleep(self._interval)
 
@@ -222,14 +279,62 @@ class FollowerReplica:
 
     def lag(self) -> Dict[str, int]:
         """Per-topic messages the leader has that this follower doesn't —
-        the loss window if the leader died right now."""
+        the loss window if the leader died right now.  Also exported
+        live as `iotml_replica_lag_records{topic=...}` (the background
+        loop probes at the commit-mirror cadence)."""
         out: Dict[str, int] = {}
         for t, n in self._parts.items():
             out[t] = sum(
                 max(0, self._leader.end_offset(t, p)
                     - self.local.end_offset(t, p))
                 for p in range(n))
+            obs_metrics.replica_lag.set(out[t], topic=t)
         return out
+
+    # ---------------------------------------------------------- failover
+    def promote(self, epoch: int) -> str:
+        """Convert this follower into the SERVING LEADER at `epoch`.
+
+        The sequence is fencing-first: (1) barrier — park and stop the
+        sync loop so no round is mid-copy while the log changes owner;
+        (2) drop the leader client (the old leader is dead or about to
+        be fenced); (3) stamp the new epoch into this follower's wire
+        server, so epoch-stamped clients are accepted here and a
+        resurrected old leader (still at the previous epoch) rejects
+        them — split-log protection in both directions.  Returns the
+        serving address for the topology publish.
+
+        What stays scoped out (vs the reference's managed clusters):
+        re-admitting the old leader as a follower of the new one is an
+        operator action; this method only changes who serves."""
+        if self.promoted:
+            raise RuntimeError("already promoted")
+        self._stop.set()
+        # close the leader client BEFORE waiting on the loop: a sync
+        # round stalled in recv against a wedged (not-dead) leader
+        # would otherwise hold the join below open for the full socket
+        # timeout; closing makes the round fail fast into the stop check
+        try:
+            self._leader.close()
+        except OSError:
+            pass
+        self.resume()  # release a parked loop so it can observe _stop
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            if self._thread.is_alive():
+                # REFUSE to serve: a still-running round could append
+                # stale leader records after post-failover produces,
+                # interleaving old and new writes in the promoted log
+                raise RuntimeError(
+                    "sync loop did not stop within 10s; refusing to "
+                    "promote over a possibly mid-copy log")
+        self.server.set_epoch(epoch)
+        self.promoted = True
+        obs_metrics.failover_epoch.set(epoch)
+        for t in self._parts:
+            obs_metrics.replica_lag.set(0, topic=t)  # no leader: no lag
+        host = self.server.server_address[0]
+        return f"{host}:{self.port}"
 
     def caught_up(self, timeout_s: float = 10.0) -> bool:
         """Block until every mirrored topic's lag is zero (or timeout)."""

@@ -86,9 +86,11 @@ class KsqlServer(RestServer):
 
     # --------------------------------------------------------- lifecycle
     def start(self):
+        from ..supervise.registry import register_thread
+
         super().start()
-        self._pump_thread = threading.Thread(target=self._pump_loop,
-                                             daemon=True)
+        self._pump_thread = register_thread(threading.Thread(
+            target=self._pump_loop, daemon=True, name="iotml-ksql-pump"))
         self._pump_thread.start()
         return self
 

@@ -743,10 +743,12 @@ class SqlSelectTask(StreamTask):
                 out.append((m.key, encoded[enc_i], m.timestamp_ms))
                 enc_i += 1
             else:
-                # row-level fallback: the Python leg decides (drops
-                # poisoned rows, encodes nulls/escapes/big ints exactly)
+                # row-level fallback: the Python leg decides (poisoned
+                # rows dead-letter; nulls/escapes/big ints encode exactly)
                 rec = _decode_record(self.src_meta, self.src_codec, m)
                 if rec is None:
+                    self.dead_letter(m, "undecodable "
+                                     f"{self.src_meta.value_format} record")
                     continue
                 row = self._project(rec)
                 if row is None:
@@ -804,7 +806,11 @@ class SqlSelectTask(StreamTask):
                              self._native_src, messages)
         for m, rec in zip(messages, recs):
             if rec is None:
-                continue  # poisoned message: drop, don't halt (KSQL DLQ-ish)
+                # poisoned message: dead-letter, don't halt (the real
+                # KSQL DLQ behavior this comment used to approximate)
+                self.dead_letter(m, "undecodable "
+                                 f"{self.src_meta.value_format} record")
+                continue
             if self.stmt.where is not None:
                 try:
                     if not self.stmt.where(rec):
@@ -1009,6 +1015,8 @@ class SqlAggTask(StreamTask):
                                  self._native_src, messages)
             for m, rec in zip(messages, recs):
                 if rec is None:
+                    self.dead_letter(m, "undecodable "
+                                     f"{self.src_meta.value_format} record")
                     continue
                 if self.stmt.where is not None:
                     try:

@@ -26,6 +26,7 @@ from ..ops.avro import AvroCodec
 from ..ops.framing import frame
 from ..stream.broker import Broker, Message
 from ..stream.consumer import StreamConsumer
+from . import dlq as _dlq
 
 
 class StreamTask:
@@ -50,6 +51,13 @@ class StreamTask:
     def process(self, messages: List[Message]) -> List[Tuple]:
         """Return [(key, value, timestamp_ms)] outputs."""
         raise NotImplementedError
+
+    def dead_letter(self, message: Message, error) -> None:
+        """Route one poisoned input to `<src>_DLQ` instead of silently
+        dropping it (counted under iotml_dlq_total{source=...}); a
+        failing DLQ path degrades back to the plain drop."""
+        _dlq.route(self.broker, message, str(error),
+                   task=type(self).__name__)
 
     def _forward_traces(self, msgs, outs):
         """Re-attach trace headers to a chunk's outputs and mark the
@@ -128,22 +136,33 @@ class JsonToAvro(StreamTask):
     def process(self, messages):
         out = []
         for m in messages:
-            obj = json.loads(m.value)
-            rec = {}
-            for k, v in obj.items():
-                name = self._alias.get(k.lower())
-                if name is None:
-                    continue
-                f = KSQL_CAR_SCHEMA.field(name)
-                if v is None:
-                    rec[name] = None
-                elif f.avro_type in ("int", "long"):
-                    rec[name] = int(v)
-                elif f.avro_type == "string":
-                    rec[name] = str(v)
-                else:
-                    rec[name] = float(v)
-            out.append((m.key, frame(self.codec.encode(rec)), m.timestamp_ms))
+            try:
+                obj = json.loads(m.value)
+                if not isinstance(obj, dict):
+                    raise ValueError(f"expected JSON object, got "
+                                     f"{type(obj).__name__}")
+                rec = {}
+                for k, v in obj.items():
+                    name = self._alias.get(k.lower())
+                    if name is None:
+                        continue
+                    f = KSQL_CAR_SCHEMA.field(name)
+                    if v is None:
+                        rec[name] = None
+                    elif f.avro_type in ("int", "long"):
+                        rec[name] = int(v)
+                    elif f.avro_type == "string":
+                        rec[name] = str(v)
+                    else:
+                        rec[name] = float(v)
+                val = frame(self.codec.encode(rec))
+            except (ValueError, TypeError, KeyError) as e:
+                # poisoned sensor JSON used to HALT the whole chunk
+                # (json.loads raised out of process_available); now it
+                # dead-letters and the stream keeps flowing
+                self.dead_letter(m, e)
+                continue
+            out.append((m.key, val, m.timestamp_ms))
         return out
 
 
@@ -220,12 +239,16 @@ class DelimitedToAvro(StreamTask):
         for m in messages:
             try:
                 parts = m.value.decode().split(",")
-            except UnicodeDecodeError:
-                continue  # poisoned message: drop, don't halt the pipeline
-            if len(parts) != 2 + len(CAR_SCHEMA.fields):
-                continue  # malformed line: KSQL would null-fill; we drop
+            except UnicodeDecodeError as e:
+                self.dead_letter(m, e)  # poisoned bytes: DLQ, don't halt
+                continue
             if parts[0] == "time":
-                continue  # replayed header
+                continue  # replayed header: expected shape, not poison
+            if len(parts) != 2 + len(CAR_SCHEMA.fields):
+                self.dead_letter(
+                    m, f"expected {2 + len(CAR_SCHEMA.fields)} columns, "
+                       f"got {len(parts)}")  # KSQL would null-fill; we DLQ
+                continue
             rec = {}
             try:
                 for f_prod, f_ksql, raw in zip(CAR_SCHEMA.fields,
@@ -233,8 +256,9 @@ class DelimitedToAvro(StreamTask):
                                                parts[2:]):
                     rec[f_ksql.name] = int(float(raw)) \
                         if f_ksql.avro_type in ("int", "long") else float(raw)
-            except ValueError:
-                continue  # non-numeric sensor value: drop the line
+            except ValueError as e:
+                self.dead_letter(m, f"non-numeric sensor value: {e}")
+                continue
             rec["FAILURE_OCCURRED"] = self.label
             key = parts[1].encode()
             out.append((key, frame(self.codec.encode(rec)), m.timestamp_ms))

@@ -1,0 +1,32 @@
+"""iotml.supervise — live self-healing runtime.
+
+Supervised component lifecycles (``supervisor``), published leadership
+topology with fencing epochs (``topology``), the process-wide thread /
+supervisor registry (``registry``), and live chaos drills with recovery
+SLOs (``drill``, ``python -m iotml.supervise drill``).
+
+This ``__init__`` is deliberately lazy: ``registry`` is imported by
+low-level modules (obs.metrics, every thread-spawning module) and must
+stay dependency-free, so the heavier supervisor/drill machinery loads
+only on attribute access.
+"""
+
+from __future__ import annotations
+
+_LAZY = {
+    "Supervisor": "supervisor", "SupervisedUnit": "supervisor",
+    "Topology": "topology",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module 'iotml.supervise' has no "
+                             f"attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod}", __name__), name)
+
+
+__all__ = sorted(_LAZY)

@@ -1,0 +1,102 @@
+"""Process-wide registries for supervised threads and supervisors.
+
+Every background thread the framework starts outside ``iotml/supervise/``
+must be *daemon*, *named*, and registered here (lint rule R8 closes this
+by construction) — the registry is what turns "fire-and-forget threads
+scattered over twelve modules" into an enumerable runtime surface the
+supervisor and ``/healthz`` can reason about.  Registration is
+deliberately cheap and dependency-free: one weak reference per thread,
+no locks on the thread's own path, importable from anywhere without
+cycles (this module imports nothing from ``iotml``).
+
+Supervisors (``supervise.supervisor.Supervisor``) register themselves on
+start so the metrics server's ``/healthz`` can report unit states
+without the obs layer importing the supervise package eagerly.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Dict, List, Optional
+
+_lock = threading.Lock()
+#: weak refs so a registered thread (or its owner) can be garbage
+#: collected normally — the registry observes lifecycles, never extends
+#: them.
+_threads: "List[weakref.ref]" = []
+_supervisors: "List[weakref.ref]" = []
+
+
+def register_thread(thread: threading.Thread,
+                    name: Optional[str] = None) -> threading.Thread:
+    """Register a background thread; returns it (wrap-the-constructor
+    idiom: ``register_thread(threading.Thread(...))``).
+
+    Enforces at runtime what lint R8 enforces at review time: the
+    thread must be a daemon (a non-daemon background thread blocks
+    process exit — the supervisor owns orderly shutdown, not atexit
+    hangs) and must carry a meaningful name (``Thread-7`` in a stack
+    dump of a wedged process is useless)."""
+    if name is not None:
+        thread.name = name
+    if not thread.daemon:
+        raise ValueError(
+            f"background thread {thread.name!r} must be daemon=True: "
+            f"orderly shutdown belongs to the supervisor, not to a "
+            f"non-daemon thread pinning process exit")
+    if thread.name.startswith("Thread-"):
+        raise ValueError(
+            "background thread needs an explicit name (got default "
+            f"{thread.name!r}): unnamed threads make wedged-process "
+            "stack dumps unreadable")
+    with _lock:
+        # opportunistic compaction BEFORE appending, keeping unstarted
+        # threads (ident is None): registration happens at construction
+        # time (wrap-the-constructor idiom), so an is_alive()-only
+        # filter would evict every just-registered thread once the list
+        # is long — silently un-enumerating exactly what R8 registers
+        if len(_threads) > 64:
+            _threads[:] = [r for r in _threads
+                           if (t := r()) is not None
+                           and (t.ident is None or t.is_alive())]
+        _threads.append(weakref.ref(thread))
+    return thread
+
+
+def threads() -> List[threading.Thread]:
+    """Live registered threads (snapshot)."""
+    with _lock:
+        refs = list(_threads)
+    return [t for r in refs if (t := r()) is not None and t.is_alive()]
+
+
+def register_supervisor(sup) -> None:
+    with _lock:
+        _supervisors[:] = [r for r in _supervisors if r() is not None]
+        _supervisors.append(weakref.ref(sup))
+
+
+def unregister_supervisor(sup) -> None:
+    with _lock:
+        _supervisors[:] = [r for r in _supervisors
+                           if r() is not None and r() is not sup]
+
+
+def supervisors() -> list:
+    with _lock:
+        refs = list(_supervisors)
+    return [s for r in refs if (s := r()) is not None]
+
+
+def snapshot() -> Dict[str, dict]:
+    """Unit-state snapshot across every live supervisor — the
+    ``/healthz`` "supervisor" section (empty dict when nothing is
+    supervised, so unsupervised processes pay one list read)."""
+    out: Dict[str, dict] = {}
+    for sup in supervisors():
+        try:
+            out.update(sup.snapshot())
+        except Exception:  # noqa: BLE001 - a dying supervisor must not
+            continue       # take the health endpoint down with it
+    return out

@@ -1,0 +1,69 @@
+"""Published stream topology: who leads, at which fencing epoch.
+
+The reference delegates leadership to ZooKeeper-backed Kafka controllers
+(SURVEY §L0); the rebuild's equivalent is this small shared object: the
+supervisor *publishes* ``(leader address, epoch)`` on every promotion,
+and ``KafkaWireBroker`` clients built with ``topology=...`` *resolve*
+it on every (re)connect instead of walking a static bootstrap order.
+The epoch is the fencing token: monotonically increased at each
+promotion, stamped by clients into the wire protocol, and checked by
+servers on the log-mutating APIs (produce / offset-commit) — a
+resurrected old leader, or a client that slept through a failover,
+answers FENCED instead of silently splitting the log.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+
+class Topology:
+    """Thread-safe (leader, epoch) cell with a fallback server list.
+
+    ``resolve()`` returns ``(servers, epoch)`` where ``servers`` is the
+    active leader first, then the remaining known servers (a client that
+    cannot reach the published leader still has somewhere to go while a
+    promotion is in flight)."""
+
+    def __init__(self, leader: str, epoch: int = 0,
+                 fallback: Optional[List[str]] = None):
+        self._lock = threading.Lock()
+        self._leader = leader
+        self._epoch = int(epoch)
+        self._fallback = [s for s in (fallback or []) if s != leader]
+        #: bumped on every publish so pollers can cheaply detect change
+        self.generation = 0
+
+    # ------------------------------------------------------------ write
+    def publish(self, leader: str, epoch: int) -> None:
+        """Install a new leadership term.  Epochs only move forward —
+        a belated publish from a slow failover path must not roll the
+        fleet back onto a fenced leader."""
+        with self._lock:
+            if epoch < self._epoch:
+                raise ValueError(
+                    f"epoch must be monotonic: have {self._epoch}, "
+                    f"got {epoch}")
+            old = self._leader
+            self._leader = leader
+            self._epoch = int(epoch)
+            if old != leader and old not in self._fallback:
+                self._fallback.append(old)
+            self._fallback = [s for s in self._fallback if s != leader]
+            self.generation += 1
+
+    # ------------------------------------------------------------- read
+    def resolve(self) -> Tuple[List[str], int]:
+        with self._lock:
+            return [self._leader] + list(self._fallback), self._epoch
+
+    @property
+    def leader(self) -> str:
+        with self._lock:
+            return self._leader
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch

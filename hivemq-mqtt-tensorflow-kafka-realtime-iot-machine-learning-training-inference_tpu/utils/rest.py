@@ -120,8 +120,11 @@ class RestServer:
         return f"http://{self.host}:{self.port}"
 
     def start(self):
-        self._thread = threading.Thread(target=self.httpd.serve_forever,
-                                        daemon=True)
+        from ..supervise.registry import register_thread
+
+        self._thread = register_thread(threading.Thread(
+            target=self.httpd.serve_forever, daemon=True,
+            name=f"iotml-rest-{self.port}"))
         self._thread.start()
         return self
 

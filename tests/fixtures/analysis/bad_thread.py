@@ -1,0 +1,43 @@
+"""R8 fixture: unsupervised thread construction, every way to get it
+wrong — an anonymous non-daemon fire-and-forget thread (3 findings on
+one call), a named daemon thread that skips the registry (1 finding),
+the module-alias evasion (1 finding), and the compliant form plus a
+justified suppression (0 findings)."""
+
+import threading
+import threading as t
+
+from iotml.supervise.registry import register_thread
+
+
+def target():
+    pass
+
+
+def fire_and_forget():
+    # all three violations at once: not daemon, unnamed, unregistered
+    t = threading.Thread(target=target)
+    t.start()
+
+
+def named_but_unregistered():
+    t = threading.Thread(target=target, daemon=True, name="worker")
+    t.start()
+
+
+def aliased_evasion():
+    # aliasing the module must not dodge the rule
+    t.Thread(target=target, daemon=True, name="sneaky").start()
+
+
+def compliant():
+    t = register_thread(threading.Thread(target=target, daemon=True,
+                                         name="iotml-good-worker"))
+    t.start()
+
+
+def justified():
+    # lint-ok: R8 short-lived join()ed helper entirely owned by this call
+    t = threading.Thread(target=target, daemon=True, name="scratch")
+    t.start()
+    t.join()

@@ -112,23 +112,23 @@ def test_consumer_survives_leader_death_mid_drain():
                 seen.append((m.partition, m.offset, m.value))
         assert len(seen) >= total // 2
         consumer.commit()
-        # Wait until the BACKGROUND replication loop has mirrored the
-        # commit (poll-until-deadline on the actual catch-up condition).
-        # Driving rep.sync_once() from this thread — the pre-deflake
-        # version — races the loop's own concurrent round, and a blind
-        # sleep just moves the race; the condition is what we wait on.
+        # Supervised barrier (replaces BOTH earlier deflake attempts):
+        # pause() parks the background replication loop BETWEEN rounds,
+        # so the explicit sync_once() below races nothing and the kill
+        # cannot land mid-round.  The pre-barrier versions — driving
+        # sync_once() concurrently with the loop, then poll-until-
+        # deadline on the mirrored-commit condition — both left a
+        # window where the loop's own round interleaved with the kill
+        # and occasionally flaked; the barrier removes the window
+        # instead of timing around it.
+        assert rep.pause()
+        rep.sync_once()  # deterministic mirror: nothing else is syncing
         want = {p: off for _, p, off in consumer.positions()}
-
-        def commit_mirrored():
-            return all(rep.local.committed("g2", "T", p) == want[p]
-                       for p in range(2))
-
-        deadline = time.monotonic() + 15
-        while not commit_mirrored() and time.monotonic() < deadline:
-            time.sleep(0.02)
-        assert commit_mirrored()
-        # the leader dies abruptly
+        assert all(rep.local.committed("g2", "T", p) == want[p]
+                   for p in range(2))
+        # the leader dies abruptly, with replication quiescent
         srv.kill()
+        rep.resume()
         deadline = time.monotonic() + 20
         while len(seen) < total and time.monotonic() < deadline:
             try:
@@ -148,13 +148,14 @@ def test_consumer_survives_leader_death_mid_drain():
             offs = sorted(o for pp, o, _ in seen if pp == p)
             assert offs == list(range(len(offs)))
         # a crash-restart resumes from the replicated committed offsets
-        # against the follower alone
+        # against the follower alone — EXACTLY the offsets committed
+        # before the kill (the barrier made the mirror deterministic,
+        # so this is equality, not the old tautological >= 0 check)
         c2 = StreamConsumer.from_committed(
             KafkaWireBroker(f"127.0.0.1:{rep.port}"), "T", range(2),
             group="g2")
         positions = {p: off for _, p, off in c2.positions()}
-        assert sum(positions.values()) == total // 2 or \
-            all(v >= 0 for v in positions.values())
+        assert positions == want
     finally:
         rep.stop()
         try:
