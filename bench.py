@@ -237,6 +237,65 @@ def bench_serve():
 
 
 # ---------------------------------------------------------------- ksql
+def bench_store_log():
+    """Durable segmented-log micro-bench (iotml.store): append MB/s and
+    replay MB/s through the broker-shaped path (CRC32C framing, sparse
+    index maintenance, segment rolls), plus crash-recovery wall time
+    over the same data with a torn tail — the costs the --durable
+    platform pays over the in-memory broker."""
+    import shutil
+    import tempfile
+
+    from iotml.store import SegmentedLog, StorePolicy
+
+    n_records = int(os.environ.get("IOTML_BENCH_STORE_RECORDS", "20000"))
+    value = b"x" * 256  # ~ a framed Avro sensor row
+    mb = n_records * len(value) / 1e6
+
+    def one_pass():
+        d = tempfile.mkdtemp(prefix="iotml_bench_store_")
+        try:
+            log = SegmentedLog(d, StorePolicy(
+                fsync="interval", fsync_interval_s=0.05,
+                segment_bytes=4 * 1024 * 1024))
+            t0 = time.perf_counter()
+            for i in range(n_records):
+                log.append(None, value, i, sync=False)
+            log.sync_batch()
+            append_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            off, seen = 0, 0
+            while seen < n_records:
+                chunk = log.read_from(off, 4096)
+                if not chunk:
+                    break
+                seen += len(chunk)
+                off = chunk[-1][0] + 1
+            replay_s = time.perf_counter() - t0
+            log.simulate_torn_write()
+            log.close()
+            t0 = time.perf_counter()
+            recovered = SegmentedLog(d, StorePolicy(segment_bytes=4 * 1024 * 1024))
+            recovery_s = time.perf_counter() - t0
+            assert recovered.end_offset == n_records
+            assert recovered.recovered_truncated_bytes > 0
+            recovered.close()
+            return append_s, replay_s, recovery_s
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
+    one_pass()  # warm the page cache / allocator
+    walls = [one_pass() for _ in range(max(3, PASSES // 2))]
+    ap50, _ = _percentiles([w[0] for w in walls])
+    rp50, _ = _percentiles([w[1] for w in walls])
+    cp50, _ = _percentiles([w[2] for w in walls])
+    return dict(value=mb / ap50,
+                replay_mb_per_sec=round(mb / rp50, 2),
+                recovery_ms=round(cp50 * 1e3, 2),
+                n_records=n_records, payload_bytes=len(value),
+                n_passes=len(walls))
+
+
 def bench_ksql_pipeline():
     """The reference's four-object KSQL pipeline (JSON stream → AVRO CSAS →
     rekey CSAS → 5-min CTAS) pumped over a seeded sensor-data topic — the
@@ -1942,6 +2001,10 @@ def main():
         ("serve_rows_per_sec", "rows/s", TRAIN_BASELINE_RPS),
         # the preprocessing stage must keep pace with fleet ingest
         ("ksql_pipeline_records_per_sec", "records/s", FLEET_BASELINE_MPS),
+        # durable-store costs (iotml.store): append/replay MB/s + crash-
+        # recovery wall time; no reference twin (its retention lived in
+        # managed Kafka), so vs_baseline deliberately 0
+        ("store_append_mb_per_sec", "MB/s", None),
         # the whole platform live at once: fleet → MQTT → bridge → KSQL
         # in the main process, training in a TPU child process, scoring in
         # a CPU child process (the deploy manifests' pod separation), the
@@ -1980,6 +2043,7 @@ def main():
         run("flash_attention_fwd_bwd_tokens_per_sec", bench_long_context)
         run("serve_rows_per_sec", bench_serve)
         run("ksql_pipeline_records_per_sec", bench_ksql_pipeline)
+        run("store_append_mb_per_sec", bench_store_log)
         run("fleet_ingest_msgs_per_sec", bench_fleet_ingest)
         try:
             run("fleet_ingest_native_msgs_per_sec",

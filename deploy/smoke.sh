@@ -12,8 +12,10 @@ cd "$(dirname "$0")/.."
 echo "== 0/5 concurrency & protocol-invariant lint (iotml.analysis)"
 python -m iotml.analysis lint
 
-echo "== 1/5 chaos drill: seeded failure scenario, invariant-checked"
+echo "== 1/5 chaos drills: seeded failure scenarios, invariant-checked"
 JAX_PLATFORMS=cpu python -m iotml.chaos run --scenario mqtt-flap \
+  --seed 7 --records 500
+JAX_PLATFORMS=cpu python -m iotml.chaos run --scenario broker-crash-recover \
   --seed 7 --records 500
 
 echo "== 2/5 supervised restart: live scorer-crash drill (the scorer"

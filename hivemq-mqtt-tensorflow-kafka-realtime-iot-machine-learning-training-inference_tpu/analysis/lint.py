@@ -45,6 +45,14 @@ Rules (see ARCHITECTURE.md §analysis for the full table):
       self-healing runtime can only supervise what it can enumerate,
       and a fire-and-forget anonymous thread is exactly the erosion
       the supervise subsystem exists to stop.
+  R9  durable-store write discipline: outside ``iotml/store/``, no
+      ``os.fsync`` at all, and no ``open()``/``os.open()`` whose
+      arguments name a store path (identifiers like ``store_dir`` /
+      ``store_path`` / segment paths) — every byte written under a
+      store directory goes through ``store.segment.SegmentWriter``, so
+      the durability promises (fsync accounting, torn-tail recovery
+      semantics, atomic-rename publication) are made in exactly one
+      place.
 
 Suppression: append ``# lint-ok: RN <reason>`` to the flagged line (for
 R4, to the ``with`` line holding the lock).  A suppression WITHOUT a
@@ -124,7 +132,17 @@ RULES: Dict[str, str] = {
     "R8": "threading.Thread outside iotml/supervise/ must be daemon, "
           "named, and wrapped in register_thread(...) (supervisor "
           "registry)",
+    "R9": "naked store-dir write (os.fsync, or open()/os.open() on a "
+          "store path) outside iotml/store/: all store-dir bytes go "
+          "through SegmentWriter",
 }
+
+# R9: identifier substrings that mark an open() argument as a store
+# path.  Conservative by construction (names, not data flow) — matching
+# errs toward flagging, and a false positive justifies itself with a
+# suppression, the lint's usual direction.
+_STORE_PATH_NAME_RE = re.compile(
+    r"store_dir|store_path|storedir|segment_path|\.slog\b", re.IGNORECASE)
 
 _SUPPRESS_RE = re.compile(r"#\s*lint-ok:\s*(R\d)\b[ \t]*(.*)")
 _RETRY_OK_RE = re.compile(r"#\s*retry-ok:[ \t]*(.*)")
@@ -378,6 +396,9 @@ class _FileLinter(ast.NodeVisitor):
         # R8 scoping: the supervise package OWNS thread lifecycles (the
         # registry itself, the monitor) and is exempt from wrapping
         self.in_supervise = "supervise" in parts
+        # R9 scoping: the store package OWNS the bytes (SegmentWriter,
+        # atomic_write) and is the one place fsync may appear
+        self.in_store = "store" in parts
         #: Thread(...) call nodes already seen as a register_thread(...)
         #: argument — outer calls visit before inner ones, so by the
         #: time visit_Call reaches the Thread node it is marked
@@ -569,6 +590,27 @@ class _FileLinter(ast.NodeVisitor):
                            + ", ".join(missing)
                            + " — the self-healing runtime can only "
                              "supervise what it can enumerate")
+
+        # R9 — durable-store write discipline: fsync is SegmentWriter's
+        # alone, and an open() on a store path bypasses the frame/CRC/
+        # fsync contract recovery depends on
+        if not self.in_store:
+            if name == "fsync":
+                self._emit("R9", node,
+                           "os.fsync outside iotml/store/: durability "
+                           "promises are made in one place — route the "
+                           "write through store.segment.SegmentWriter")
+            if name == "open":
+                arg_src = " ".join(
+                    ast.unparse(a) for a in list(node.args)
+                    + [kw.value for kw in node.keywords])
+                if _STORE_PATH_NAME_RE.search(arg_src):
+                    self._emit("R9", node,
+                               "naked open() on a store path outside "
+                               "iotml/store/: all bytes under a store "
+                               "dir go through SegmentWriter (framing, "
+                               "CRC, fsync accounting, recovery "
+                               "semantics)")
 
         # R5 — engine-owned topic produced outside streamproc/
         if not self.in_streamproc and name in ("produce", "produce_many",

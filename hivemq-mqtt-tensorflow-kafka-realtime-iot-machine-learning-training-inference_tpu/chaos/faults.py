@@ -65,6 +65,10 @@ RUNNER_POINTS: Dict[str, str] = {
     "runner.kill_leader": "abrupt leader wire-server death (accept loop "
                           "+ every live connection) -> client failover "
                           "promotes the follower",
+    "runner.crash_broker": "durable broker process death MID-WRITE (torn "
+                           "frame left on the active segment) -> remount "
+                           "from the store dir, recovery truncates the "
+                           "tail, consumers resume from persisted commits",
 }
 
 #: actions each site actually interprets — validated at engine build so
@@ -80,6 +84,7 @@ POINT_ACTIONS: Dict[str, frozenset] = {
     "scorer.poll": frozenset({"error", "delay"}),
     "trainer.poll": frozenset({"error", "delay"}),
     "runner.kill_leader": frozenset({"kill_leader"}),
+    "runner.crash_broker": frozenset({"crash_broker"}),
 }
 
 _EXCEPTIONS = {"ConnectionError": ConnectionError, "OSError": OSError,

@@ -158,9 +158,11 @@ def _check_final_commit(broker, topic: str, parts: int) -> Invariant:
 
 
 def _record_commits(broker, log: List[tuple], tag: str) -> None:
-    """Shadow a Broker instance's commit with a history-recording
-    wrapper — the monotonicity invariant needs the sequence, and the
-    broker (correctly) stores only the latest value."""
+    """Shadow a Broker instance's commit paths with history-recording
+    wrappers — the monotonicity invariant needs the sequence, and the
+    broker (correctly) stores only the latest value.  Both entry points
+    are wrapped: StreamConsumer.commit prefers the batched commit_many
+    when the broker offers it."""
     orig = broker.commit
 
     def commit(group, topic, partition, next_offset):
@@ -168,6 +170,14 @@ def _record_commits(broker, log: List[tuple], tag: str) -> None:
         return orig(group, topic, partition, next_offset)
 
     broker.commit = commit
+    orig_many = getattr(broker, "commit_many", None)
+    if orig_many is not None:
+        def commit_many(group, topic, entries):
+            for p, off in entries:
+                log.append((tag, group, topic, p, off))
+            return orig_many(group, topic, entries)
+
+        broker.commit_many = commit_many
 
 
 # --------------------------------------------------------------- runner
@@ -187,7 +197,11 @@ class ChaosRunner:
         from ..obs import tracing
 
         eng = faults.arm(faults.ChaosEngine(self.schedule.events))
-        trace_inproc = self.schedule.topology == "inproc"
+        # span-log invariants need trace headers end to end: the inproc
+        # AND store topologies carry them (the durable log round-trips
+        # headers in their transport byte form); only the wire topology
+        # loses them at the TCP boundary by design
+        trace_inproc = self.schedule.topology in ("inproc", "store")
         prev = (tracing.ENABLED, tracing._SAMPLE, tracing._PATH)
         span_path = self.span_path
         if trace_inproc:
@@ -202,6 +216,8 @@ class ChaosRunner:
         try:
             if self.schedule.topology == "wire":
                 report = self._run_wire(eng)
+            elif self.schedule.topology == "store":
+                report = self._run_store(eng, span_path)
             else:
                 report = self._run_inproc(eng, span_path)
         finally:
@@ -294,6 +310,197 @@ class ChaosRunner:
             scenario=self.schedule.name, seed=self.schedule.seed,
             records=self.schedule.records, topology="inproc",
             published=published, scored=scorer.scored, rewinds=rewinds,
+            dropped_accounted=eng.dropped_count,
+            injected=dict(sorted(eng.injected.items())),
+            invariants=invariants, span_path=span_path)
+
+    # ------------------------------------------------------------- store
+    def _run_store(self, eng: faults.ChaosEngine,
+                   span_path: str) -> ChaosReport:
+        """The durable-broker crash drill: the same inproc pipeline over
+        a Broker mounted on the segmented store (``fsync=always``), the
+        process "killed" mid-write at the scheduled record count (the
+        broker object is abandoned un-flushed with a torn frame left on
+        the active segment — the exact on-disk artifact of a real kill),
+        then REMOUNTED: recovery truncates the torn tail, every record
+        acked before the kill must re-serve byte-identically, and a
+        restarted pipeline (fresh task/consumer/scorer, cursors
+        ``from_committed``) finishes the stream with the PR 3 delivery
+        invariants intact."""
+        import shutil
+        import tempfile
+
+        store_dir = tempfile.mkdtemp(prefix="iotml_chaos_store_")
+        try:
+            return self._run_store_in(eng, span_path, store_dir)
+        finally:
+            # CI/smoke run this scenario repeatedly; a leaked segment
+            # dir per run is unbounded /tmp growth
+            shutil.rmtree(store_dir, ignore_errors=True)
+
+    def _run_store_in(self, eng: faults.ChaosEngine, span_path: str,
+                      store_dir: str) -> ChaosReport:
+        from ..gen.simulator import FleetGenerator, FleetScenario
+        from ..mqtt.bridge import KafkaBridge
+        from ..mqtt.broker import MqttBroker
+        from ..obs import tracing
+        from ..store import StorePolicy
+        from ..stream.broker import Broker
+        from ..stream.consumer import StreamConsumer
+        from ..streamproc.tasks import JsonToAvro
+
+        # small segments so the crash lands on a log with real rolls
+        # behind it; fsync=always is the acked=durable contract the
+        # zero-loss invariant rides on
+        policy = dict(fsync="always", segment_bytes=64 * 1024)
+        commit_log: List[tuple] = []
+        rewinds = 0
+        published = 0
+        gen = FleetGenerator(FleetScenario(num_cars=CARS_PER_TICK,
+                                           seed=self.schedule.seed))
+        ticks = max(1, -(-self.schedule.records // CARS_PER_TICK))
+
+        def build_pipeline(broker):
+            """One process incarnation: ingress + transform + scorer,
+            every cursor resuming from the broker's committed offsets."""
+            mqtt = MqttBroker()
+            KafkaBridge(mqtt, broker, partitions=2)
+            task = JsonToAvro(broker, src="sensor-data", dst=IN_TOPIC,
+                              partitions=2)
+            parts = broker.topic(IN_TOPIC).partitions
+            consumer = StreamConsumer.from_committed(
+                broker, IN_TOPIC, range(parts), group=GROUP)
+            scorer = self._make_scorer(broker, consumer)
+            return mqtt, task, consumer, scorer, parts
+
+        broker = Broker(store_dir=store_dir,
+                        store_policy=StorePolicy(**policy))
+        _record_commits(broker, commit_log, "stream")
+        mqtt, task, consumer, scorer, parts = build_pipeline(broker)
+
+        def drive_once():
+            nonlocal rewinds
+            try:
+                task.process_available()
+            except ConnectionError:
+                task.consumer.rewind_to_committed()
+                rewinds += 1
+            try:
+                return scorer.score_available()
+            except ConnectionError:
+                consumer.rewind_to_committed()
+                rewinds += 1
+                return -1
+
+        crash = {"done": False, "torn": 0, "acked": {}, "committed": {},
+                 "recovered_end": {}, "truncated": 0, "resumed_at": {},
+                 "scored_pre": 0, "replayed_match": False}
+        scored_total = 0
+
+        def crash_and_recover():
+            nonlocal broker, mqtt, task, consumer, scorer, parts
+            nonlocal scored_total
+            # --- the kill: snapshot what was ACKED, leave a torn frame
+            for t in (IN_TOPIC, PRED_TOPIC, "sensor-data"):
+                for p in range(broker.topic(t).partitions):
+                    crash["acked"][(t, p)] = broker.end_offset(t, p)
+            for p in range(parts):
+                crash["committed"][p] = broker.committed(GROUP, IN_TOPIC, p)
+            pre_crash = broker.fetch(IN_TOPIC, 0,
+                                     broker.begin_offset(IN_TOPIC, 0), 10**6)
+            crash["torn"] = broker.store.log_for(
+                IN_TOPIC, 0).simulate_torn_write()
+            crash["scored_pre"] = scorer.scored
+            scored_total += scorer.scored
+            # the old incarnation is DEAD: nothing flushes, nothing
+            # closes — fsync=always already made every ack durable
+            broker = Broker(store_dir=store_dir,
+                            store_policy=StorePolicy(**policy))
+            _record_commits(broker, commit_log, "stream")
+            crash["truncated"] = broker.store.recovered_truncated_bytes()
+            for (t, p), end in crash["acked"].items():
+                crash["recovered_end"][(t, p)] = broker.end_offset(t, p)
+            # byte-identical replay: the full pre-crash read repeats
+            post_crash = broker.fetch(IN_TOPIC, 0,
+                                      broker.begin_offset(IN_TOPIC, 0),
+                                      10**6)
+            crash["replayed_match"] = \
+                [(m.offset, m.key, m.value, m.timestamp_ms)
+                 for m in pre_crash] == \
+                [(m.offset, m.key, m.value, m.timestamp_ms)
+                 for m in post_crash]
+            mqtt, task, consumer, scorer, parts = build_pipeline(broker)
+            crash["resumed_at"] = {p: off for _t, p, off
+                                   in consumer.positions()}
+            crash["done"] = True
+
+        def run_due_events():
+            for ev in eng.due_runner_events(published):
+                if ev.action == "crash_broker" and not crash["done"]:
+                    crash_and_recover()
+                    eng.note_runner_fired(ev)
+
+        for _ in range(ticks):
+            run_due_events()
+            published += self._publish_tick_mqtt(gen, mqtt)
+            drive_once()
+            tracing.flush()
+        run_due_events()
+        for _ in range(64):
+            n = drive_once()
+            if n == 0 and consumer.at_end() and task.consumer.at_end():
+                break
+        tracing.flush()
+        scored_total += scorer.scored
+        broker.close()
+
+        lost = {k: (acked, crash["recovered_end"].get(k))
+                for k, acked in crash["acked"].items()
+                if crash["recovered_end"].get(k) != acked}
+        resumed_bad = {p: (crash["resumed_at"].get(p), committed)
+                       for p, committed in crash["committed"].items()
+                       if committed is not None
+                       and crash["resumed_at"].get(p) != committed}
+        invariants = [
+            _check_spans_accounted(span_path, eng.dropped_traces),
+            _check_counts(published, scored_total, eng.dropped_count),
+            _check_commits_monotonic(commit_log),
+            _check_predictions(broker, scored_total),
+            _check_final_commit(broker, IN_TOPIC, parts),
+            Invariant(
+                "acked_records_survive_crash",
+                crash["done"] and not lost,
+                ("broker never crashed" if not crash["done"] else
+                 f"every pre-kill acked offset re-served after remount "
+                 f"({sum(crash['acked'].values())} records across "
+                 f"{len(crash['acked'])} partitions)" if not lost else
+                 f"ACKED RECORDS LOST after recovery: {lost}")),
+            Invariant(
+                "replay_byte_identical",
+                crash["replayed_match"],
+                "pre-crash read == post-recovery read (offset, key, "
+                "value, timestamp all equal)" if crash["replayed_match"]
+                else "post-recovery replay DIVERGED from the acked read"),
+            Invariant(
+                "torn_tail_truncated",
+                crash["done"] and crash["truncated"] == crash["torn"],
+                f"recovery truncated {crash['truncated']} bytes == the "
+                f"{crash['torn']} torn bytes the kill left "
+                f"(iotml_store_recovery_truncated_bytes)"
+                if crash["truncated"] == crash["torn"] else
+                f"recovery truncated {crash['truncated']} bytes, kill "
+                f"left {crash['torn']}"),
+            Invariant(
+                "consumer_resumed_from_committed",
+                crash["done"] and not resumed_bad,
+                "restarted consumer cursors == persisted committed "
+                "offsets" if not resumed_bad else
+                f"cursors diverged from persisted commits: {resumed_bad}"),
+        ]
+        return ChaosReport(
+            scenario=self.schedule.name, seed=self.schedule.seed,
+            records=self.schedule.records, topology="store",
+            published=published, scored=scored_total, rewinds=rewinds,
             dropped_accounted=eng.dropped_count,
             injected=dict(sorted(eng.injected.items())),
             invariants=invariants, span_path=span_path)

@@ -26,6 +26,9 @@ Built-ins:
   must resume via rewind-to-committed redelivery.
 - ``loss-bug-fixture``: a seeded SILENT drop (not ledgered) — exists so
   tests can prove the invariant checker actually fails on real loss.
+- ``broker-crash-recover`` (store): the durable broker dies mid-write
+  (torn frame on the active segment); remount recovers, acked records
+  re-serve, consumers resume from their persisted committed offsets.
 """
 
 from __future__ import annotations
@@ -66,7 +69,7 @@ class Schedule:
     name: str
     seed: int
     records: int
-    topology: str  # "inproc" | "wire"
+    topology: str  # "inproc" | "wire" | "store" (durable broker)
     events: Tuple[FaultEvent, ...]
 
     def lines(self) -> List[str]:
@@ -139,6 +142,22 @@ def _scorer_crash_resume(rng: random.Random, records: int) -> list:
             FaultEvent(h2, "scorer.poll", "error")]
 
 
+def _broker_crash_recover(rng: random.Random, records: int) -> list:
+    # the durable broker dies MID-WRITE somewhere in the middle third of
+    # the stream (torn frame on the active segment); the runner remounts
+    # from disk and the restarted pipeline must finish the stream with
+    # every pre-crash acked record re-served.  A couple of fetch stalls
+    # ride along so recovery is proven under an unquiet consumer.
+    lo, hi = max(1, records // 3), max(2, (2 * records) // 3)
+    events = [FaultEvent(rng.randint(lo, hi), "runner.crash_broker",
+                         "crash_broker")]
+    for _ in range(2):
+        events.append(FaultEvent(rng.randint(1, max(2, records // 20)),
+                                 "broker.fetch", "delay",
+                                 params=(("seconds", 0.001),)))
+    return events
+
+
 def _loss_bug_fixture(rng: random.Random, records: int) -> list:
     # the seeded bug: one delivery silently lost — NOT ledgered, so the
     # scored-or-accounted invariant must fail (the checker's own test)
@@ -177,6 +196,10 @@ SCENARIOS: Dict[str, Tuple[Callable, str, str]] = {
         _loss_bug_fixture, "inproc",
         "SEEDED BUG: one silent (unledgered) drop — the invariant "
         "checker must FAIL on it"),
+    "broker-crash-recover": (
+        _broker_crash_recover, "store",
+        "durable broker killed mid-write; remount recovers the torn "
+        "tail, acked records re-serve, consumers resume from committed"),
 }
 
 

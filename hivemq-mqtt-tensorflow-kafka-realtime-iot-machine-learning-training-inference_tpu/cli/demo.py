@@ -113,7 +113,7 @@ def main(argv=None) -> int:
 
         anomalies = 0
         n_pred = plat.broker.end_offset("model-predictions", 0)
-        off = 0
+        off = plat.broker.begin_offset("model-predictions", 0)
         while off < n_pred:
             for m in plat.broker.fetch("model-predictions", 0, off, 2048):
                 anomalies += b"|anomaly|" in m.value

@@ -79,6 +79,11 @@ def main(argv=None) -> int:
     tr.add_argument("--take-batches", type=int, default=20)
     tr.add_argument("--batch-size", type=int, default=100)
     tr.add_argument("--epochs-per-round", type=int, default=1)
+    tr.add_argument("--backfill-since-ms", type=int, default=None,
+                    help="cold start: begin from the first retained "
+                         "record at/after this timestamp (durable-store "
+                         "replay API) instead of offset 0; partitions "
+                         "with a committed cursor still resume from it")
 
     sc = sub.add_parser("score", help="continuous scorer with hot-swap")
     sc.add_argument("servers")
@@ -154,7 +159,8 @@ def main(argv=None) -> int:
                                 batch_size=args.batch_size,
                                 take_batches=args.take_batches,
                                 epochs_per_round=args.epochs_per_round,
-                                normalizer=normalizer)
+                                normalizer=normalizer,
+                                backfill_since_ms=args.backfill_since_ms)
         print(f"live train: {args.topic} rounds of "
               f"{args.take_batches}x{args.batch_size} -> "
               f"{args.artifact_root}/{args.model_name}", flush=True)

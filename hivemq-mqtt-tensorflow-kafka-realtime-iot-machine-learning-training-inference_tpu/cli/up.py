@@ -43,7 +43,8 @@ class Platform:
                  kafka_port: int = 0, mqtt_port: int = 0,
                  registry_port: int = 0, ksql_port: int = 0,
                  connect_port: int = 0, host: str = "127.0.0.1",
-                 retention_messages: Optional[int] = None, cc_port: int = 0):
+                 retention_messages: Optional[int] = None, cc_port: int = 0,
+                 store_dir: Optional[str] = None, store_policy=None):
         from ..connect import ConnectServer, ConnectWorker
         from ..core.schema import CAR_SCHEMA, KSQL_CAR_SCHEMA
         from ..mqtt.bridge import KafkaBridge
@@ -56,7 +57,12 @@ class Platform:
         from ..streamproc import KsqlServer, SqlEngine
         from ..streamproc.sql import install_reference_pipeline
 
-        self.broker = Broker()
+        # durable mode (iotml.store): every partition is a crash-
+        # recoverable segmented log on disk, consumer offsets persist,
+        # and a restarted platform re-serves everything it acked — the
+        # "no data lake" training substrate surviving the process
+        self.store_dir = store_dir
+        self.broker = Broker(store_dir=store_dir, store_policy=store_policy)
         # the reference's two topics, its partition count.  retention
         # bounds the in-memory log for long-running platforms (the
         # reference sets retention.ms=100000 — aggressive 100s retention,
@@ -146,14 +152,15 @@ class Platform:
         return self
 
     def endpoints(self) -> dict:
-        out = {
+        out = {} if self.store_dir is None else {"store": self.store_dir}
+        out.update({
             "kafka": f"{self.host}:{self.kafka.port}",
             "mqtt": f"{self.host}:{self.mqtt.port}",
             "schema-registry": self.registry_server.url,
             "ksql": self.ksql.url,
             "connect": self.connect.url,
             "control-center": self.control_center.url,
-        }
+        })
         if self.metrics_server is not None:
             out["metrics"] = "http://127.0.0.1:" + \
                 str(self.metrics_server.server_address[1]) + "/metrics"
@@ -317,6 +324,7 @@ class Platform:
             self.metrics_server.shutdown()
             self.metrics_server.server_close()
             self.metrics_server = None
+        self.broker.close()  # durable: fsync + release fds (no-op else)
         self.started = False
 
 
@@ -344,6 +352,16 @@ def main(argv=None) -> int:
                     help="keep at most N messages per partition "
                          "(0 = unbounded; the reference retains ~100s). "
                          "Validated by the broker (negative rejected).")
+    ap.add_argument("--durable", action="store_true",
+                    help="mount the broker on a durable segmented log "
+                         "(iotml.store): crash recovery, persisted "
+                         "consumer offsets, disk retention.  Dir from "
+                         "--store-dir / IOTML_STORE_DIR / "
+                         "/tmp/iotml-store; fsync & retention knobs ride "
+                         "the store.* config section.")
+    ap.add_argument("--store-dir", default=None, metavar="DIR",
+                    help="store directory for --durable (also enables "
+                         "durable mode when given)")
     ap.add_argument("--supervise", action="store_true",
                     help="run component lifecycles under the "
                          "iotml.supervise supervisor (crashed serving "
@@ -353,15 +371,31 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     sasl = tuple(args.sasl.split(":", 1)) if args.sasl else None
+    # the store.* config section (file < IOTML_STORE_* env) supplies the
+    # durable dir and fsync/segment/retention policy; the CLI flags win
+    from ..config import load_config
+    from ..store import StorePolicy
+
+    cfg, _ = load_config([])
+    store_dir = args.store_dir or (
+        (cfg.store.dir or "/tmp/iotml-store") if args.durable else
+        (cfg.store.dir or None))
     try:
         plat = Platform(sasl=sasl, host=args.host,
                         kafka_port=args.kafka_port,
                         mqtt_port=args.mqtt_port,
-                        retention_messages=args.retention,
+                        # 0 (the default) = UNSET, so durable topics
+                        # inherit the store.* retention policy; negatives
+                        # still reach the broker's validation below
+                        retention_messages=args.retention
+                        if args.retention else None,
                         cc_port=args.cc_port,
                         registry_port=args.registry_port,
                         ksql_port=args.ksql_port,
-                        connect_port=args.connect_port)
+                        connect_port=args.connect_port,
+                        store_dir=store_dir,
+                        store_policy=(StorePolicy.from_config(cfg.store)
+                                      if store_dir else None))
     except ValueError as e:  # e.g. negative retention: clean usage error
         ap.error(str(e))
     plat.start(metrics_port=args.metrics_port)

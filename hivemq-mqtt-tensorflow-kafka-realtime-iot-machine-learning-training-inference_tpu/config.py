@@ -96,6 +96,27 @@ class MeshConfig:
 
 
 @dataclasses.dataclass
+class StoreConfig:
+    """Durable segmented-log storage (iotml.store).
+
+    ``dir`` empty (the default) keeps the broker in-memory; set it
+    (``IOTML_STORE_DIR=/var/lib/iotml``) — or pass ``--durable`` to the
+    platform CLI — to mount a crash-recoverable log per partition.
+    Retention here is the store-wide default; per-topic retention on
+    TopicSpec overrides it."""
+
+    dir: str = ""                    # empty = in-memory broker
+    fsync: str = "interval"          # never | interval | always
+    fsync_interval_s: float = 0.05
+    segment_bytes: int = 16 * 1024 * 1024
+    segment_age_s: float = 0.0       # 0 = roll by bytes only
+    retention_bytes: int = 0         # 0 = unbounded
+    retention_ms: int = 0            # 0 = unbounded (reference: 100000)
+    retention_messages: int = 0      # 0 = unbounded (segment-granular)
+    index_interval_bytes: int = 4096
+
+
+@dataclasses.dataclass
 class Config:
     broker: BrokerConfig = dataclasses.field(default_factory=BrokerConfig)
     stream: StreamConfig = dataclasses.field(default_factory=StreamConfig)
@@ -104,6 +125,7 @@ class Config:
     artifacts: ArtifactConfig = dataclasses.field(default_factory=ArtifactConfig)
     scenario: ScenarioConfig = dataclasses.field(default_factory=ScenarioConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    store: StoreConfig = dataclasses.field(default_factory=StoreConfig)
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)

@@ -650,7 +650,16 @@ int64_t iotml_kafka_fetch(void* h, const char* topic, int32_t partition,
       const uint8_t* ms;
       int32_t msn = r.bytes(&ms);
       if (r.fail) return K_EIO;
-      if (err == 1 /*OFFSET_OUT_OF_RANGE*/) continue;  // empty poll
+      if (err == 1 /*OFFSET_OUT_OF_RANGE*/) {
+        // the broker trimmed the log head past this offset (retention).
+        // Silently treating it as an empty poll livelocks the consumer
+        // at the trimmed offset forever; surface it like every other
+        // protocol error.  The iotml wire server rides the EARLIEST
+        // retained offset in the hwm slot for this error (real brokers
+        // send -1), so the caller can reset without a second round trip.
+        c->staged_high_watermark = hwm;
+        return proto_err(err);
+      }
       if (err != ERR_NONE) return proto_err(err);
       c->staged_high_watermark = hwm;
       if (msn > 0 &&

@@ -134,6 +134,7 @@ def cmd_trace(args) -> int:
 def cmd_dlq(args) -> int:
     """Peek a dead-letter topic: decode the JSON envelopes the
     streamproc DLQ writes and show what poisoned the pipeline."""
+    from ..stream.broker import OffsetOutOfRangeError
     from ..stream.kafka_wire import KafkaWireBroker
     from ..streamproc.dlq import DLQ_SUFFIX, decode_envelope
 
@@ -155,8 +156,20 @@ def cmd_dlq(args) -> int:
         for p in range(parts):
             off = client.begin_offset(topic, p)
             end = client.end_offset(topic, p)
+            resets = 0
             while off < end and len(rows) < args.limit:
-                msgs = client.fetch(topic, p, off, max_messages=256)
+                try:
+                    msgs = client.fetch(topic, p, off, max_messages=256)
+                except OffsetOutOfRangeError as e:  # raced a retention trim
+                    # bounded, like the consumer's auto-reset: a broker
+                    # reporting earliest=0 (real Kafka sends hwm -1 on
+                    # this error) must not spin this CLI forever
+                    resets += 1
+                    if resets > 3:
+                        break
+                    off = max(e.earliest, client.begin_offset(topic, p))
+                    continue
+                resets = 0
                 if not msgs:
                     break
                 for m in msgs:

@@ -236,6 +236,10 @@ commit_seconds = default_registry.histogram(
 scorer_rewinds = default_registry.counter(
     "iotml_scorer_rewinds_total",
     "scorer rewind-to-committed redeliveries after a broker failover")
+consumer_autoresets = default_registry.counter(
+    "iotml_consumer_autoresets_total",
+    "consumer cursors auto-reset to earliest after retention trimmed "
+    "past them (OffsetOutOfRange), by topic")
 replica_sync_rounds = default_registry.counter(
     "iotml_replica_sync_rounds_total", "follower replication rounds")
 replica_copied = default_registry.counter(

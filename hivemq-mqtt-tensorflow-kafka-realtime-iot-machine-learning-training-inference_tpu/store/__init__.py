@@ -1,0 +1,24 @@
+"""iotml.store — durable segmented log storage for the stream broker.
+
+The paper's pipeline trains directly from the distributed commit log —
+"no data lake" — which only holds if the commit log actually retains
+and re-serves history across process deaths.  This package is that
+retention: an append-only segmented log per partition (CRC32C-framed
+records, configurable fsync, size/age segment roll, byte+time
+retention, sparse offset + timestamp indexes), crash recovery that
+truncates torn tails, a compacted consumer-offsets file, and a replay
+API (`read_from` / `read_since`) for training backfill.
+
+Mounted by `stream.broker.Broker(store_dir=...)`; every knob rides the
+`store.*` config section (`IOTML_STORE_DIR`, `IOTML_STORE_FSYNC`, ...).
+Lint rule R9 keeps every file write under a store directory inside this
+package (`segment.SegmentWriter` owns the bytes and the fsync ledger).
+"""
+
+from .log import SegmentedLog, StorePolicy
+from .mount import StoreMount
+from .offsets import OffsetsFile
+from .segment import SegmentWriter, crc32c
+
+__all__ = ["SegmentedLog", "StorePolicy", "StoreMount", "OffsetsFile",
+           "SegmentWriter", "crc32c"]

@@ -9,6 +9,16 @@ a partitioned, offset-addressed append-only log with consumer-group offset
 storage and optional size/retention bounds, so every pipeline in the
 framework — train, score, streamproc, generator — runs unchanged against it.
 
+Two partition backends behind one `Broker`:
+
+- **in-memory** (default): a Python list per partition — fast, dies with
+  the process;
+- **durable** (`store_dir=`): an `iotml.store.SegmentedLog` per
+  partition — CRC-framed segments on disk, crash recovery at mount,
+  retention by bytes and time, committed consumer offsets persisted in
+  a compacted offsets file.  This is what makes the paper's "train from
+  the commit log, no data lake" claim survive a restart.
+
 The same `Broker` duck-type is what the native (C++) engine and a real
 librdkafka-backed client expose, so swapping the emulator for a real cluster
 is a constructor change, not a code path change.
@@ -40,6 +50,29 @@ class TopicOwnershipError(PermissionError):
     server maps this to Kafka's TOPIC_AUTHORIZATION_FAILED."""
 
 
+class OffsetOutOfRangeError(LookupError):
+    """Fetch below the partition's retained base offset.
+
+    Retention (or a replica realignment) trimmed the log head past the
+    requested offset.  The old behavior — silently clamping the read
+    forward — made trimmed history indistinguishable from delivered
+    history; now the signal is explicit: the wire server answers Kafka
+    error 1 (OFFSET_OUT_OF_RANGE), the wire client re-raises this, and
+    `StreamConsumer` implements the documented auto-reset-to-earliest
+    (`auto.offset.reset=earliest` semantics)."""
+
+    def __init__(self, topic: str, partition: int, offset: int,
+                 earliest: int):
+        super().__init__(
+            f"fetch {topic}:{partition}@{offset} below retained base "
+            f"{earliest}: the log head was trimmed (retention); consumers "
+            f"auto-reset to earliest, raw callers decide")
+        self.topic = topic
+        self.partition = partition
+        self.offset = offset
+        self.earliest = earliest
+
+
 # Thread-local produce grants: a thread pumping an owning engine enters
 # `producer_grant(token)` and may produce to the topics that token
 # restricts; every other producer is rejected.  Thread-local (not an
@@ -65,8 +98,9 @@ class Message(NamedTuple):
     #: optional ((name, value), ...) record headers — the trace-context
     #: carrier (obs.tracing): metadata rides beside the payload so the
     #: Avro bytes are untouched.  None (the untraced default) costs
-    #: nothing.  In-process only: MessageSet v1 on the wire has no
-    #: header slot, so wire/native clients drop them.
+    #: nothing.  Wire/native clients drop headers (no MessageSet v1
+    #: slot); the durable backend round-trips them in their transport
+    #: byte form (tracing.from_headers accepts both).
     headers: Optional[tuple] = None
 
 
@@ -74,29 +108,197 @@ class Message(NamedTuple):
 class TopicSpec:
     name: str
     partitions: int = 1
-    # retention by message count (the reference uses retention.ms=100000 —
-    # time-based; count-based is the deterministic test-friendly analogue).
+    # retention by message count (deterministic test-friendly bound),
+    # by total bytes, and by record-timestamp age — the reference sets
+    # retention.ms=100000 (01_installConfluentPlatform.sh:180-183);
+    # retention_ms is that knob's native analog.  None = UNSET (durable
+    # brokers fall back to the store-wide policy default; in-memory has
+    # no default, so unbounded); 0 = EXPLICITLY unlimited (the wire
+    # maps Kafka's retention.*=-1 sentinel here) — the only way a topic
+    # on a durable broker opts out of the store default.
     retention_messages: Optional[int] = None
+    retention_bytes: Optional[int] = None
+    retention_ms: Optional[int] = None
 
 
 class _Partition:
-    __slots__ = ("log", "base_offset")
+    """In-memory partition: the list-backed log (the seed backend)."""
+
+    __slots__ = ("log", "base_offset", "bytes", "max_ts")
 
     def __init__(self):
         self.log: List[tuple] = []  # (key, value, ts, headers)
         self.base_offset = 0  # offset of log[0] after retention trimming
+        self.bytes = 0        # payload bytes retained (retention_bytes)
+        self.max_ts = 0       # newest record ts seen (retention_ms anchor)
+
+    # one method per broker touch-point so the durable partition can
+    # substitute — the broker's lock discipline stays identical
+    def append(self, key, value, ts, headers, sync: bool = True) -> int:
+        self.log.append((key, value, ts, headers))
+        self.bytes += len(value) + (len(key) if key else 0)
+        if ts > self.max_ts:
+            self.max_ts = ts
+        return self.base_offset + len(self.log) - 1
+
+    def sync_batch(self) -> None:
+        pass  # durability is the durable backend's concern
+
+    def note_replay(self, n: int) -> None:
+        pass  # iotml_store_* metrics are the durable backend's alone
+
+    def end(self) -> int:
+        return self.base_offset + len(self.log)
+
+    def base(self) -> int:
+        return self.base_offset
+
+    def read(self, offset: int, max_messages: int) -> List[tuple]:
+        """[(offset, key, value, ts, headers)] from `offset`."""
+        idx = offset - self.base_offset
+        return [(offset + i, key, value, ts, hdrs)
+                for i, (key, value, ts, hdrs)
+                in enumerate(self.log[idx:idx + max_messages])]
+
+    def drop_head(self, count: int) -> None:
+        for key, value, _ts, _h in self.log[:count]:
+            self.bytes -= len(value) + (len(key) if key else 0)
+        del self.log[:count]
+        self.base_offset += count
+
+    def enforce_retention(self, spec: TopicSpec) -> None:
+        if spec.retention_messages and len(self.log) > spec.retention_messages:
+            self.drop_head(len(self.log) - spec.retention_messages)
+        if spec.retention_bytes:
+            drop = 0
+            freed = 0
+            while self.bytes - freed > spec.retention_bytes and \
+                    drop < len(self.log) - 1:
+                key, value, _ts, _h = self.log[drop]
+                freed += len(value) + (len(key) if key else 0)
+                drop += 1
+            if drop:
+                self.drop_head(drop)
+        if spec.retention_ms:
+            # age against the NEWEST record timestamp (Kafka's rule),
+            # tracked incrementally at append — an O(n) scan here would
+            # run per produce under the broker lock.  Untimestamped
+            # (ts=0) streams never age out, deterministically.
+            cutoff = self.max_ts - spec.retention_ms
+            drop = 0
+            while drop < len(self.log) - 1 and self.log[drop][2] < cutoff:
+                drop += 1
+            if drop and self.log[drop - 1][2] < cutoff:
+                self.drop_head(drop)
+
+    def align_base(self, offset: int) -> None:
+        if self.log:
+            raise ValueError("partition not empty; base is immutable")
+        self.base_offset = max(self.base_offset, int(offset))
+
+    def reset(self, base_offset: int) -> None:
+        self.log.clear()
+        self.bytes = 0
+        self.max_ts = 0
+        self.base_offset = int(base_offset)
+
+    def offset_for_timestamp(self, ts_ms: int) -> int:
+        for i, (_k, _v, ts, _h) in enumerate(self.log):
+            if ts >= ts_ms:
+                return self.base_offset + i
+        return self.end()
+
+
+class _DurablePartition:
+    """Durable partition: an `iotml.store.SegmentedLog` behind the same
+    touch-points as `_Partition`.  All three retention knobs map to
+    whole-segment deletes (Kafka's own granularity): count/bytes/time
+    caps may over-retain up to one segment, never under-retain."""
+
+    __slots__ = ("slog",)
+
+    def __init__(self, slog):
+        self.slog = slog
+
+    def append(self, key, value, ts, headers, sync: bool = True) -> int:
+        return self.slog.append(key, value, ts, headers, sync=sync)
+
+    def sync_batch(self) -> None:
+        self.slog.sync_batch()
+
+    def note_replay(self, n: int) -> None:
+        from ..store.log import store_replay_records
+
+        store_replay_records.inc(n)
+
+    def end(self) -> int:
+        return self.slog.end_offset
+
+    def base(self) -> int:
+        return self.slog.base_offset
+
+    def read(self, offset: int, max_messages: int) -> List[tuple]:
+        return self.slog.read_from(offset, max_messages)
+
+    def enforce_retention(self, spec: TopicSpec) -> None:
+        pol = self.slog.policy
+        # topic spec overrides the store-wide defaults when present
+        prev = (pol.retention_bytes, pol.retention_ms,
+                pol.retention_messages)
+        if spec.retention_bytes is not None:
+            pol.retention_bytes = spec.retention_bytes
+        if spec.retention_ms is not None:
+            pol.retention_ms = spec.retention_ms
+        if spec.retention_messages is not None:
+            pol.retention_messages = spec.retention_messages
+        try:
+            self.slog.enforce_retention()
+        finally:
+            (pol.retention_bytes, pol.retention_ms,
+             pol.retention_messages) = prev
+
+    def align_base(self, offset: int) -> None:
+        self.slog.align_base(offset)
+
+    def reset(self, base_offset: int) -> None:
+        self.slog.reset(base_offset)
+
+    def offset_for_timestamp(self, ts_ms: int) -> int:
+        return self.slog.offset_for_timestamp(ts_ms)
 
 
 class Broker:
-    """Partitioned in-memory commit log with Kafka-shaped semantics."""
+    """Partitioned commit log with Kafka-shaped semantics.
 
-    def __init__(self):
+    ``Broker()`` is the in-memory emulator.  ``Broker(store_dir=...)``
+    mounts (and crash-recovers) a durable segmented log per partition:
+    topics from the manifest are re-created before serving, committed
+    consumer offsets load from the compacted offsets file, and every
+    subsequent commit is persisted through it."""
+
+    def __init__(self, store_dir: Optional[str] = None, store_policy=None):
         self._lock = threading.Lock()
         self._topics: Dict[str, TopicSpec] = {}
-        self._parts: Dict[str, List[_Partition]] = {}
+        self._parts: Dict[str, List] = {}
         self._group_offsets: Dict[tuple, int] = {}  # (group, topic, part) → next offset
         self._rr: Dict[str, int] = {}  # round-robin cursor per topic
         self._owned: Dict[str, object] = {}  # topic prefix → owner token
+        self.store = None
+        if store_dir:
+            from ..store import StoreMount
+
+            self.store = StoreMount(store_dir, policy=store_policy)
+            for doc in self.store.topics():
+                self.create_topic(
+                    doc["name"], partitions=doc["partitions"],
+                    retention_messages=doc.get("retention_messages"),
+                    retention_bytes=doc.get("retention_bytes"),
+                    retention_ms=doc.get("retention_ms"))
+            self._group_offsets.update(self.store.offsets.table())
+
+    @property
+    def durable(self) -> bool:
+        return self.store is not None
 
     # --------------------------------------------------------- ownership
     def restrict_topic(self, prefix: str,
@@ -137,21 +339,46 @@ class Broker:
                     f"(Broker.producer_grant)")
 
     # ------------------------------------------------------------- topics
-    def create_topic(self, name: str, partitions: int = 1,
-                     retention_messages: Optional[int] = None) -> TopicSpec:
-        if retention_messages is not None and retention_messages < 0:
+    @staticmethod
+    def _validate_retention(name: str, value: Optional[int]) -> Optional[int]:
+        if value is not None and value < 0:
             # a negative cap would delete every produced record while
             # producers believe writes succeed
-            raise ValueError(f"retention_messages must be >= 0 or None, "
-                             f"got {retention_messages}")
-        if not retention_messages:
-            retention_messages = None  # 0 = unbounded (BrokerConfig sentinel)
+            raise ValueError(f"{name} must be >= 0 or None, got {value}")
+        # 0 is preserved, not collapsed to None: on a durable broker
+        # None means "inherit the store-wide default" while 0 means
+        # "explicitly unlimited" — collapsing them made unlimited
+        # unexpressible per topic (both read as unbounded in-memory)
+        return value
+
+    def _make_partition(self, topic: str, partition: int):
+        if self.store is not None:
+            return _DurablePartition(self.store.log_for(topic, partition))
+        return _Partition()
+
+    def create_topic(self, name: str, partitions: int = 1,
+                     retention_messages: Optional[int] = None,
+                     retention_bytes: Optional[int] = None,
+                     retention_ms: Optional[int] = None) -> TopicSpec:
+        retention_messages = self._validate_retention(
+            "retention_messages", retention_messages)
+        retention_bytes = self._validate_retention(
+            "retention_bytes", retention_bytes)
+        retention_ms = self._validate_retention("retention_ms", retention_ms)
         with self._lock:
             if name in self._topics:
                 return self._topics[name]
-            spec = TopicSpec(name, partitions, retention_messages)
+            spec = TopicSpec(name, partitions, retention_messages,
+                             retention_bytes, retention_ms)
             self._topics[name] = spec
-            self._parts[name] = [_Partition() for _ in range(partitions)]
+            if self.store is not None:
+                self.store.register_topic(
+                    name, partitions,
+                    retention_messages=retention_messages,
+                    retention_bytes=retention_bytes,
+                    retention_ms=retention_ms)
+            self._parts[name] = [self._make_partition(name, p)
+                                 for p in range(partitions)]
             self._rr[name] = 0
             return spec
 
@@ -184,13 +411,8 @@ class Broker:
         with self._lock:
             p = self._partition_for(topic, key) if partition is None else partition
             part = self._parts[topic][p]
-            part.log.append((key, value, timestamp_ms, headers))
-            off = part.base_offset + len(part.log) - 1
-            spec = self._topics[topic]
-            if spec.retention_messages and len(part.log) > spec.retention_messages:
-                drop = len(part.log) - spec.retention_messages
-                del part.log[:drop]
-                part.base_offset += drop
+            off = part.append(key, value, timestamp_ms, headers)
+            part.enforce_retention(self._topics[topic])
             return off
 
     def produce_batch(self, topic: str, values, key=None, partition=None) -> int:
@@ -222,29 +444,31 @@ class Broker:
         with self._lock:
             parts = self._parts[topic]
             spec = self._topics[topic]
+            touched = set()
             for entry in entries:
                 key, value, ts = entry[0], entry[1], entry[2]
                 p = self._partition_for(topic, key) if partition is None \
                     else partition
-                part = parts[p]
-                part.log.append((key, value, ts,
-                                 entry[3] if len(entry) > 3 else None))
-                last_off = part.base_offset + len(part.log) - 1
-            if spec.retention_messages:
-                for part in parts:
-                    if len(part.log) > spec.retention_messages:
-                        drop = len(part.log) - spec.retention_messages
-                        del part.log[:drop]
-                        part.base_offset += drop
+                last_off = parts[p].append(
+                    key, value, ts, entry[3] if len(entry) > 3 else None,
+                    sync=False)
+                touched.add(p)
+            for p in touched:
+                # ONE fsync per touched partition per batch (fsync=always):
+                # the ack (this method returning) still follows the sync,
+                # so everything acked is durable — per-record fsync would
+                # only add latency, not safety.  Retention likewise:
+                # untouched partitions cannot have grown past their caps.
+                parts[p].sync_batch()
+                parts[p].enforce_retention(spec)
         return last_off
 
     # -------------------------------------------------------------- fetch
     def end_offset(self, topic: str, partition: int = 0) -> int:
-        part = self._parts[topic][partition]
-        return part.base_offset + len(part.log)
+        return self._parts[topic][partition].end()
 
     def begin_offset(self, topic: str, partition: int = 0) -> int:
-        return self._parts[topic][partition].base_offset
+        return self._parts[topic][partition].base()
 
     def align_base_offset(self, topic: str, partition: int,
                           offset: int) -> None:
@@ -255,10 +479,7 @@ class Broker:
         pair (consumer cursors survive a failover unchanged)."""
         part = self._parts[topic][partition]
         with self._lock:
-            if part.log:
-                raise ValueError(
-                    f"{topic}:{partition} not empty; base is immutable")
-            part.base_offset = max(part.base_offset, int(offset))
+            part.align_base(offset)
 
     def reset_partition(self, topic: str, partition: int,
                         base_offset: int) -> None:
@@ -266,25 +487,75 @@ class Broker:
         replica REALIGNMENT when the leader's retention outran
         replication: appending the post-gap messages at the local end
         would shift every subsequent offset and silently break the
-        offsets-identical failover contract.  Readers see the same thing
-        a leader-side trim shows them (fetch clamps to the new base)."""
+        offsets-identical failover contract."""
         part = self._parts[topic][partition]
         with self._lock:
-            part.log.clear()
-            part.base_offset = int(base_offset)
+            part.reset(base_offset)
 
     def fetch(self, topic: str, partition: int, offset: int,
               max_messages: int = 1024) -> List[Message]:
-        """Read up to max_messages starting at offset (monotone, no blocking)."""
+        """Read up to max_messages starting at offset (monotone, no
+        blocking).  A fetch below the retained base raises
+        OffsetOutOfRangeError — trimmed history is an explicit signal,
+        never a silent skip (consumers auto-reset to earliest)."""
         chaos.point("broker.fetch")  # before the lock: a chaos stall must
         # park this fetcher, never every thread contending the broker
         part = self._parts[topic][partition]
         with self._lock:
-            start = max(offset, part.base_offset)
-            idx = start - part.base_offset
-            chunk = part.log[idx:idx + max_messages]
-        return [Message(topic, partition, start + i, value, key, ts, hdrs)
-                for i, (key, value, ts, hdrs) in enumerate(chunk)]
+            base = part.base()
+            if offset < base:
+                raise OffsetOutOfRangeError(topic, partition, offset, base)
+            if isinstance(part, _Partition):
+                # in-memory: a list slice, cheap enough to hold the lock
+                chunk = part.read(offset, max_messages)
+            else:
+                chunk = None
+        if chunk is None:
+            # durable: disk I/O happens OUTSIDE the broker lock — one
+            # cold read must not park every producer and fetcher.  The
+            # segmented log reads a snapshot (appends only grow files;
+            # a concurrent trim reads as trimmed history), so the only
+            # race is retention passing `offset` mid-read → re-signal.
+            try:
+                chunk = part.read(offset, max_messages)
+            except LookupError:
+                raise OffsetOutOfRangeError(topic, partition, offset,
+                                            part.base()) from None
+        return [Message(topic, partition, off, value, key, ts, hdrs)
+                for off, key, value, ts, hdrs in chunk]
+
+    # ------------------------------------------------------------- replay
+    def offset_for_timestamp(self, topic: str, partition: int,
+                             timestamp_ms: int) -> int:
+        """Earliest offset whose record timestamp is >= `timestamp_ms`
+        (end offset when no such record) — the replay cursor behind
+        `read_since` and the wire protocol's ListOffsets-by-timestamp."""
+        part = self._parts[topic][partition]
+        with self._lock:
+            return part.offset_for_timestamp(timestamp_ms)
+
+    def read_since(self, topic: str, partition: int, timestamp_ms: int,
+                   max_messages: int = 1024) -> List[Message]:
+        """Replay from the first record at/after `timestamp_ms` — how
+        `ContinuousTrainer` backfills history on a cold start instead of
+        training only on post-start records."""
+        offset = self.offset_for_timestamp(topic, partition, timestamp_ms)
+        for _ in range(3):
+            try:
+                msgs = self.fetch(topic, partition, offset, max_messages)
+                break
+            except OffsetOutOfRangeError as e:
+                # raced a retention trim between the timestamp lookup
+                # and the read: skip ahead like every other fetch caller
+                offset = e.earliest
+        else:
+            msgs = []
+        if msgs:
+            # iotml_store_replay_records_total, counted by the durable
+            # backend only — an in-memory replay must not show up on a
+            # store dashboard (and the stream layer stays metric-free)
+            self._parts[topic][partition].note_replay(len(msgs))
+        return msgs
 
     # ------------------------------------------------- consumer-group API
     def commit(self, group: str, topic: str, partition: int, next_offset: int):
@@ -293,7 +564,36 @@ class Broker:
         # has no way to prove that, and free-threaded builds won't either
         with self._lock:
             self._group_offsets[(group, topic, partition)] = next_offset
+            if self.store is not None:
+                self.store.offsets.commit(group, topic, partition,
+                                          next_offset)
+
+    def commit_many(self, group: str, topic: str, entries) -> None:
+        """Commit [(partition, next_offset), ...] of one topic under ONE
+        lock acquisition — and, durable, ONE offsets-file fsync
+        (StreamConsumer.commit's fast path, same contract as the wire
+        client's commit_many)."""
+        with self._lock:
+            for p, off in entries:
+                self._group_offsets[(group, topic, p)] = off
+            if self.store is not None:
+                self.store.offsets.commit_many(group, topic, entries)
 
     def committed(self, group: str, topic: str, partition: int) -> Optional[int]:
         with self._lock:
             return self._group_offsets.get((group, topic, partition))
+
+    # ---------------------------------------------------------- lifecycle
+    def flush(self) -> None:
+        """Durable broker: fsync every partition log + the offsets file
+        (no-op in-memory)."""
+        if self.store is not None:
+            with self._lock:
+                self.store.flush()
+
+    def close(self) -> None:
+        """Release the durable backend's file handles (clean restart
+        path; crash recovery handles the unclean one)."""
+        if self.store is not None:
+            with self._lock:
+                self.store.close()

@@ -19,7 +19,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from ..obs import metrics as obs_metrics
-from .broker import Broker, Message
+from .broker import Broker, Message, OffsetOutOfRangeError
 
 
 def parse_spec(spec: str) -> tuple:
@@ -77,10 +77,32 @@ class StreamConsumer:
             off = self.broker.committed(self.group, topic, part)
             cur[2] = off if off is not None else self._start[i]
 
+    def _fetch_autoreset(self, topic: str, part: int, off: int,
+                         max_messages: int) -> tuple:
+        """One broker fetch with the documented out-of-range policy:
+        a cursor below the retained base (retention trimmed the head
+        past it) auto-resets to EARLIEST — `auto.offset.reset=earliest`
+        semantics, counted in iotml_consumer_autoresets_total so a
+        consumer chronically outrun by retention is visible.  Returns
+        (batch, effective_offset)."""
+        for _ in range(4):  # retention may trim again between the calls
+            try:
+                return self.broker.fetch(topic, part, off, max_messages), off
+            except OffsetOutOfRangeError as e:
+                off = max(e.earliest, self.broker.begin_offset(topic, part))
+                obs_metrics.consumer_autoresets.inc(topic=topic)
+        # chronically outrun by retention (it trimmed past every reset):
+        # an empty batch with the cursor parked at the last-known
+        # earliest keeps the documented contract — poll() never raises
+        # for trimmed history, the next poll resumes the chase
+        return [], off
+
     # --------------------------------------------------------------- read
     def poll(self, max_messages: int = 1024) -> List[Message]:
         """Fetch up to max_messages across cursors (round-robin between
-        partitions so one hot partition cannot starve the rest)."""
+        partitions so one hot partition cannot starve the rest).  A
+        cursor stranded below the retained base auto-resets to earliest
+        (see _fetch_autoreset)."""
         out: List[Message] = []
         n = len(self._cursors)
         attempts = 0
@@ -89,7 +111,9 @@ class StreamConsumer:
             self._rr += 1
             attempts += 1
             topic, part, off = cur
-            batch = self.broker.fetch(topic, part, off, max_messages - len(out))
+            batch, off = self._fetch_autoreset(topic, part, off,
+                                               max_messages - len(out))
+            cur[2] = off  # an auto-reset moved the cursor even if empty
             if batch:
                 cur[2] = batch[-1].offset + 1
                 out.extend(batch)
@@ -125,8 +149,17 @@ class StreamConsumer:
             self._rr += 1
             attempts += 1
             topic, part, off = cur
-            res = fd(topic, part, off, codec, strip=strip,
-                     max_rows=max_messages - got)
+            try:
+                res = fd(topic, part, off, codec, strip=strip,
+                         max_rows=max_messages - got)
+            except OffsetOutOfRangeError as e:
+                # same documented auto-reset-to-earliest as poll(): the
+                # fused native path must not turn a retention trim into
+                # a crashed trainer/scorer loop
+                cur[2] = max(e.earliest,
+                             self.broker.begin_offset(topic, part))
+                obs_metrics.consumer_autoresets.inc(topic=topic)
+                continue
             numeric, labels = res[0], res[1]
             next_off = res[-1]
             if len(numeric):
@@ -164,6 +197,17 @@ class StreamConsumer:
         """Rewind to the construction offsets (per-epoch stream re-read)."""
         for cur, off in zip(self._cursors, self._start):
             cur[2] = off
+
+    def seek_to_timestamp(self, timestamp_ms: int) -> None:
+        """Move every cursor to the first record at/after `timestamp_ms`
+        (the broker's timestamp index / ListOffsets-by-timestamp) — the
+        replay entry point for training backfill.  Brokers without the
+        replay API (native engine) leave the cursors untouched."""
+        oft = getattr(self.broker, "offset_for_timestamp", None)
+        if oft is None:
+            return
+        for cur in self._cursors:
+            cur[2] = oft(cur[0], cur[1], timestamp_ms)
 
     def seek(self, topic: str, partition: int, offset: int):
         for cur in self._cursors:

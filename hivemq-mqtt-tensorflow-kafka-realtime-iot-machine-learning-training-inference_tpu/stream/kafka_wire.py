@@ -37,7 +37,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..chaos import faults as chaos
 from ..utils.net import recv_exact
-from .broker import Broker, Message, TopicSpec
+from .broker import Broker, Message, OffsetOutOfRangeError, TopicSpec
 
 # api keys
 PRODUCE, FETCH, LIST_OFFSETS, METADATA = 0, 1, 2, 3
@@ -57,6 +57,7 @@ ERR_TOPIC_AUTHORIZATION_FAILED = 29
 ERR_UNSUPPORTED_VERSION = 35
 ERR_TOPIC_EXISTS = 36
 ERR_SASL_AUTH_FAILED = 58
+ERR_INVALID_CONFIG = 40
 ERR_FENCED_LEADER_EPOCH = 74  # Kafka's own fencing error code
 
 _SUPPORTED = {PRODUCE: (2, 2), FETCH: (2, 2), LIST_OFFSETS: (1, 1),
@@ -567,6 +568,11 @@ class KafkaWireBroker(ProducePartitionMixin):
         # timeout_s, so a stalled broker parks callers for at most that.
         with self._lock:
             try:
+                if self._sock is None:
+                    # a previous reconnect found no reachable server and
+                    # left no socket; try again now (the outage may be a
+                    # restart in flight) instead of dying on a dead handle
+                    self._connect_any()
                 corr, resp = self._exchange(api_key, api_version, body)
             except OSError as e:
                 # dead server: fail over across the bootstrap list, then
@@ -653,13 +659,22 @@ class KafkaWireBroker(ProducePartitionMixin):
         return TopicSpec(name, n)
 
     def create_topic(self, name: str, partitions: int = 1,
-                     retention_messages: Optional[int] = None) -> TopicSpec:
+                     retention_messages: Optional[int] = None,
+                     retention_bytes: Optional[int] = None,
+                     retention_ms: Optional[int] = None) -> TopicSpec:
         w = _Writer()
+        # retention rides CreateTopics v0's standard config entries —
+        # retention.bytes / retention.ms are Kafka's own names;
+        # retention.messages is the emulator-family extension
+        cfgs = [(k, str(v)) for k, v in
+                (("retention.messages", retention_messages),
+                 ("retention.bytes", retention_bytes),
+                 ("retention.ms", retention_ms)) if v is not None]
 
         def one(wr, _):
             wr.string(name).i32(partitions).i16(1)
             wr.i32(0)  # replica assignment: none
-            wr.i32(0)  # configs: none
+            wr.array(cfgs, lambda cw, kv: cw.string(kv[0]).string(kv[1]))
 
         w.array([None], one)
         w.i32(10_000)  # timeout ms
@@ -671,6 +686,11 @@ class KafkaWireBroker(ProducePartitionMixin):
         for _, err in errs:
             if err == ERR_TOPIC_EXISTS:
                 existed = True
+            elif err == ERR_INVALID_CONFIG:
+                # mirrors the in-process broker's validation contract
+                raise ValueError(
+                    f"create_topic({name}): broker rejected the config "
+                    f"(negative retention?)")
             elif err != ERR_NONE:
                 raise RuntimeError(f"create_topic({name}) failed: error {err}")
         if existed:
@@ -750,7 +770,13 @@ class KafkaWireBroker(ProducePartitionMixin):
         for tname, parts in tops:
             for pid, err, hwm, record_set in parts:
                 if err == ERR_OFFSET_OUT_OF_RANGE:
-                    continue
+                    # the server's log head was trimmed past this offset
+                    # (retention/realignment).  Surfaced, not swallowed:
+                    # the old `continue` made trimmed history look like
+                    # an empty poll.  `hwm` rides the response as the
+                    # earliest retained offset for this error.
+                    raise OffsetOutOfRangeError(tname or topic, pid,
+                                                offset, max(hwm, 0))
                 if err == ERR_UNKNOWN_TOPIC:
                     raise KeyError(topic)
                 if err != ERR_NONE:
@@ -786,6 +812,12 @@ class KafkaWireBroker(ProducePartitionMixin):
 
     def begin_offset(self, topic: str, partition: int = 0) -> int:
         return self._list_offset(topic, partition, -2)
+
+    def offset_for_timestamp(self, topic: str, partition: int,
+                             timestamp_ms: int) -> int:
+        """Earliest offset with record timestamp >= `timestamp_ms` —
+        ListOffsets by timestamp, the Broker replay-API duck-type."""
+        return self._list_offset(topic, partition, max(int(timestamp_ms), 0))
 
     # ------------------------------------------------- consumer-group API
     def commit(self, group: str, topic: str, partition: int, next_offset: int):
@@ -1254,7 +1286,15 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                     if not self._valid_part(broker, tname, pid):
                         presp.append((pid, ERR_UNKNOWN_TOPIC, -1, b""))
                         continue
-                    msgs = broker.fetch(tname, pid, offset, 4096)
+                    try:
+                        msgs = broker.fetch(tname, pid, offset, 4096)
+                    except OffsetOutOfRangeError as e:
+                        # Kafka error 1; the hwm slot carries the
+                        # earliest retained offset so the client's
+                        # auto-reset needs no second round trip
+                        presp.append((pid, ERR_OFFSET_OUT_OF_RANGE,
+                                      e.earliest, b""))
+                        continue
                     hwm = broker.end_offset(tname, pid)
                     ms = encode_message_set(
                         [(m.offset, m.key, m.value, m.timestamp_ms)
@@ -1281,6 +1321,12 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                     elif ts == -2:
                         presp.append((pid, ERR_NONE, -1,
                                       broker.begin_offset(tname, pid)))
+                    elif ts >= 0:
+                        # ListOffsets by timestamp: the replay cursor
+                        # (earliest offset with record ts >= requested)
+                        presp.append((pid, ERR_NONE, -1,
+                                      broker.offset_for_timestamp(
+                                          tname, pid, ts)))
                     else:
                         presp.append((pid, ERR_NONE, -1,
                                       broker.end_offset(tname, pid)))
@@ -1324,8 +1370,11 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                             for t, parts in tops]
             else:
                 for tname, parts in tops:
-                    for pid, off, _meta in parts:
-                        broker.commit(group, tname, pid, off)
+                    # one batched commit per topic: a durable broker
+                    # fsyncs its offsets file ONCE per request, not once
+                    # per partition (the client batched for a reason)
+                    broker.commit_many(group, tname,
+                                       [(pid, off) for pid, off, _ in parts])
                 resp = [(tname, [(pid, ERR_NONE) for pid, _, _ in parts])
                         for tname, parts in tops]
             w.array(resp, lambda wr, t: (wr.string(t[0]), wr.array(
@@ -1433,17 +1482,43 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                 parts = rd.i32()
                 rd.i16()  # replication factor
                 rd.array(lambda x: (x.i32(), x.array(lambda y: y.i32())))
-                rd.array(lambda x: (x.string(), x.string()))
-                return (name, parts)
+                cfgs = rd.array(lambda x: (x.string(), x.string()))
+                return (name, parts, cfgs)
 
             tops = r.array(topic)
             r.i32()  # timeout
             resp = []
-            for name, parts in tops:
+            for name, parts, cfgs in tops:
                 if name in broker.topics():
                     resp.append((name, ERR_TOPIC_EXISTS))
                 else:
-                    broker.create_topic(name, partitions=max(parts, 1))
+                    # retention configs carried the standard way (the
+                    # names Kafka itself uses); unknown keys are ignored
+                    # like a permissive broker's defaults path
+                    try:
+                        ret = {}
+                        for k, v in cfgs:
+                            field = {"retention.messages":
+                                     "retention_messages",
+                                     "retention.bytes": "retention_bytes",
+                                     "retention.ms": "retention_ms"}.get(k)
+                            if field is None or v is None:
+                                continue
+                            value = int(v)  # non-integer → INVALID_CONFIG
+                            if value == -1:
+                                # Kafka's documented 'unlimited' sentinel
+                                # for retention.*: explicit unlimited (0),
+                                # which on a durable broker OVERRIDES the
+                                # store-wide default (None would inherit)
+                                value = 0
+                            ret[field] = value
+                        broker.create_topic(name, partitions=max(parts, 1),
+                                            **ret)
+                    except ValueError:
+                        # unparseable or negative retention: answer
+                        # INVALID_CONFIG instead of killing the connection
+                        resp.append((name, ERR_INVALID_CONFIG))
+                        continue
                     resp.append((name, ERR_NONE))
             w.array(resp, lambda wr, t: wr.string(t[0]).i16(t[1]))
 

@@ -25,7 +25,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .broker import Message, TopicSpec
+from .broker import Message, OffsetOutOfRangeError, TopicSpec
 from .kafka_wire import ProducePartitionMixin
 from .native import LABEL_STRIDE, NativeCodec, load
 
@@ -224,6 +224,17 @@ class NativeKafkaBroker(ProducePartitionMixin):
             return last
 
     # --------------------------------------------------------------- fetch
+    def _raise_out_of_range(self, rc: int, topic: str, partition: int,
+                            offset: int) -> None:
+        """proto error 1 (rc -1001): the broker trimmed past `offset`.
+        The iotml wire server carries the earliest retained offset in
+        the hwm slot for this error (real brokers send -1; consumers
+        re-query begin_offset on 0), staged by the native client."""
+        if rc == -1001:
+            earliest = max(
+                int(self._lib.iotml_kafka_high_watermark(self._h)), 0)
+            raise OffsetOutOfRangeError(topic, partition, offset, earliest)
+
     def fetch(self, topic: str, partition: int, offset: int,
               max_messages: int = 1024) -> List[Message]:
         with self._lock:
@@ -232,6 +243,7 @@ class NativeKafkaBroker(ProducePartitionMixin):
                                              ctypes.c_int64(max_messages))
             if rc == -1003:
                 raise KeyError(topic)
+            self._raise_out_of_range(rc, topic, partition, offset)
             n = _check(rc, f"fetch({topic}:{partition}@{offset})")
             if n == 0:
                 return []
@@ -282,6 +294,7 @@ class NativeKafkaBroker(ProducePartitionMixin):
                 raise ValueError(f"malformed Avro message at row {-(rc + 2000) - 1}")
             if rc == -1003:
                 raise KeyError(topic)
+            self._raise_out_of_range(rc, topic, partition, offset)
             n = _check(rc, f"fetch_decode({topic}:{partition}@{offset})")
             return (numeric[:n], labels[:n, : codec.n_strings],
                     int(next_off.value))
@@ -323,6 +336,7 @@ class NativeKafkaBroker(ProducePartitionMixin):
                     f"malformed Avro message at row {-(rc + 2000) - 1}")
             if rc == -1003:
                 raise KeyError(topic)
+            self._raise_out_of_range(rc, topic, partition, offset)
             n = _check(rc, f"fetch_decode_keys({topic}:{partition}@{offset})")
             # A key that fills the stride was possibly truncated by the
             # engine (it writes at most stride-1 bytes): two distinct car

@@ -39,7 +39,7 @@ from typing import Dict, List, Optional, Tuple
 from ..chaos import faults as chaos
 from ..obs import metrics as obs_metrics
 from ..utils.backoff import ExpBackoff
-from .broker import Broker
+from .broker import Broker, OffsetOutOfRangeError
 from .kafka_wire import KafkaWireBroker, KafkaWireServer
 
 #: wire-server epoch of an UNPROMOTED follower: no stamped epoch can
@@ -75,13 +75,17 @@ class FollowerReplica:
                  fetch_batch: int = 2000,
                  retention_messages: Optional[int] = None,
                  sasl: Optional[tuple] = None,
-                 commit_interval_s: float = 1.0):
+                 commit_interval_s: float = 1.0,
+                 store_dir: Optional[str] = None, store_policy=None):
         #: local log bound per mirrored topic.  The wire protocol does
         #: not carry the leader's retention config, so a follower of a
         #: retention-bounded leader must be given its own bound here or
         #: it accumulates the whole stream forever.
         self._retention = retention_messages
-        self.local = Broker()
+        # store_dir: mount the follower's log durably (iotml.store) —
+        # a restarted follower resumes replication from its retained
+        # end instead of re-copying the leader's whole history
+        self.local = Broker(store_dir=store_dir, store_policy=store_policy)
         # epoch -1 = "not a leader": an epoch-stamped produce/commit
         # reaching this follower BEFORE promotion is fenced (the
         # pre-promotion half of split-log protection — a failed-over
@@ -151,6 +155,7 @@ class FollowerReplica:
             self._leader.close()
         except OSError:
             pass
+        self.local.close()  # durable backend releases its fds (no-op else)
 
     def __enter__(self) -> "FollowerReplica":
         return self.start()
@@ -237,8 +242,22 @@ class FollowerReplica:
             for p in range(self._parts[t]):
                 while not self._stop.is_set():
                     local_end = self.local.end_offset(t, p)
-                    msgs = self._leader.fetch(t, p, local_end,
-                                              max_messages=self._batch)
+                    try:
+                        msgs = self._leader.fetch(t, p, local_end,
+                                                  max_messages=self._batch)
+                    except OffsetOutOfRangeError as e:
+                        # the leader's retention outran replication and
+                        # now SAYS so (wire error 1) instead of clamping:
+                        # realign to its earliest retained offset
+                        begin = max(e.earliest,
+                                    self._leader.begin_offset(t, p))
+                        if begin <= local_end:
+                            break  # raced a concurrent trim; next round
+                        self.sync_errors.append(
+                            f"trimmed past cursor {t}:{p} "
+                            f"{local_end}->{begin}; realigned")
+                        self.local.reset_partition(t, p, begin)
+                        continue
                     if not msgs:
                         break
                     if msgs[0].offset != local_end:

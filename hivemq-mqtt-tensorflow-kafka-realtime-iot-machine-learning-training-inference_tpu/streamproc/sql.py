@@ -36,7 +36,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..core.schema import Field, RecordSchema
 from ..ops.avro import AvroCodec, zigzag_encode
 from ..ops.framing import frame, unframe
-from ..stream.broker import Broker, Message
+from ..stream.broker import Broker, Message, OffsetOutOfRangeError
 from ..stream.registry import SchemaRegistry, subject_for_topic
 from .tasks import StreamTask
 
@@ -902,7 +902,12 @@ class SqlAggTask(StreamTask):
             off = self.broker.begin_offset(self.dst, p)
             end = self.broker.end_offset(self.dst, p)
             while off < end:
-                msgs = self.broker.fetch(self.dst, p, off, max_messages=1024)
+                try:
+                    msgs = self.broker.fetch(self.dst, p, off,
+                                             max_messages=1024)
+                except OffsetOutOfRangeError as e:
+                    off = e.earliest  # raced a retention trim: skip ahead
+                    continue
                 if not msgs:
                     break
                 for m in msgs:
@@ -1346,7 +1351,12 @@ class SqlEngine:
             off = self.broker.begin_offset(meta.topic, p)
             end = self.broker.end_offset(meta.topic, p)
             while off < end:
-                msgs = self.broker.fetch(meta.topic, p, off, max_messages=1024)
+                try:
+                    msgs = self.broker.fetch(meta.topic, p, off,
+                                             max_messages=1024)
+                except OffsetOutOfRangeError as e:
+                    off = e.earliest  # raced a retention trim: skip ahead
+                    continue
                 if not msgs:
                     break
                 for m in msgs:
@@ -1421,7 +1431,14 @@ class SqlEngine:
                             self.broker.end_offset(topic, p) - (limit or 10)))
             end = self.broker.end_offset(topic, p)
             while off < end and (limit is None or len(rows) < limit):
-                for m in self.broker.fetch(topic, p, off, max_messages=256):
+                try:
+                    msgs = self.broker.fetch(topic, p, off, max_messages=256)
+                except OffsetOutOfRangeError as e:
+                    off = e.earliest  # raced a retention trim: skip ahead
+                    continue
+                if not msgs:
+                    break
+                for m in msgs:
                     rows.append({"partition": p, "offset": m.offset,
                                  "rowtime": m.timestamp_ms,
                                  "key": (m.key or b"").decode(errors="replace"),

@@ -52,7 +52,8 @@ class ContinuousTrainer:
                  group: str = "cardata-live-train",
                  model=None, batch_size: int = 100, take_batches: int = 20,
                  epochs_per_round: int = 1, only_normal: bool = True,
-                 learning_rate: float = 1e-3, normalizer=None):
+                 learning_rate: float = 1e-3, normalizer=None,
+                 backfill_since_ms: Optional[int] = None):
         if model is None:
             from ..models.autoencoder import CAR_AUTOENCODER
 
@@ -73,6 +74,20 @@ class ContinuousTrainer:
         # dominant cost of the naive loop
         self.consumer = StreamConsumer.from_committed(broker, topic, parts,
                                                       group=group)
+        # cold-start backfill (the durable store's replay API): a FIRST
+        # incarnation of this group — no committed cursor — starts from
+        # the log's history at `backfill_since_ms` instead of offset 0 of
+        # whatever happens to be retained, so a trainer deployed against
+        # a long-retained durable topic trains on exactly the requested
+        # window.  Partitions WITH a committed cursor are never moved
+        # (resume beats replay; the committed contract stays intact).
+        if backfill_since_ms is not None:
+            oft = getattr(broker, "offset_for_timestamp", None)
+            if oft is not None:
+                for p in parts:
+                    if broker.committed(group, topic, p) is None:
+                        self.consumer.seek(
+                            topic, p, oft(topic, p, backfill_since_ms))
         # large poll chunks: each wire fetch is a round trip into the
         # broker process (expensive when that process is busy), and the
         # batcher's poll budgeting (_need_rows) guarantees a bounded

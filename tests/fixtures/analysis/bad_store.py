@@ -1,0 +1,32 @@
+"""R9 fixture: naked writes under a store directory, every way to get
+it wrong — a raw os.open + os.fsync pair (2 findings), a builtin open()
+on a store path (1 finding) — plus the clean shapes: an open() on an
+unrelated path and a justified suppression (0 findings)."""
+
+import os
+
+
+def torn_write_by_hand(store_dir):
+    # both halves flagged: the bytes bypass SegmentWriter's framing/CRC,
+    # and the fsync bypasses its durability accounting
+    fd = os.open(os.path.join(store_dir, "00000000000000000000.log"),
+                 os.O_WRONLY | os.O_APPEND)
+    os.fsync(fd)
+    os.close(fd)
+
+
+def naked_segment_append(store_dir):
+    with open(os.path.join(store_dir, "segments", "t", "0", "x.log"),
+              "ab") as fh:
+        fh.write(b"unframed bytes recovery cannot checksum")
+
+
+def unrelated_write_is_fine(tmp_dir):
+    with open(os.path.join(tmp_dir, "notes.txt"), "w") as fh:
+        fh.write("not a store path: no finding")
+
+
+def justified(store_dir):
+    # lint-ok: R9 read-only introspection; os.open with O_RDONLY writes nothing
+    fd = os.open(os.path.join(store_dir, "offsets"), os.O_RDONLY)
+    os.close(fd)

@@ -31,14 +31,72 @@ def test_keyed_partitioning_is_stable():
 
 
 def test_retention_trims_and_offsets_stay_absolute():
+    from iotml.stream.broker import OffsetOutOfRangeError
+
     b = Broker()
     b.create_topic("t", retention_messages=10)
     for i in range(25):
         b.produce("t", str(i).encode(), partition=0)
     assert b.begin_offset("t", 0) == 15
     assert b.end_offset("t", 0) == 25
-    msgs = b.fetch("t", 0, 0)  # request from trimmed region clamps forward
-    assert msgs[0].offset == 15
+    # a fetch below the retained base is an explicit signal, not a
+    # silent clamp (trimmed history must be distinguishable from
+    # delivered history); the error names the earliest retained offset
+    with pytest.raises(OffsetOutOfRangeError) as ei:
+        b.fetch("t", 0, 0)
+    assert ei.value.earliest == 15
+    assert b.fetch("t", 0, 15)[0].offset == 15
+
+
+def test_retention_by_bytes_and_time():
+    from iotml.stream.broker import OffsetOutOfRangeError
+
+    b = Broker()
+    b.create_topic("tb", retention_bytes=100)
+    for i in range(30):
+        b.produce("tb", b"x" * 10, partition=0)
+    assert b.end_offset("tb", 0) == 30
+    assert b.begin_offset("tb", 0) >= 19  # ~100 bytes of 10-byte records
+    # time retention ages against the NEWEST record timestamp
+    b.create_topic("tt", retention_ms=1000)
+    for i in range(10):
+        b.produce("tt", str(i).encode(), partition=0, timestamp_ms=1000 + i)
+    assert b.begin_offset("tt", 0) == 0  # all within the window
+    b.produce("tt", b"new", partition=0, timestamp_ms=5000)
+    assert b.begin_offset("tt", 0) == 10  # 1000-era records aged out
+    # negative knobs rejected like the count knob
+    for kw in ({"retention_bytes": -1}, {"retention_ms": -5},
+               {"retention_messages": -2}):
+        with pytest.raises(ValueError):
+            b.create_topic("bad", **kw)
+    # untimestamped (ts=0) streams never age out
+    b.create_topic("t0", retention_ms=1)
+    for i in range(5):
+        b.produce("t0", str(i).encode(), partition=0)
+    assert b.begin_offset("t0", 0) == 0
+    with pytest.raises(OffsetOutOfRangeError):
+        b.fetch("tt", 0, 3)
+
+
+def test_consumer_auto_resets_to_earliest_after_trim():
+    """The documented auto.offset.reset=earliest behavior: a cursor
+    stranded below the retained base resumes at the earliest retained
+    record instead of erroring forever or silently skipping."""
+    b = Broker()
+    b.create_topic("t", retention_messages=5)
+    for i in range(3):
+        b.produce("t", str(i).encode(), partition=0)
+    c = StreamConsumer(b, ["t:0:0"], group="g", eof=False)
+    assert [m.value for m in c.poll()] == [b"0", b"1", b"2"]
+    c2 = StreamConsumer(b, ["t:0:0"], group="g2", eof=False)  # lags at 0
+    for i in range(3, 20):
+        b.produce("t", str(i).encode(), partition=0)
+    assert b.begin_offset("t", 0) == 15
+    msgs = c2.poll()
+    assert [m.offset for m in msgs] == [15, 16, 17, 18, 19]
+    from iotml.obs import metrics as obs_metrics
+
+    assert obs_metrics.consumer_autoresets.value(topic="t") >= 1
 
 
 def test_parse_spec():

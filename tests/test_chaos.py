@@ -343,6 +343,20 @@ def test_leader_kill_scenario_holds_the_invariants():
     assert "promotion_loss_bounded" in names
 
 
+def test_broker_crash_recover_scenario_holds_the_invariants(tmp_path):
+    """The store topology: durable broker killed mid-write (torn tail),
+    remounted, invariants incl. the recovery-specific ones must hold."""
+    report = _run("broker-crash-recover", records=100, tmp_path=tmp_path)
+    assert report.ok, _failed(report)
+    assert report.topology == "store"
+    assert report.injected.get("runner.crash_broker:crash_broker") == 1
+    assert report.published == 100
+    by_name = {i.name: i for i in report.invariants}
+    assert by_name["torn_tail_truncated"].ok
+    assert by_name["replay_byte_identical"].ok
+    assert by_name["consumer_resumed_from_committed"].ok
+
+
 def test_loss_bug_fixture_fails_the_checker(tmp_path):
     """The checker checked: a committed-then-silently-dropped record
     (the seeded unledgered drop) must FAIL, naming the lost trace."""
