@@ -17,6 +17,8 @@ JAX_PLATFORMS=cpu python -m iotml.chaos run --scenario mqtt-flap \
   --seed 7 --records 500
 JAX_PLATFORMS=cpu python -m iotml.chaos run --scenario broker-crash-recover \
   --seed 7 --records 500
+JAX_PLATFORMS=cpu python -m iotml.chaos run --scenario rebalance-under-chaos \
+  --seed 7 --records 500
 
 echo "== 2/5 supervised restart: live scorer-crash drill (the scorer"
 echo "        thread dies twice; the supervisor must heal the pipeline)"

@@ -72,7 +72,7 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 # in sync with kafka_wire.IDEMPOTENT_APIS by tests/test_analysis.py.
 IDEMPOTENT_API_NAMES = frozenset({
     "FETCH", "METADATA", "LIST_OFFSETS", "OFFSET_FETCH",
-    "API_VERSIONS", "SASL_HANDSHAKE", "HEARTBEAT",
+    "API_VERSIONS", "SASL_HANDSHAKE", "HEARTBEAT", "FIND_COORDINATOR",
 })
 
 # R5: topics written exclusively by the stream-proc engine (the AVRO leg
@@ -135,7 +135,17 @@ RULES: Dict[str, str] = {
     "R9": "naked store-dir write (os.fsync, or open()/os.open() on a "
           "store path) outside iotml/store/: all store-dir bytes go "
           "through SegmentWriter",
+    "R10": "direct broker-instance addressing outside iotml/cluster/ "
+           "(ShardBroker(...) construction, or subscripting a "
+           "controller's .brokers/.servers/.serving/.replicas): clients "
+           "route via PartitionMap / ClusterClient",
 }
+
+# R10: the cluster-internal collections whose per-instance subscripting
+# outside the package bypasses PartitionMap routing (and with it the
+# NOT_LEADER + epoch-fencing invariants).  The chaos/supervise drill
+# harnesses are exempt — proving failover requires touching the victim.
+_R10_COLLECTIONS = frozenset({"brokers", "servers", "serving", "replicas"})
 
 # R9: identifier substrings that mark an open() argument as a store
 # path.  Conservative by construction (names, not data flow) — matching
@@ -396,6 +406,9 @@ class _FileLinter(ast.NodeVisitor):
         # R8 scoping: the supervise package OWNS thread lifecycles (the
         # registry itself, the monitor) and is exempt from wrapping
         self.in_supervise = "supervise" in parts
+        # R10 scoping: the cluster package owns broker instances; the
+        # chaos/supervise drill harnesses may address victims directly
+        self.r10_exempt = "cluster" in parts or self.in_chaos
         # R9 scoping: the store package OWNS the bytes (SegmentWriter,
         # atomic_write) and is the one place fsync may appear
         self.in_store = "store" in parts
@@ -450,6 +463,20 @@ class _FileLinter(ast.NodeVisitor):
     def visit_Import(self, node: ast.Import) -> None:
         for alias in node.names:
             self._check_chaos_import(node, alias.name)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        # R10 — `<x>.brokers[i]` / `.servers[i]` / `.serving[i]` /
+        # `.replicas[i]`: picking a broker instance by index outside the
+        # cluster package bypasses PartitionMap routing — and with it
+        # the NOT_LEADER re-route and epoch-fencing invariants
+        v = node.value
+        if not self.r10_exempt and isinstance(v, ast.Attribute) \
+                and v.attr in _R10_COLLECTIONS:
+            self._emit("R10", node,
+                       f"direct broker-instance addressing "
+                       f"(.{v.attr}[...]) outside iotml/cluster/: "
+                       f"route via PartitionMap / ClusterClient")
         self.generic_visit(node)
 
     # R4 needs with-scope tracking, so visit With explicitly
@@ -611,6 +638,16 @@ class _FileLinter(ast.NodeVisitor):
                                "dir go through SegmentWriter (framing, "
                                "CRC, fsync accounting, recovery "
                                "semantics)")
+
+        # R10 — broker instances are the cluster package's to build:
+        # constructing a ShardBroker elsewhere bypasses the controller's
+        # ownership wiring (and the map that fences it)
+        if not self.r10_exempt and name == "ShardBroker":
+            self._emit("R10", node,
+                       "ShardBroker(...) constructed outside "
+                       "iotml/cluster/: broker instances belong to the "
+                       "ClusterController; clients route via "
+                       "PartitionMap / ClusterClient")
 
         # R5 — engine-owned topic produced outside streamproc/
         if not self.in_streamproc and name in ("produce", "produce_many",

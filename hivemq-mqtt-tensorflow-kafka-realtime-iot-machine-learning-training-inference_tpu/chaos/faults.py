@@ -69,6 +69,14 @@ RUNNER_POINTS: Dict[str, str] = {
                            "frame left on the active segment) -> remount "
                            "from the store dir, recovery truncates the "
                            "tail, consumers resume from persisted commits",
+    "runner.kill_member": "consumer-group member crash (stops polling, "
+                          "never leaves) -> coordinator expires it at "
+                          "session timeout, survivors inherit its "
+                          "partitions at the committed frontier",
+    "runner.kill_shard_leader": "abrupt SHARD leader death on the "
+                                "partitioned cluster -> its follower is "
+                                "promoted at a bumped epoch; one map "
+                                "entry moves, the rest keep serving",
 }
 
 #: actions each site actually interprets — validated at engine build so
@@ -85,6 +93,8 @@ POINT_ACTIONS: Dict[str, frozenset] = {
     "trainer.poll": frozenset({"error", "delay"}),
     "runner.kill_leader": frozenset({"kill_leader"}),
     "runner.crash_broker": frozenset({"crash_broker"}),
+    "runner.kill_member": frozenset({"kill_member"}),
+    "runner.kill_shard_leader": frozenset({"kill_shard_leader"}),
 }
 
 _EXCEPTIONS = {"ConnectionError": ConnectionError, "OSError": OSError,

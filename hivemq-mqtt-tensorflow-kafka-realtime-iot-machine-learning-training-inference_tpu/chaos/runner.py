@@ -218,6 +218,8 @@ class ChaosRunner:
                 report = self._run_wire(eng)
             elif self.schedule.topology == "store":
                 report = self._run_store(eng, span_path)
+            elif self.schedule.topology == "cluster":
+                report = self._run_cluster(eng)
             else:
                 report = self._run_inproc(eng, span_path)
         finally:
@@ -518,6 +520,208 @@ class ChaosRunner:
                 f"vehicles/sensor/data/{gen.scenario.car_id(i)}",
                 json.dumps(rec).encode(), qos=1)
         return n
+
+    # --------------------------------------------------------- cluster
+    def _run_cluster(self, eng: faults.ChaosEngine) -> ChaosReport:
+        """Rebalance-under-chaos on a partitioned 3-broker cluster.
+
+        Three group members score a 6-partition topic through routed
+        ``ClusterClient``s (group protocol pinned to the coordinator
+        broker).  Mid-epoch a member is killed (crash semantics: stops
+        polling, never leaves; the coordinator expires it and survivors
+        inherit its partitions at the committed frontier), then a shard
+        LEADER is killed after replication drains to zero lag and its
+        follower is promoted at a bumped epoch — one shard's map entry
+        moves, nothing else.  The proof is record-identity exact-once:
+        the multiset of (partition, offset) scored across all members
+        equals the set of records in the logs — zero lost, zero
+        double-scored — plus monotonic commits and the epoch/assignment
+        evidence of both failures actually happening."""
+        import time as _time
+
+        from ..cluster import ClusterController
+        from ..stream.group import GroupConsumer
+        from ..stream.kafka_wire import RemoteGroupCoordinator
+
+        n_parts, n_members = 6, 3
+        victim_shard = 2  # a non-coordinator shard (coordinator death
+        # is tested separately; here the GROUP must survive both kills)
+        ctl = ClusterController(brokers=3, replicated=True,
+                                replica_sync="manual",
+                                mirror_groups=(GROUP,))
+        ctl.start()
+        commit_log: List[tuple] = []
+        # group commits land on the COORDINATOR broker (shard 0):
+        # fenced commits route through its GroupCoordinator
+        _record_commits(ctl.brokers[0], commit_log, "coordinator")
+        published = rewinds = 0
+        scored: List[List[Tuple[int, int]]] = [[] for _ in range(n_members)]
+        clients = []
+        members: List[Optional[GroupConsumer]] = []
+        try:
+            ctl.create_topic(IN_TOPIC, partitions=n_parts)
+            ctl.create_topic(PRED_TOPIC, partitions=n_members)
+            producer = ctl.client(client_id="chaos-cluster-producer")
+            clients.append(producer)
+            for m in range(n_members):
+                c = ctl.client(client_id=f"chaos-cluster-m{m}")
+                clients.append(c)
+                coord = RemoteGroupCoordinator(c, GROUP,
+                                               session_timeout_ms=1500)
+                members.append(GroupConsumer(coord, [IN_TOPIC]))
+
+            killed_member: Optional[int] = None
+            killed_shard = False
+
+            def drive_member(m: int) -> int:
+                nonlocal rewinds
+                gc = members[m]
+                if gc is None:
+                    return 0
+                try:
+                    batch = gc.poll(4096)
+                    if not batch:
+                        return 0
+                    for msg in batch:
+                        scored[m].append((msg.partition, msg.offset))
+                        clients[m + 1].produce(
+                            PRED_TOPIC,
+                            f"{msg.partition}:{msg.offset}".encode(),
+                            key=msg.key, partition=m)
+                    # commit AFTER scoring the whole poll: the member's
+                    # committed frontier == its scored frontier, so an
+                    # inheritor never re-scores (the zero-dup invariant)
+                    gc.commit()
+                    return len(batch)
+                except ConnectionError:
+                    gc.rewind_to_committed()
+                    rewinds += 1
+                    return 0
+
+            def run_due_events():
+                nonlocal killed_member, killed_shard
+                for ev in eng.due_runner_events(published):
+                    if ev.action == "kill_member" and killed_member is None:
+                        # crash, not leave: stop polling member 2 — the
+                        # coordinator expires it at session timeout and
+                        # survivors inherit its committed frontier
+                        killed_member = n_members - 1
+                        members[killed_member] = None
+                        eng.note_runner_fired(ev)
+                    elif ev.action == "kill_shard_leader" \
+                            and not killed_shard:
+                        # zero-lag handoff (the wire drill's contract):
+                        # drain replication, then kill the leader and
+                        # promote its follower at a bumped epoch
+                        while ctl.sync_replicas_once() > 0:
+                            pass
+                        ctl.fail_shard(victim_shard)
+                        killed_shard = True
+                        eng.note_runner_fired(ev)
+
+            def produce_tick(tick: int) -> int:
+                entries = [(f"car_{tick}_{i}".encode(),
+                            f"r{tick}:{i}".encode(), 0)
+                           for i in range(CARS_PER_TICK)]
+                for attempt in range(3):
+                    try:
+                        producer.produce_many(IN_TOPIC, entries)
+                        return len(entries)
+                    except ConnectionError:
+                        # kills land between ticks: the dead broker
+                        # cannot have applied this batch — re-route and
+                        # redeliver (NOT_LEADER re-routes internally)
+                        if attempt == 2:
+                            raise
+                return 0
+
+            ticks = max(1, -(-self.schedule.records // CARS_PER_TICK))
+            for tick in range(ticks):
+                run_due_events()
+                published += produce_tick(tick)
+                if not killed_shard:
+                    ctl.sync_replicas_once()
+                for m in range(n_members):
+                    drive_member(m)
+            run_due_events()
+            # final drain: outlast the dead member's session timeout so
+            # survivors inherit and finish its partitions
+            deadline = _time.monotonic() + 30.0
+            while _time.monotonic() < deadline:
+                moved = sum(drive_member(m) for m in range(n_members))
+                live = [gc for gc in members if gc is not None]
+                if not moved and all(gc.at_end() for gc in live):
+                    # at_end is only trustworthy once every partition is
+                    # assigned to a survivor (the dead member's
+                    # partitions reassign after expiry)
+                    assigned = set()
+                    for gc in live:
+                        assigned.update(gc.assignment)
+                    if assigned == {(IN_TOPIC, p)
+                                    for p in range(n_parts)}:
+                        break
+                _time.sleep(0.05)
+        finally:
+            for c in clients:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+            ctl.stop()
+
+        # exact-once over record identities: everything in the logs,
+        # once each, across all members
+        expected = set()
+        for p in range(n_parts):
+            end = ctl.serving[ctl.pmap.shard_for(IN_TOPIC, p)] \
+                .end_offset(IN_TOPIC, p)
+            expected.update((p, o) for o in range(end))
+        flat = [ident for member in scored for ident in member]
+        dupes = len(flat) - len(set(flat))
+        missing = expected - set(flat)
+        extra = set(flat) - expected
+        total_scored = len(flat)
+        invariants = [
+            _check_counts(published, total_scored, eng.dropped_count),
+            _check_commits_monotonic(commit_log),
+            Invariant(
+                "zero_records_lost",
+                not missing and not extra,
+                f"all {len(expected)} log records scored"
+                if not missing and not extra else
+                f"{len(missing)} records NEVER SCORED "
+                f"(e.g. {sorted(missing)[:3]}); {len(extra)} phantom"),
+            Invariant(
+                "zero_double_scored",
+                dupes == 0,
+                f"{total_scored} scores over {len(set(flat))} unique "
+                f"records" + ("" if dupes == 0 else
+                              f"; {dupes} DOUBLE-SCORED")),
+            Invariant(
+                "member_death_rebalanced",
+                killed_member is not None and any(
+                    gc is not None and gc.rebalances > 0
+                    for gc in members),
+                "survivors rebalanced and inherited the dead member's "
+                "partitions" if killed_member is not None else
+                "member was never killed"),
+            Invariant(
+                "shard_failover_one_shard_only",
+                killed_shard and ctl.pmap.epoch(victim_shard) == 1
+                and all(ctl.pmap.epoch(s) == 0 for s in range(3)
+                        if s != victim_shard),
+                f"shard {victim_shard} at epoch "
+                f"{ctl.pmap.epoch(victim_shard)}, every other shard "
+                f"untouched at epoch 0" if killed_shard else
+                "shard leader was never killed"),
+        ]
+        return ChaosReport(
+            scenario=self.schedule.name, seed=self.schedule.seed,
+            records=self.schedule.records, topology="cluster",
+            published=published, scored=total_scored, rewinds=rewinds,
+            dropped_accounted=eng.dropped_count,
+            injected=dict(sorted(eng.injected.items())),
+            invariants=invariants, span_path=None)
 
     # ------------------------------------------------------------ wire
     def _run_wire(self, eng: faults.ChaosEngine) -> ChaosReport:

@@ -29,6 +29,11 @@ Built-ins:
 - ``broker-crash-recover`` (store): the durable broker dies mid-write
   (torn frame on the active segment); remount recovers, acked records
   re-serve, consumers resume from their persisted committed offsets.
+- ``rebalance-under-chaos`` (cluster): on a 3-broker partitioned
+  cluster, a consumer-group member dies mid-epoch and then a shard
+  leader dies mid-epoch; the runner proves every produced record was
+  scored exactly once (zero lost, zero double-scored) across the
+  rebalance and the per-shard failover.
 """
 
 from __future__ import annotations
@@ -69,7 +74,7 @@ class Schedule:
     name: str
     seed: int
     records: int
-    topology: str  # "inproc" | "wire" | "store" (durable broker)
+    topology: str  # "inproc" | "wire" | "store" | "cluster"
     events: Tuple[FaultEvent, ...]
 
     def lines(self) -> List[str]:
@@ -158,6 +163,29 @@ def _broker_crash_recover(rng: random.Random, records: int) -> list:
     return events
 
 
+def _rebalance_under_chaos(rng: random.Random, records: int) -> list:
+    # the cluster drill: a consumer-group member dies mid-epoch, then a
+    # SHARD leader dies mid-epoch (after the member's rebalance window
+    # opens) — the runner asserts every produced record is scored
+    # EXACTLY once across both: survivors inherit the dead member's
+    # partitions at its committed frontier, and the promoted shard
+    # follower serves identical offsets.  A few wire recv delays ride
+    # along so routing retries happen under an unquiet clock.
+    lo, hi = max(1, records // 3), max(2, (2 * records) // 3)
+    mid = (lo + hi) // 2
+    events = [
+        FaultEvent(rng.randint(lo, max(lo + 1, mid)),
+                   "runner.kill_member", "kill_member"),
+        FaultEvent(rng.randint(mid + 1, max(mid + 2, hi)),
+                   "runner.kill_shard_leader", "kill_shard_leader"),
+    ]
+    for _ in range(3):
+        events.append(FaultEvent(rng.randint(1, max(2, records // 20)),
+                                 "kafka_wire.recv", "delay",
+                                 params=(("seconds", 0.001),)))
+    return events
+
+
 def _loss_bug_fixture(rng: random.Random, records: int) -> list:
     # the seeded bug: one delivery silently lost — NOT ledgered, so the
     # scored-or-accounted invariant must fail (the checker's own test)
@@ -200,6 +228,11 @@ SCENARIOS: Dict[str, Tuple[Callable, str, str]] = {
         _broker_crash_recover, "store",
         "durable broker killed mid-write; remount recovers the torn "
         "tail, acked records re-serve, consumers resume from committed"),
+    "rebalance-under-chaos": (
+        _rebalance_under_chaos, "cluster",
+        "3-broker cluster: a group member AND a shard leader die "
+        "mid-epoch; every record scored exactly once across the "
+        "rebalance + per-shard failover"),
 }
 
 

@@ -44,7 +44,8 @@ class Platform:
                  registry_port: int = 0, ksql_port: int = 0,
                  connect_port: int = 0, host: str = "127.0.0.1",
                  retention_messages: Optional[int] = None, cc_port: int = 0,
-                 store_dir: Optional[str] = None, store_policy=None):
+                 store_dir: Optional[str] = None, store_policy=None,
+                 trusted_passthrough: Optional[bool] = None):
         from ..connect import ConnectServer, ConnectWorker
         from ..core.schema import CAR_SCHEMA, KSQL_CAR_SCHEMA
         from ..mqtt.bridge import KafkaBridge
@@ -94,9 +95,22 @@ class Platform:
         # and rejects produces without the engine's grant; a wire/native
         # client with SASL creds gets TOPIC_AUTHORIZATION_FAILED instead
         # of silently forking the validated stream (ADVICE.md round-5).
+        #
+        # Exposure policy (the rest of that finding): trust DEFAULTS OFF
+        # when the wire server binds a non-loopback address — an exposed
+        # platform's threat model includes the broker-side grant being
+        # misconfigured, so pass-through batches are fully re-validated
+        # there unless the operator opts back in.  On loopback the
+        # engine trusts its own encoder but still SAMPLE-VALIDATES one
+        # batch in 32 (catches encoder regressions, ~3% of the cost).
+        exposed = host not in ("127.0.0.1", "localhost", "::1")
+        if trusted_passthrough is None:
+            trusted_passthrough = not exposed
         owner = self.broker.restrict_topic("SENSOR_DATA_S_AVRO")
         self.sql = SqlEngine(self.broker, registry=self.registry,
-                             trusted_passthrough=True, owner_token=owner)
+                             trusted_passthrough=trusted_passthrough,
+                             owner_token=owner,
+                             passthrough_sample=32)
         install_reference_pipeline(self.sql)
         self.ksql = KsqlServer(self.sql, host=host, port=ksql_port)
 
@@ -367,6 +381,12 @@ def main(argv=None) -> int:
                          "iotml.supervise supervisor (crashed serving "
                          "threads restart under backoff; unit states on "
                          "/healthz).  Also enabled by IOTML_SUPERVISE=1.")
+    ap.add_argument("--trust-passthrough", dest="trust_passthrough",
+                    action="store_true", default=None,
+                    help="opt back into trusted pass-through on a "
+                         "non-loopback host (default: exposed platforms "
+                         "fully re-validate pass-through batches; "
+                         "loopback trusts with 1-in-32 sampling)")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
 
@@ -395,7 +415,8 @@ def main(argv=None) -> int:
                         connect_port=args.connect_port,
                         store_dir=store_dir,
                         store_policy=(StorePolicy.from_config(cfg.store)
-                                      if store_dir else None))
+                                      if store_dir else None),
+                        trusted_passthrough=args.trust_passthrough)
     except ValueError as e:  # e.g. negative retention: clean usage error
         ap.error(str(e))
     plat.start(metrics_port=args.metrics_port)

@@ -1,0 +1,328 @@
+"""ClusterController — boot, watch, and fail over a partitioned cluster.
+
+One controller owns N shard brokers (``ShardBroker`` + ``KafkaWireServer``
+each), the shared ``PartitionMap``, and optionally one ``FollowerReplica``
+per shard.  It is the ZooKeeper-controller role of the reference's
+3-broker deployment (PAPER.md L3), scoped the way this rebuild scopes
+infrastructure: in-process objects speaking the real wire protocol, so
+the same code drives tests, chaos drills, the CLI and the bench.
+
+Topology on disk (``store_root=``)::
+
+    <store_root>/broker-0/          shard 0's store (its partitions only)
+    <store_root>/broker-1/
+    ...
+    <store_root>/broker-0-replica/  shard 0's follower (replicated=True)
+
+Failover is PER SHARD: a dead shard leader's follower is promoted at a
+bumped epoch and only that shard's map entry moves — clients of every
+other shard never notice.  Group coordination is pinned to one shard's
+live leader; if THAT shard fails over, the promoted follower serves the
+mirrored committed offsets and groups re-form against it (membership is
+in-memory by design — exactly a Kafka coordinator change).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..obs import metrics as obs_metrics
+from ..stream.kafka_wire import KafkaWireServer
+from ..stream.replica import FollowerReplica
+from .partition_map import PartitionMap
+from .shard import ShardBroker
+
+
+def _split(addr: str) -> Tuple[str, int]:
+    host, port = addr.rsplit(":", 1)
+    return host, int(port)
+
+
+class ShardView:
+    """One node's view of the cluster — what its wire server consults to
+    answer Metadata (per-partition leaders), FIND_COORDINATOR (the
+    pinned node) and to advertise the broker list."""
+
+    def __init__(self, pmap: PartitionMap, node_id: int):
+        self.pmap = pmap
+        self.node_id = node_id
+
+    def brokers(self) -> List[Tuple[int, str, int]]:
+        return [(i, *_split(addr))
+                for i, addr in enumerate(self.pmap.addresses())]
+
+    def leader_node(self, topic: str, partition: int) -> int:
+        return self.pmap.shard_for(topic, partition)
+
+    def coordinator(self) -> Tuple[int, str, int]:
+        shard, addr = self.pmap.coordinator()
+        return (shard, *_split(addr))
+
+
+class ClusterController:
+    """Boot N shard brokers behind one PartitionMap.
+
+    Args:
+      brokers: shard count (the reference ran 3).
+      store_root: durable mode — each shard mounts
+        ``<store_root>/broker-<i>`` (cold restart resumes every shard
+        from its own dirs).
+      replicated: one FollowerReplica per shard, enabling
+        ``fail_shard`` / supervised per-shard failover.
+      replica_sync: "thread" starts each follower's background sync
+        loop; "manual" leaves stepping to the caller
+        (``sync_replicas_once`` — deterministic runners).
+      mirror_groups: consumer groups whose committed offsets the
+        followers mirror (survive a shard/coordinator failover).
+      coordinator_shard: which shard's live leader holds group state.
+      base_port: fixed listen ports — shard *i* binds ``base_port + i``
+        and its follower ``base_port + n + i`` (deployments expose a
+        known port range); default lets the OS pick ephemeral ports.
+      advertise_host: the hostname clients should dial (a k8s Service
+        name, a LB address) when it differs from the bind ``host`` —
+        Metadata, the PartitionMap, and failover publishes all carry
+        it.  A wildcard bind (0.0.0.0/::) is never advertised: local
+        clients get 127.0.0.1 when no advertise_host is given.
+    """
+
+    def __init__(self, brokers: int = 3, host: str = "127.0.0.1",
+                 store_root: Optional[str] = None, store_policy=None,
+                 replicated: bool = False, replica_sync: str = "thread",
+                 mirror_groups: Tuple[str, ...] = (),
+                 coordinator_shard: int = 0,
+                 base_port: Optional[int] = None,
+                 advertise_host: Optional[str] = None):
+        if brokers < 1:
+            raise ValueError("brokers must be >= 1")
+        if replica_sync not in ("thread", "manual"):
+            raise ValueError("replica_sync is 'thread' or 'manual'")
+        self.n = int(brokers)
+        self.host = host
+        self._store_root = store_root
+        self._replica_sync = replica_sync
+        self._mirror_groups = tuple(mirror_groups)
+        # the address brokers REACH EACH OTHER at (follower sync) vs the
+        # one clients are TOLD to dial (Metadata / PartitionMap)
+        connect_host = "127.0.0.1" if host in ("0.0.0.0", "::") else host
+        self._adv_host = advertise_host or connect_host
+        self.brokers: List[ShardBroker] = []
+        self.servers: List[KafkaWireServer] = []
+        self._killed = [False] * self.n
+        for i in range(self.n):
+            owns = self._owns_fn(i)
+            store_dir = os.path.join(store_root, f"broker-{i}") \
+                if store_root else None
+            b = ShardBroker(owns, shard_id=i, store_dir=store_dir,
+                            store_policy=store_policy)
+            self.brokers.append(b)
+            self.servers.append(KafkaWireServer(
+                b, host=host,
+                port=(base_port + i) if base_port else 0))
+        addresses = [f"{self._adv_host}:{s.port}" for s in self.servers]
+        local_addresses = [f"{connect_host}:{s.port}"
+                           for s in self.servers]
+        self.pmap = PartitionMap(addresses,
+                                 coordinator_shard=coordinator_shard)
+        for i, srv in enumerate(self.servers):
+            srv.cluster = ShardView(self.pmap, i)
+        # durable cold restart: the manifests already re-created each
+        # shard's topics during mount — surface them in the map so
+        # clients and assignors see the full width immediately
+        for b in self.brokers:
+            for t in b.topics():
+                self.pmap.register_topic(t, b.topic(t).partitions)
+        #: per shard: the broker currently SERVING it (the leader until
+        #: a failover, then the promoted follower's local broker)
+        self.serving: List[ShardBroker] = list(self.brokers)
+        self.replicas: List[Optional[FollowerReplica]] = [None] * self.n
+        if replicated:
+            for i in range(self.n):
+                owns = self._owns_fn(i)
+                rep_dir = os.path.join(store_root, f"broker-{i}-replica") \
+                    if store_root else None
+                local = ShardBroker(owns, shard_id=i, store_dir=rep_dir,
+                                    store_policy=store_policy)
+                # only the COORDINATOR shard's follower mirrors group
+                # offsets: the cluster pins all offset state to the
+                # coordinator broker (other brokers answer
+                # NOT_COORDINATOR), and a promoted coordinator-follower
+                # inherits the whole table with the role
+                groups = self._mirror_groups \
+                    if i == coordinator_shard else ()
+                rep = FollowerReplica(
+                    local_addresses[i], groups=groups, host=host,
+                    port=(base_port + self.n + i) if base_port else 0,
+                    partition_filter=owns, local=local)
+                # a promoted follower must keep answering cluster-shaped
+                # metadata (per-partition leaders, pinned coordinator)
+                rep.server.cluster = ShardView(self.pmap, i)
+                self.replicas[i] = rep
+        self.started = False
+
+    def _owns_fn(self, shard: int):
+        n = self.n
+        return lambda t, p, _i=shard: p % n == _i
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> "ClusterController":
+        for srv in self.servers:
+            srv.start()
+        for rep in self.replicas:
+            if rep is None:
+                continue
+            if self._replica_sync == "thread":
+                rep.start()          # sync loop + serving follower
+            else:
+                rep.server.start()   # serve only; caller steps sync
+        self.started = True
+        return self
+
+    def stop(self) -> None:
+        for rep in self.replicas:
+            if rep is not None:
+                try:
+                    rep.stop()
+                except (OSError, RuntimeError):
+                    pass
+        for i, srv in enumerate(self.servers):
+            if not self._killed[i]:
+                try:
+                    srv.kill()
+                except (OSError, RuntimeError):
+                    pass
+                self._killed[i] = True
+        for b in self.brokers:
+            try:
+                b.close()
+            except (OSError, RuntimeError):
+                pass
+        self.started = False
+
+    def __enter__(self) -> "ClusterController":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------- topics
+    def create_topic(self, name: str, partitions: int = 1,
+                     **retention) -> None:
+        """Provision a topic CLUSTER-WIDE: every shard broker learns the
+        full spec (and mounts only its own partitions); the map records
+        the width for clients and assignors."""
+        for b in self.brokers:
+            b.create_topic(name, partitions=partitions, **retention)
+        for rep in self.replicas:
+            if rep is not None:
+                rep.local.create_topic(name, partitions=partitions,
+                                       **retention)
+        self.pmap.register_topic(name, partitions)
+
+    # ------------------------------------------------------------ clients
+    def bootstrap(self) -> str:
+        return ",".join(self.pmap.addresses())
+
+    def client(self, **kw):
+        """A routing client sharing this controller's live map."""
+        from .client import ClusterClient
+
+        return ClusterClient(partition_map=self.pmap, **kw)
+
+    def endpoints(self) -> Dict[str, str]:
+        out = {f"broker-{i}": addr
+               for i, addr in enumerate(self.pmap.addresses())}
+        shard, addr = self.pmap.coordinator()
+        out["coordinator"] = f"{addr} (shard {shard})"
+        if self._store_root:
+            out["store"] = self._store_root
+        return out
+
+    # ---------------------------------------------------------- failover
+    def sync_replicas_once(self) -> int:
+        """Step every live follower one replication round (deterministic
+        runners; replica_sync='manual')."""
+        copied = 0
+        for i, rep in enumerate(self.replicas):
+            if rep is not None and not rep.promoted:
+                copied += rep.sync_once()
+        return copied
+
+    def kill_shard(self, shard: int) -> None:
+        """Abruptly kill a shard's LEADER server (drills): established
+        connections are severed exactly like a crashed process."""
+        if not self._killed[shard]:
+            self.servers[shard].kill()
+            self._killed[shard] = True
+
+    def fail_shard(self, shard: int) -> str:
+        """Promote the shard's follower into its serving leader at a
+        bumped epoch and publish ONLY this shard's map entry.  Returns
+        the new serving address."""
+        rep = self.replicas[shard]
+        if rep is None:
+            raise RuntimeError(
+                f"shard {shard} has no follower (replicated=False): "
+                f"nothing to promote")
+        was_coordinator = self.pmap.coordinator()[0] == shard
+        self.kill_shard(shard)
+        epoch = self.pmap.epoch(shard) + 1
+        rep.promote(epoch)
+        # publish the ADVERTISED address (promote() reports the bind
+        # address, which may be a wildcard under a deployment)
+        addr = f"{self._adv_host}:{rep.port}"
+        self.pmap.publish(shard, addr, epoch)
+        self.serving[shard] = rep.local
+        obs_metrics.cluster_shard_failovers.inc()
+        if was_coordinator:
+            # the pinned shard moved WITH its follower: clients re-find
+            # the coordinator at the promoted address; membership state
+            # restarts empty (groups re-form), committed offsets were
+            # mirrored by the follower
+            obs_metrics.cluster_coordinator_moves.inc()
+        return addr
+
+    # -------------------------------------------------------- supervision
+    def _shard_alive(self, shard: int) -> bool:
+        host, port = _split(self.pmap.leader(shard))
+        try:
+            with socket.create_connection((host, port), timeout=1.0):
+                return True
+        except OSError:
+            return False
+
+    def supervised(self, poll_interval_s: Optional[float] = None,
+                   probe_failures: int = 3):
+        """A Supervisor probing every shard leader over TCP; a dead
+        leader fires per-shard failover (``fail_shard``) — one shard
+        moves, the rest of the cluster keeps serving untouched.  The
+        caller starts/stops the returned Supervisor."""
+        from ..supervise.supervisor import Supervisor
+
+        sup = Supervisor(poll_interval_s=poll_interval_s,
+                         name="cluster-supervisor")
+        for i in range(self.n):
+            if self.replicas[i] is None:
+                sup.add_probed(f"shard-{i}",
+                               (lambda i=i: self._shard_alive(i)),
+                               probe_failures=probe_failures)
+            else:
+                sup.add_probed(
+                    f"shard-{i}", (lambda i=i: self._shard_alive(i)),
+                    probe_failures=probe_failures,
+                    on_death=(lambda _u, i=i: self.fail_shard(i)))
+        return sup
+
+    def await_failover(self, shard: int, timeout_s: float = 10.0) -> bool:
+        """Block until the shard's map entry moves (a supervised
+        failover completed) or timeout."""
+        cell = self.pmap.cell(shard)
+        start_gen = cell.generation
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if cell.generation != start_gen:
+                return True
+            time.sleep(0.02)
+        return False

@@ -1,0 +1,204 @@
+"""Partition-parallel fleets: scorers and KSQL pumps as consumer groups.
+
+The reference scales inference as a K8s Deployment of predict pods in
+one consumer group over 10 partitions (SURVEY §2.7) — kill a pod and
+its partitions rebalance to survivors.  These helpers are that shape
+over the partitioned cluster: every member is a ``GroupConsumer`` via
+the wire group protocol (coordinator pinned to one broker), fetching
+from whichever shard leads each of its assigned partitions.
+
+Members are DRIVEN, not threaded, by default: ``pump_once()`` advances
+every member one round deterministically (tests, the chaos runner), and
+``start()`` wraps each member in a registered daemon thread for live
+use.  Both fleets expose ``kill(i)`` — stop driving member *i* without
+leaving the group, exactly a crashed pod: after the session timeout the
+coordinator expires it and survivors inherit its partitions at the last
+committed offsets.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Sequence
+
+from ..stream.group import GroupConsumer
+from ..stream.kafka_wire import RemoteGroupCoordinator
+
+
+class _Member:
+    """One fleet member: a group-elastic consumer plus a per-round
+    drive function; `alive` gates driving (kill(i) clears it)."""
+
+    __slots__ = ("name", "consumer", "drive", "alive", "rounds",
+                 "payload", "client")
+
+    def __init__(self, name: str, consumer: GroupConsumer,
+                 drive: Callable[[], int], payload=None, client=None):
+        self.name = name
+        self.consumer = consumer
+        self.drive = drive
+        self.alive = True
+        self.rounds = 0
+        #: the member's worker object (StreamScorer / StreamTask)
+        self.payload = payload
+        #: the member's own broker client — stop() closes its sockets
+        self.client = client
+
+
+class _Fleet:
+    """Shared driving machinery; subclasses build the members."""
+
+    def __init__(self):
+        self.members: List[_Member] = []
+        self._threads: List[Optional[threading.Thread]] = []
+        self._stop = threading.Event()
+
+    def pump_once(self) -> int:
+        """Drive every live member one round; returns records handled."""
+        n = 0
+        for m in self.members:
+            if m.alive:
+                n += m.drive()
+                m.rounds += 1
+        return n
+
+    def kill(self, i: int) -> None:
+        """Stop driving member i WITHOUT leaving the group — the
+        crashed-pod shape: its partitions rebalance to survivors only
+        after the coordinator's session timeout expires it."""
+        self.members[i].alive = False
+
+    def assignments(self) -> List[Sequence]:
+        return [m.consumer.assignment for m in self.members]
+
+    def start(self, poll_interval_s: float = 0.05) -> "_Fleet":
+        from ..supervise.registry import register_thread
+
+        self._stop.clear()
+        self._threads = []
+        for m in self.members:
+            def run(m=m):
+                while not self._stop.is_set():
+                    if m.alive:
+                        moved = m.drive()
+                        m.rounds += 1
+                        if moved:
+                            continue
+                    self._stop.wait(poll_interval_s)
+
+            t = register_thread(threading.Thread(
+                target=run, daemon=True,
+                name=f"iotml-fleet-{m.name}"))
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            if t is not None:
+                t.join(timeout=10)
+        for m in self.members:
+            if m.alive:
+                try:
+                    m.consumer.close()  # commit + clean leave
+                except (ConnectionError, RuntimeError, OSError):
+                    pass
+            # dead members keep crashed-pod semantics (no commit, no
+            # clean leave — the coordinator expires them), but their
+            # sockets must still be released: every member owns a
+            # client with one connection per shard + the coordinator
+            if m.client is not None:
+                try:
+                    m.client.close()
+                except (ConnectionError, RuntimeError, OSError):
+                    pass
+
+
+class ScorerFleet(_Fleet):
+    """N partition-parallel ``StreamScorer`` members in one group.
+
+    Each member owns its slice of the input partitions (the group's
+    assignment) and writes predictions to its OWN partition of the
+    output topic — OutputSequence's per-member global index stays an
+    ordered stream, and downstream consumers see one partition per
+    member exactly like the reference's predict pods.
+
+    Args:
+      client_factory: () -> broker duck-type (a fresh ``ClusterClient``
+        per member — members are independent processes in spirit, and
+        must not share a coordinator connection).
+      model/params: as StreamScorer.
+      in_topic/group: the scored stream and the fleet's group id.
+      out_topic: predictions topic (created with >= n_members
+        partitions by the caller).
+    """
+
+    def __init__(self, client_factory, model, params, n_members: int,
+                 in_topic: str, out_topic: str,
+                 group: str = "scorer-fleet",
+                 session_timeout_ms: int = 10_000,
+                 batch_size: int = 100):
+        super().__init__()
+        from ..data.dataset import SensorBatches
+        from ..serve.scorer import StreamScorer
+        from ..stream.producer import OutputSequence
+
+        self.group = group
+        for i in range(n_members):
+            client = client_factory()
+            coord = RemoteGroupCoordinator(
+                client, group, session_timeout_ms=session_timeout_ms)
+            consumer = GroupConsumer(coord, [in_topic])
+            batches = SensorBatches(consumer, batch_size=batch_size,
+                                    only_normal=False)
+            out = OutputSequence(client, out_topic, partition=i)
+            scorer = StreamScorer(model, params, batches, out)
+
+            def drive(scorer=scorer, consumer=consumer):
+                try:
+                    return scorer.score_available()
+                except ConnectionError:
+                    consumer.rewind_to_committed()
+                    return 0
+
+            self.members.append(
+                _Member(f"scorer-{i}", consumer, drive, payload=scorer,
+                        client=client))
+
+    def scored(self) -> int:
+        return sum(m.payload.scored for m in self.members)
+
+
+class PumpFleet(_Fleet):
+    """N group-elastic KSQL pump members over one task class.
+
+    Each member is an independent ``StreamTask`` instance whose consumer
+    is a ``GroupConsumer`` on the shared group — the task's source
+    partitions split across members and rebalance on death, turning the
+    single-threaded KSQL pump into the reference's scalable
+    stream-processing tier.
+    """
+
+    def __init__(self, client_factory, task_factory, n_members: int,
+                 src_topic: str, group: str = "pump-fleet",
+                 session_timeout_ms: int = 10_000):
+        super().__init__()
+        self.group = group
+        for i in range(n_members):
+            client = client_factory()
+            coord = RemoteGroupCoordinator(
+                client, group, session_timeout_ms=session_timeout_ms)
+            consumer = GroupConsumer(coord, [src_topic])
+            task = task_factory(client, consumer)
+
+            def drive(task=task):
+                try:
+                    return task.process_available()
+                except ConnectionError:
+                    task.consumer.rewind_to_committed()
+                    return 0
+
+            self.members.append(
+                _Member(f"pump-{i}", consumer, drive, payload=task,
+                        client=client))

@@ -1,0 +1,156 @@
+"""PartitionMap — who leads each (topic, partition), at which epoch.
+
+The reference's data plane spreads 10-partition topics over a 3-broker
+cluster (PAPER.md L3: `01_installConfluentPlatform.sh:180-183`), with
+ZooKeeper-backed controllers tracking per-partition leadership.  The
+rebuild's equivalent generalises the single-leader
+``iotml.supervise.Topology`` — one ``(leader, epoch)`` cell — into a map
+of them: one cell **per shard**, plus a static partition→shard policy.
+
+Design decisions:
+
+- **Shard identity is stable; addresses move.**  A shard keeps its id
+  across failovers — the promoted follower inherits the shard, the map
+  publishes its new ``(address, epoch)``, and every other shard's cell
+  is untouched.  "Follower promotion moves one shard, not the world."
+- **The policy is a pure function** (``partition % n_shards``): every
+  party — brokers deciding what they own, clients deciding where to
+  route, the controller deciding what to boot — computes the same
+  answer with no coordination.  The wire protocol's Metadata responses
+  carry the materialized map for external clients.
+- **Cells are ``supervise.Topology`` objects**, so per-shard wire
+  clients built with ``topology=map.cell(shard)`` inherit the whole
+  PR 4 failover machinery unchanged: reconnects re-resolve the shard's
+  live address, and the shard's fencing epoch rides every request as
+  the ``@e<N>`` client-id tag — a moved partition fences its stale
+  leader exactly like the single-leader plane did.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..supervise.topology import Topology
+
+
+class PartitionMap:
+    """Thread-safe (topic, partition) → (broker address, epoch) map.
+
+    Args:
+      leaders: initial leader address per shard (index = shard id).
+      epochs: initial fencing epoch per shard (default all 0).
+      coordinator_shard: the shard whose live leader holds every
+        consumer group's membership and offset state (FIND_COORDINATOR
+        is pinned here — group state must live in exactly one place).
+    """
+
+    def __init__(self, leaders: List[str],
+                 epochs: Optional[List[int]] = None,
+                 coordinator_shard: int = 0):
+        if not leaders:
+            raise ValueError("a cluster needs at least one shard")
+        epochs = epochs or [0] * len(leaders)
+        if len(epochs) != len(leaders):
+            raise ValueError("one epoch per shard")
+        if not 0 <= coordinator_shard < len(leaders):
+            raise ValueError(f"coordinator shard {coordinator_shard} "
+                             f"outside 0..{len(leaders) - 1}")
+        self._lock = threading.Lock()
+        # every OTHER shard's address is each cell's fallback list: a
+        # client that cannot reach its shard's leader still finds a
+        # live broker to refresh metadata from
+        self._cells = [
+            Topology(addr, epoch=epochs[i],
+                     fallback=[a for j, a in enumerate(leaders) if j != i])
+            for i, addr in enumerate(leaders)]
+        self._coordinator_shard = coordinator_shard
+        self._topics: Dict[str, int] = {}
+
+    # ------------------------------------------------------------ policy
+    @property
+    def n_shards(self) -> int:
+        return len(self._cells)
+
+    def shard_for(self, topic: str, partition: int) -> int:
+        """The owning shard — a pure function of the partition index, so
+        brokers, clients and the controller agree with no coordination."""
+        return int(partition) % len(self._cells)
+
+    # ------------------------------------------------------------ topics
+    def register_topic(self, name: str, partitions: int) -> None:
+        with self._lock:
+            self._topics[name] = int(partitions)
+
+    def topics(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._topics)
+
+    def partitions_of(self, shard: int, topic: str) -> List[int]:
+        """The partition indexes of `topic` this shard owns."""
+        with self._lock:
+            n = self._topics.get(topic, 0)
+        return [p for p in range(n) if self.shard_for(topic, p) == shard]
+
+    # ----------------------------------------------------------- resolve
+    def cell(self, shard: int) -> Topology:
+        """The shard's live (leader, epoch) cell — hand it to
+        ``KafkaWireBroker(topology=...)`` and the client re-resolves the
+        shard's address + fencing epoch on every reconnect."""
+        return self._cells[shard]
+
+    def resolve(self, topic: str, partition: int
+                ) -> Tuple[List[str], int]:
+        """(servers, epoch) for the shard owning (topic, partition):
+        live leader first, every other known broker as fallback."""
+        return self._cells[self.shard_for(topic, partition)].resolve()
+
+    def leader(self, shard: int) -> str:
+        return self._cells[shard].leader
+
+    def epoch(self, shard: int) -> int:
+        return self._cells[shard].epoch
+
+    def addresses(self) -> List[str]:
+        """Current leader address per shard (index = shard id)."""
+        return [c.leader for c in self._cells]
+
+    @property
+    def generation(self) -> int:
+        """Cheap change detector: total publishes across all cells."""
+        return sum(c.generation for c in self._cells)
+
+    # ------------------------------------------------------- coordinator
+    @property
+    def coordinator_shard(self) -> int:
+        with self._lock:
+            return self._coordinator_shard
+
+    def coordinator(self) -> Tuple[int, str]:
+        """(shard id, live address) of the pinned group coordinator."""
+        with self._lock:
+            shard = self._coordinator_shard
+        return shard, self._cells[shard].leader
+
+    def set_coordinator(self, shard: int) -> None:
+        """Re-pin group coordination (operator/controller action after a
+        coordinator broker is lost beyond its own shard failover)."""
+        if not 0 <= shard < len(self._cells):
+            raise ValueError(f"no shard {shard}")
+        with self._lock:
+            self._coordinator_shard = shard
+
+    # ----------------------------------------------------------- publish
+    def publish(self, shard: int, leader: str, epoch: int) -> None:
+        """Install a shard's new leadership term (failover): ONE cell
+        moves; the Topology's monotonic-epoch check rejects a belated
+        publish from a slow failover path.  Every other cell learns the
+        new address as a fallback replacement for the old one."""
+        old = self._cells[shard].leader
+        self._cells[shard].publish(leader, epoch)
+        for i, c in enumerate(self._cells):
+            if i != shard:
+                # swap the moved shard's address in the other cells'
+                # fallback lists so metadata refreshes keep working
+                # through any shard's client
+                c.replace_fallback(old, leader)

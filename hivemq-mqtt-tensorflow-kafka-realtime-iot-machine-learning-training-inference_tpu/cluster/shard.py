@@ -1,0 +1,76 @@
+"""ShardBroker — a Broker that serves only its shard's partitions.
+
+Each cluster node runs one of these: topics carry their full cluster
+partition count (so metadata, key-hash partitioning and consumer-group
+assignment all see the real width), but only the partitions the shard
+OWNS are materialized — in memory, or as mounted ``iotml.store``
+per-partition segment dirs under the shard's own store directory.  Any
+touch of an unowned partition raises ``NotLeaderForPartitionError``,
+which the wire server answers as Kafka error 6 and routing clients
+(``ClusterClient``) turn into a metadata refresh + re-route.
+
+Consumer-group offsets are deliberately NOT ownership-filtered: the
+cluster pins all group state to one coordinator broker, and that broker
+commits/serves offsets for every partition regardless of which shard
+stores the records.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..stream.broker import Broker
+from ..stream.kafka_wire import NotLeaderForPartitionError
+
+
+class _UnownedPartition:
+    """Placeholder for a partition this shard does not lead: every
+    touch-point raises the routing signal.  Nothing is mounted — the
+    shard's store dir holds only its own partitions' segments."""
+
+    __slots__ = ("topic", "partition")
+
+    def __init__(self, topic: str, partition: int):
+        self.topic = topic
+        self.partition = partition
+
+    def _refuse(self, *_a, **_kw):
+        raise NotLeaderForPartitionError(self.topic, self.partition)
+
+    # the full _Partition touch-point surface, all refusing
+    append = sync_batch = note_replay = _refuse
+    end = base = read = drop_head = enforce_retention = _refuse
+    align_base = reset = offset_for_timestamp = _refuse
+
+
+class ShardBroker(Broker):
+    """``Broker`` whose partitions are filtered by an ownership predicate.
+
+    Args:
+      owns: ``(topic, partition) -> bool`` — typically
+        ``lambda t, p: pmap.shard_for(t, p) == shard_id``.  Must be pure
+        and stable for the broker's lifetime: ownership *moves* by
+        promoting this shard's follower (a new broker object), never by
+        mutating a live broker's predicate.
+      shard_id: this node's id in the cluster (metadata/diagnostics).
+      store_dir / store_policy: as ``Broker`` — only owned partitions
+        mount segment logs under the dir.
+    """
+
+    def __init__(self, owns: Callable[[str, int], bool],
+                 shard_id: Optional[int] = None,
+                 store_dir: Optional[str] = None, store_policy=None):
+        # set BEFORE super().__init__: a durable mount re-creates the
+        # manifest's topics during construction, which calls
+        # _make_partition for every partition
+        self._owns_fn = owns
+        self.shard_id = shard_id
+        super().__init__(store_dir=store_dir, store_policy=store_policy)
+
+    def owns(self, topic: str, partition: int) -> bool:
+        return bool(self._owns_fn(topic, partition))
+
+    def _make_partition(self, topic: str, partition: int):
+        if not self._owns_fn(topic, partition):
+            return _UnownedPartition(topic, partition)
+        return super()._make_partition(topic, partition)

@@ -30,9 +30,75 @@
 #include <charconv>
 #include <cmath>
 #include <cstdint>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 
+// GCC 10's libstdc++ ships integer std::to_chars only — the
+// floating-point overloads (P0067R5) arrived in GCC 11.  The engine's
+// contract needs exactly two conversions: the shortest round-trip form
+// and the correctly-rounded fixed 8-fractional-digit form.  Where FP
+// to_chars exists we use it; otherwise a portable snprintf-based
+// fallback supplies the same bytes: shortest = the smallest %.*e
+// precision that round-trips through strtof/strtod (correct rounding at
+// the minimal precision selects the same closest-among-shortest digits
+// to_chars does), fixed-8 = %.8f (glibc printf is correctly rounded).
+// Parity across both paths is pinned by tests/test_fastfmt.py.
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
+#define IOTML_HAVE_FP_TO_CHARS 1
+#else
+#define IOTML_HAVE_FP_TO_CHARS 0
+#endif
+
 namespace {
+
+#if IOTML_HAVE_FP_TO_CHARS
+
+template <typename T>
+int shortest_chars(T value, char* buf, int cap) {
+  auto res = std::to_chars(buf, buf + cap, value);
+  return static_cast<int>(res.ptr - buf);
+}
+
+int fixed8_chars(double value, char* buf, int cap) {
+  auto res = std::to_chars(buf, buf + cap, value,
+                           std::chars_format::fixed, 8);
+  return static_cast<int>(res.ptr - buf);
+}
+
+#else  // GCC 10 fallback: snprintf + round-trip minimal precision
+
+inline bool roundtrips(const char* buf, float value) {
+  return std::strtof(buf, nullptr) == value;
+}
+inline bool roundtrips(const char* buf, double value) {
+  return std::strtod(buf, nullptr) == value;
+}
+
+template <typename T>
+int shortest_chars(T value, char* buf, int cap) {
+  // max_digits10: 9 (float) / 17 (double) always round-trips
+  const int max_prec = sizeof(T) == 4 ? 9 : 17;
+  int n = 0;
+  for (int prec = 1; prec <= max_prec; ++prec) {
+    // %.*e prints `prec` significant digits (1 before the point,
+    // prec-1 after): the scientific form parses identically to
+    // to_chars general output in format_elem's digit/exponent split
+    n = std::snprintf(buf, cap, "%.*e", prec - 1, double(value));
+    if (roundtrips(buf, value)) break;
+  }
+  // canonicalize to the to_chars shape the parser expects: strip a
+  // zero-padded fraction ("1.000000e+01" never appears at minimal
+  // precision) and the exponent's leading zeros/'+' don't matter to
+  // the parser, so the snprintf form is accepted as-is.
+  return n;
+}
+
+int fixed8_chars(double value, char* buf, int cap) {
+  return std::snprintf(buf, cap, "%.8f", value);
+}
+
+#endif  // IOTML_HAVE_FP_TO_CHARS
 
 constexpr int kLinewidth = 75;
 constexpr int kElemW = kLinewidth - 1;  // minus max(len(sep.rstrip()), ']')
@@ -46,8 +112,7 @@ constexpr int kElemW = kLinewidth - 1;  // minus max(len(sep.rstrip()), ']')
 template <typename T>
 int format_elem(T value, double exact, char* word, int* dot) {
   char buf[64];
-  auto res = std::to_chars(buf, buf + sizeof buf, value);
-  int n = static_cast<int>(res.ptr - buf);
+  int n = shortest_chars(value, buf, sizeof buf);
   buf[n] = '\0';
   // parse shortest form: [-]digits[.digits][e±dd]
   int w = 0;
@@ -101,9 +166,7 @@ int format_elem(T value, double exact, char* word, int* dot) {
   if (frac > 8) {
     // cutoff: correctly-rounded fixed 8-fractional-digit conversion of
     // the exact value, trailing zeros trimmed
-    auto r2 = std::to_chars(buf, buf + sizeof buf, exact,
-                            std::chars_format::fixed, 8);
-    int n2 = static_cast<int>(r2.ptr - buf);
+    int n2 = fixed8_chars(exact, buf, sizeof buf);
     // trim='.': strip ALL trailing zeros, keep the bare point ("1.").
     // The loop cannot cross the '.': eligibility guarantees a nonzero
     // digit somewhere (mn >= 1e-4), and integer-part zeros sit left of

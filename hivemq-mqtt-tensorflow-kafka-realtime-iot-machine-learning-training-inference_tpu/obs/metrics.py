@@ -274,6 +274,23 @@ supervisor_degraded = default_registry.gauge(
 supervisor_failovers = default_registry.counter(
     "iotml_supervisor_failovers_total",
     "on_death failover hooks fired (leader promotions)")
+# partitioned data plane (iotml.cluster): routing health — a rising
+# bounce rate means clients chronically chase a moving partition map;
+# failover counters pair with the supervise gauges above
+cluster_not_leader_bounces = default_registry.counter(
+    "iotml_cluster_not_leader_total",
+    "produce/fetch requests bounced with NOT_LEADER_FOR_PARTITION "
+    "(stale client metadata; refreshed and re-routed)")
+cluster_metadata_refreshes = default_registry.counter(
+    "iotml_cluster_metadata_refreshes_total",
+    "cluster metadata refreshes performed by routing clients")
+cluster_shard_failovers = default_registry.counter(
+    "iotml_cluster_shard_failovers_total",
+    "per-shard leader failovers (one shard moved, not the world)")
+cluster_coordinator_moves = default_registry.counter(
+    "iotml_cluster_coordinator_moves_total",
+    "group-coordinator re-discoveries after NOT_COORDINATOR or a "
+    "coordinator broker death")
 # dead-letter queue (streamproc.dlq): poisoned frames routed, by source
 dlq_total = default_registry.counter(
     "iotml_dlq_total",

@@ -583,6 +583,18 @@ class Broker:
         with self._lock:
             return self._group_offsets.get((group, topic, partition))
 
+    def committed_many(self, group: str, pairs):
+        """Committed offsets for [(topic, partition), ...] under ONE
+        lock acquisition; pairs with no committed offset are omitted
+        (same contract as the wire client's one-OffsetFetch version)."""
+        out = {}
+        with self._lock:
+            for t, p in pairs:
+                off = self._group_offsets.get((group, t, p))
+                if off is not None:
+                    out[(t, p)] = off
+        return out
+
     # ---------------------------------------------------------- lifecycle
     def flush(self) -> None:
         """Durable broker: fsync every partition log + the offsets file

@@ -101,6 +101,15 @@ class GroupCoordinator:
         self._subscriptions: Dict[str, Tuple[str, ...]] = {}
         self._assignments: Dict[str, List[TopicPartition]] = {}
         self._last_topics: Dict[str, int] = {}  # metadata at last rebalance
+        # revocation grace (Kafka's PreparingRebalance window): when a
+        # rebalance bumps the generation, every SURVIVING member that has
+        # not yet rejoined is remembered here with its pre-bump
+        # (generation, assignment).  A commit it issues at that old
+        # generation — the "commit before release" a revoked member owes
+        # its successor — is still accepted for its OLD partitions, but
+        # never rewinds an offset (the new owner may have moved it).
+        self._pending_rejoin: Dict[str, Tuple[int,
+                                              List[TopicPartition]]] = {}
         # metadata.max.age.ms analogue: heartbeats between sweeps reuse the
         # cached topic view, so the per-poll cost stays O(1) and a broker
         # whose metadata lookups are network calls isn't probed per poll
@@ -131,11 +140,16 @@ class GroupCoordinator:
             meta = self._topic_metadata(force=True)
             if sub_changed or meta != self._last_topics:
                 self._rebalance(meta)
+            # rejoining at the current generation closes the member's
+            # revocation-grace window: from here on only current-
+            # generation commits are its voice
+            self._pending_rejoin.pop(member_id, None)
             return member_id, self.generation, list(
                 self._assignments.get(member_id, []))
 
     def leave(self, member_id: str) -> None:
         with self._lock:
+            self._pending_rejoin.pop(member_id, None)
             if member_id in self._heartbeats:
                 del self._heartbeats[member_id]
                 del self._subscriptions[member_id]
@@ -171,15 +185,34 @@ class GroupCoordinator:
         partition) actually committed — so callers can flag positions that
         named partitions outside the member's assignment."""
         with self._lock:
-            if member_id not in self._heartbeats or \
-                    generation != self.generation:
+            if member_id in self._heartbeats and \
+                    generation == self.generation:
+                owned = set(self._assignments.get(member_id, []))
+                done = set()
+                for t, p, off in positions:
+                    if (t, p) in owned:
+                        self.broker.commit(self.group_id, t, p, off)
+                        done.add((t, p))
+                return done
+            # revocation grace: a surviving member that hasn't seen the
+            # rebalance yet commits its progress at the OLD generation
+            # before releasing its partitions.  Accepted only for the
+            # partitions it owned THEN, and never backwards — the
+            # inheriting member may already have committed further, and
+            # rewinding its cursor would redeliver history it fenced.
+            pending = self._pending_rejoin.get(member_id)
+            if member_id not in self._heartbeats or pending is None or \
+                    generation != pending[0]:
                 return None
-            owned = set(self._assignments.get(member_id, []))
+            owned = set(pending[1])
             done = set()
             for t, p, off in positions:
-                if (t, p) in owned:
+                if (t, p) not in owned:
+                    continue
+                cur = self.broker.committed(self.group_id, t, p)
+                if cur is None or off >= cur:
                     self.broker.commit(self.group_id, t, p, off)
-                    done.add((t, p))
+                done.add((t, p))
             return done
 
     def sync(self, member_id: str, generation: int
@@ -268,6 +301,9 @@ class GroupCoordinator:
         for m in dead:
             del self._heartbeats[m]
             del self._subscriptions[m]
+            # an EXPIRED member gets no grace: it is presumed crashed,
+            # and a zombie resurfacing must not clobber its successor
+            self._pending_rejoin.pop(m, None)
         if dead:
             self._rebalance()
 
@@ -275,6 +311,15 @@ class GroupCoordinator:
         if topics is None:
             topics = self._topic_metadata(force=True)
         members = sorted(self._heartbeats)
+        # open the revocation-grace window for every surviving member:
+        # until it rejoins, a commit at the outgoing generation is still
+        # its legitimate "commit before release".  The earliest pending
+        # generation wins for a member that misses several rebalances —
+        # its uncommitted progress dates from the assignment it last saw.
+        for m in members:
+            if m not in self._pending_rejoin:
+                self._pending_rejoin[m] = (
+                    self.generation, list(self._assignments.get(m, [])))
         assignments = self.assignor(members, topics)
         # only members subscribed to a topic may receive its partitions
         for m in members:
@@ -316,12 +361,25 @@ class GroupConsumer:
         # partitions resume from the group's committed offset.
         held = ({(t, p): off for t, p, off in self._sc.positions()}
                 if sticky and hasattr(self, "_sc") else {})
+        # ONE OffsetFetch for the whole assignment (remote consumers:
+        # the per-partition committed() loop cost a coordinator round
+        # trip each, on every rebalance)
+        frontier = self.broker.committed_many(self.group, list(assigned)) \
+            if assigned else {}
         specs = []
         for t, p in assigned:
+            committed = frontier.get((t, p))
             if (t, p) in held:
                 off = held[(t, p)]
+                if committed is not None and committed > off:
+                    # the GROUP's committed frontier moved past our held
+                    # cursor: an interim owner consumed this partition
+                    # while we were out of the group (coordinator
+                    # failover, long GC pause).  Trusting the stale
+                    # in-memory cursor would re-read the interim owner's
+                    # committed work — resume at the frontier instead.
+                    off = committed
             else:
-                committed = self.broker.committed(self.group, t, p)
                 off = committed if committed is not None \
                     else self.fallback_offset
             specs.append(f"{t}:{p}:{off}")
@@ -330,6 +388,17 @@ class GroupConsumer:
 
     def _ensure_membership(self) -> None:
         if not self.coord.heartbeat(self.member_id, self.generation):
+            # revocation: commit this member's progress BEFORE releasing
+            # its partitions to the rebalance, inside the coordinator's
+            # grace window — the successor then resumes at our real
+            # frontier instead of redelivering everything since the last
+            # periodic commit.  Best-effort: a fenced/expired member
+            # falls back to plain at-least-once redelivery.
+            try:
+                self.coord.fenced_commit(self.member_id, self.generation,
+                                         self._sc.positions())
+            except ConnectionError:
+                pass  # coordinator moved/died: rejoin below re-resolves
             self.member_id, self.generation, assigned = \
                 self.coord.join(self.topics, self.member_id)
             self._adopt(assigned)
@@ -378,6 +447,12 @@ class GroupConsumer:
         resume cursor), not offset 0."""
         self._adopt([(t, p) for t, p, _ in self._sc.positions()],
                     sticky=False)
+
+    def rewind_to_committed(self) -> None:
+        """Reset in-memory cursors to the group's committed offsets —
+        the redelivery entry point after a ConnectionError mid-drain
+        (same contract as StreamConsumer.rewind_to_committed)."""
+        self._sc.rewind_to_committed()
 
     def commit(self) -> bool:
         """Generation-fenced commit; returns False (and writes nothing) when

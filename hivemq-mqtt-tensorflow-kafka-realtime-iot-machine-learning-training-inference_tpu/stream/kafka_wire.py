@@ -50,6 +50,8 @@ SASL_HANDSHAKE, API_VERSIONS, CREATE_TOPICS = 17, 18, 19
 ERR_NONE = 0
 ERR_OFFSET_OUT_OF_RANGE = 1
 ERR_UNKNOWN_TOPIC = 3
+ERR_NOT_LEADER_FOR_PARTITION = 6
+ERR_NOT_COORDINATOR = 16
 ERR_ILLEGAL_GENERATION = 22
 ERR_UNKNOWN_MEMBER_ID = 25
 ERR_REBALANCE_IN_PROGRESS = 27
@@ -75,7 +77,8 @@ _SUPPORTED = {PRODUCE: (2, 2), FETCH: (2, 2), LIST_OFFSETS: (1, 1),
 # ConnectionError and the caller owns redelivery.  The R2 lint
 # (iotml.analysis) holds every _request call site to this list.
 IDEMPOTENT_APIS = frozenset({FETCH, METADATA, LIST_OFFSETS, OFFSET_FETCH,
-                             API_VERSIONS, SASL_HANDSHAKE, HEARTBEAT})
+                             API_VERSIONS, SASL_HANDSHAKE, HEARTBEAT,
+                             FIND_COORDINATOR})
 
 
 class SaslAuthError(ConnectionError):
@@ -83,6 +86,32 @@ class SaslAuthError(ConnectionError):
     or non-empty auth response) — as opposed to dying mid-handshake.
     Failover must not retry rejected credentials against every
     bootstrap server; connectivity errors it may."""
+
+
+class NotLeaderForPartitionError(ConnectionError):
+    """The addressed broker does not lead this (topic, partition).
+
+    Kafka error 6: the cluster's partition map moved (shard failover,
+    stale client metadata) and this broker — alive and reachable —
+    refuses to serve a partition it doesn't own.  Routing clients
+    (``iotml.cluster.ClusterClient``) catch it, refresh their cached
+    metadata, and retry against the real leader; it subclasses
+    ConnectionError so non-routing callers' existing redelivery loops
+    treat it as the failover signal it is."""
+
+    def __init__(self, topic: str, partition: int):
+        super().__init__(
+            f"broker is not the leader for {topic}:{partition}; refresh "
+            f"metadata and route to the owning broker (Kafka error 6)")
+        self.topic = topic
+        self.partition = partition
+
+
+class CoordinatorMovedError(ConnectionError):
+    """A group/offset request landed on a broker that is not the group
+    coordinator (Kafka error 16, NOT_COORDINATOR).  The caller
+    re-discovers the coordinator via FIND_COORDINATOR and retries —
+    cluster group state is pinned to exactly one broker."""
 
 
 class FencedEpochError(ConnectionError):
@@ -642,12 +671,37 @@ class KafkaWireBroker(ProducePartitionMixin):
         brokers = r.array(broker)
         r.i32()  # controller id
         tops = r.array(topic)
-        meta = {"brokers": brokers, "topics": {}}
+        meta = {"brokers": brokers, "topics": {}, "leaders": {}}
         for err, name, parts in tops:
             if err == ERR_NONE:
                 meta["topics"][name] = len(parts)
                 self._meta[name] = len(parts)
+                for perr, pid, leader in parts:
+                    if perr == ERR_NONE:
+                        # per-partition leader NODE ID (cluster servers
+                        # publish the real owner; classic servers say 0)
+                        meta["leaders"][(name, pid)] = leader
         return meta
+
+    def cluster_metadata(self, topics: Optional[List[str]] = None) -> dict:
+        """Raw metadata: {"brokers": [(node, host, port, rack)],
+        "topics": {name: n_partitions},
+        "leaders": {(topic, partition): node}} — what a routing client
+        (iotml.cluster.ClusterClient) caches and refreshes on
+        NOT_LEADER_FOR_PARTITION."""
+        return self._metadata(topics)
+
+    def find_coordinator(self, group: str) -> Tuple[int, str, int]:
+        """(node_id, host, port) of the group coordinator — in a cluster
+        the one broker holding membership + offset state for `group`."""
+        w = _Writer()
+        w.string(group)
+        r = self._request(FIND_COORDINATOR, 0, bytes(w.buf))
+        err = r.i16()
+        node, host, port = r.i32(), r.string(), r.i32()
+        if err != ERR_NONE:
+            raise RuntimeError(f"find_coordinator({group}): error {err}")
+        return node, host or "", port
 
     def topics(self) -> List[str]:
         return sorted(self._metadata()["topics"])
@@ -745,6 +799,11 @@ class KafkaWireBroker(ProducePartitionMixin):
                     # old leader): nothing was appended — re-resolve and
                     # hand redelivery back to the caller
                     raise self._fenced(f"produce to {topic}:{p}")
+                if err == ERR_NOT_LEADER_FOR_PARTITION:
+                    # sharded cluster: this broker no longer owns the
+                    # partition — nothing appended THERE; the routing
+                    # client refreshes its map and redelivers
+                    raise NotLeaderForPartitionError(topic, p)
                 if err != ERR_NONE:
                     raise RuntimeError(f"produce to {topic}:{p} failed: {err}")
                 last = max(last, base + len(by_part[p]) - 1)
@@ -779,6 +838,8 @@ class KafkaWireBroker(ProducePartitionMixin):
                                                 offset, max(hwm, 0))
                 if err == ERR_UNKNOWN_TOPIC:
                     raise KeyError(topic)
+                if err == ERR_NOT_LEADER_FOR_PARTITION:
+                    raise NotLeaderForPartitionError(tname or topic, pid)
                 if err != ERR_NONE:
                     raise RuntimeError(f"fetch {topic}:{pid} failed: {err}")
                 for off, key, value, ts in decode_message_set(record_set or b""):
@@ -802,6 +863,8 @@ class KafkaWireBroker(ProducePartitionMixin):
             lambda p: (p.i32(), p.i16(), p.i64(), p.i64()))))
         for _, parts in tops:
             for pid, err, ts, off in parts:
+                if err == ERR_NOT_LEADER_FOR_PARTITION:
+                    raise NotLeaderForPartitionError(topic, pid)
                 if err != ERR_NONE:
                     raise RuntimeError(f"list_offsets {topic}:{pid}: {err}")
                 return off
@@ -841,6 +904,10 @@ class KafkaWireBroker(ProducePartitionMixin):
             lambda p: (p.i32(), p.i64(), p.string(), p.i16()))))
         for _, parts in tops:
             for pid, off, _meta, err in parts:
+                if err == ERR_NOT_COORDINATOR:
+                    raise CoordinatorMovedError(
+                        f"offset fetch {topic}:{pid}: broker is not the "
+                        f"coordinator")
                 if err != ERR_NONE:
                     raise RuntimeError(f"offset fetch {topic}:{pid}: {err}")
                 return None if off < 0 else off
@@ -867,6 +934,10 @@ class KafkaWireBroker(ProducePartitionMixin):
         out: Dict[Tuple[str, int], int] = {}
         for tname, parts in tops:
             for pid, off, _meta, err in parts:
+                if err == ERR_NOT_COORDINATOR:
+                    raise CoordinatorMovedError(
+                        f"offset fetch {tname}:{pid}: broker is not the "
+                        f"coordinator")
                 if err != ERR_NONE:
                     raise RuntimeError(f"offset fetch {tname}:{pid}: {err}")
                 if off >= 0:
@@ -915,6 +986,12 @@ class KafkaWireBroker(ProducePartitionMixin):
             return True
         if errs == {ERR_ILLEGAL_GENERATION}:
             return False  # fenced: nothing was written
+        if errs == {ERR_NOT_COORDINATOR}:
+            # the group's coordinator moved (cluster failover): nothing
+            # written here — re-find the coordinator and re-commit
+            raise CoordinatorMovedError(
+                f"offset commit {sorted(by_topic)}: broker is not the "
+                f"coordinator")
         if errs == {ERR_FENCED_LEADER_EPOCH}:
             # leadership-epoch fence (distinct from the generation fence
             # above: this is the whole SERVER relationship being stale,
@@ -948,6 +1025,9 @@ class KafkaWireBroker(ProducePartitionMixin):
         # lost response never leaks a zombie member past session timeout
         r = self._request(JOIN_GROUP, 0, bytes(w.buf))
         err = r.i16()
+        if err == ERR_NOT_COORDINATOR:
+            raise CoordinatorMovedError(
+                f"join group {group}: broker is not the coordinator")
         if err != ERR_NONE:
             raise RuntimeError(f"join group {group}: error {err}")
         generation = r.i32()
@@ -997,6 +1077,9 @@ class KafkaWireBroker(ProducePartitionMixin):
         r = self._request(SYNC_GROUP, 0, bytes(w.buf))
         err = r.i16()
         blob = r.bytes_() or b""
+        if err == ERR_NOT_COORDINATOR:
+            raise CoordinatorMovedError(
+                f"sync group {group}: broker is not the coordinator")
         if err != ERR_NONE:
             raise RuntimeError(f"sync group {group}: error {err}")
         if not blob:
@@ -1014,7 +1097,11 @@ class KafkaWireBroker(ProducePartitionMixin):
         w = _Writer()
         w.string(group).i32(generation).string(member_id)
         r = self._request(HEARTBEAT, 0, bytes(w.buf))
-        return r.i16() == ERR_NONE
+        err = r.i16()
+        if err == ERR_NOT_COORDINATOR:
+            raise CoordinatorMovedError(
+                f"heartbeat {group}: broker is not the coordinator")
+        return err == ERR_NONE
 
     def leave_group(self, group: str, member_id: str) -> None:
         w = _Writer()
@@ -1194,28 +1281,55 @@ class _KafkaConn(socketserver.BaseRequestHandler):
         server_epoch = self.server.epoch     # type: ignore[attr-defined]
         return client_epoch is not None and client_epoch != server_epoch
 
+    def _not_coordinator(self) -> bool:
+        """True when this broker is part of a cluster whose group
+        coordinator is pinned to a DIFFERENT node: group membership and
+        offset state must live in exactly one place, so every other
+        broker answers NOT_COORDINATOR (16) and the client re-finds."""
+        cluster = self.server.cluster        # type: ignore[attr-defined]
+        return cluster is not None and \
+            cluster.coordinator()[0] != cluster.node_id
+
     # ------------------------------------------------------------ handlers
     def _dispatch(self, broker: Broker, api_key: int, r: _Reader, w: _Writer,
                   client_epoch: Optional[int] = None):
+        cluster = self.server.cluster          # type: ignore[attr-defined]
         if api_key == METADATA:
             n = r.i32()
             names = [r.string() for _ in range(max(n, 0))] if n >= 0 else None
             if names is None or n == 0:
                 names = broker.topics()
-            host, port = self.server.server_address[:2]  # type: ignore
-            w.array([(0, host, port, None)],
-                    lambda wr, b: wr.i32(b[0]).string(b[1]).i32(b[2])
-                    .string(b[3]))
-            w.i32(0)  # controller id
+            if cluster is not None:
+                # cluster mode: the broker list is the WHOLE cluster and
+                # every partition names its owning node — the map routing
+                # clients cache (refreshed on NOT_LEADER_FOR_PARTITION)
+                rows = list(cluster.brokers())
+                my_id = cluster.node_id
+            else:
+                host, port = self.server.server_address[:2]  # type: ignore
+                rows = [(0, host, port)]
+                my_id = 0
+            w.array(rows, lambda wr, b: wr.i32(b[0]).string(b[1])
+                    .i32(b[2]).string(None))
+            w.i32(my_id if cluster is None else rows[0][0])  # controller id
 
             def topic_entry(wr, name):
                 known = name in broker.topics()
                 wr.i16(ERR_NONE if known else ERR_UNKNOWN_TOPIC)
                 wr.string(name).i8(0)
                 parts = range(broker.topic(name).partitions) if known else []
-                wr.array(list(parts), lambda pw, p: pw.i16(ERR_NONE).i32(p)
-                         .i32(0).array([0], lambda x, v: x.i32(v))
-                         .array([0], lambda x, v: x.i32(v)))
+
+                def part_entry(pw, p):
+                    leader = my_id if cluster is None else \
+                        cluster.leader_node(name, p)
+                    pw.i16(ERR_NONE).i32(p).i32(-1 if leader is None
+                                                else leader)
+                    pw.array([leader if leader is not None else 0],
+                             lambda x, v: x.i32(v))  # replicas
+                    pw.array([leader if leader is not None else 0],
+                             lambda x, v: x.i32(v))  # isr
+
+                wr.array(list(parts), part_entry)
 
             w.array(names, topic_entry)
         elif api_key == PRODUCE:
@@ -1243,13 +1357,16 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                 presp = []
                 for pid, record_set in parts:
                     entries = decode_message_set(record_set or b"")
-                    if tname not in broker.topics():
+                    if tname not in broker.topics() and cluster is None:
+                        # cluster topics are provisioned cluster-wide by
+                        # the controller/client fan-out; a single-broker
+                        # auto-create here would fork the topic spec
                         broker.create_topic(tname, partitions=max(pid + 1, 1))
                     if not self._valid_part(broker, tname, pid):
                         presp.append((pid, ERR_UNKNOWN_TOPIC, -1))
                         continue
-                    base = broker.end_offset(tname, pid)
                     try:
+                        base = broker.end_offset(tname, pid)
                         # bulk append under one broker lock — the
                         # per-message produce loop was a per-record cost
                         # in the server's hottest handler
@@ -1257,6 +1374,12 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                             tname, [(key, value or b"", ts)
                                     for _, key, value, ts in entries],
                             partition=pid)
+                    except NotLeaderForPartitionError:
+                        # sharded broker, unowned partition: Kafka error
+                        # 6 — the client refreshes metadata and re-routes
+                        presp.append(
+                            (pid, ERR_NOT_LEADER_FOR_PARTITION, -1))
+                        continue
                     except PermissionError:
                         # engine-owned topic (Broker.restrict_topic): an
                         # external client may not write the AVRO leg —
@@ -1288,6 +1411,10 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                         continue
                     try:
                         msgs = broker.fetch(tname, pid, offset, 4096)
+                    except NotLeaderForPartitionError:
+                        presp.append((pid, ERR_NOT_LEADER_FOR_PARTITION,
+                                      -1, b""))
+                        continue
                     except OffsetOutOfRangeError as e:
                         # Kafka error 1; the hwm slot carries the
                         # earliest retained offset so the client's
@@ -1316,20 +1443,24 @@ class _KafkaConn(socketserver.BaseRequestHandler):
             for tname, parts in tops:
                 presp = []
                 for pid, ts in parts:
-                    if not self._valid_part(broker, tname, pid):
-                        presp.append((pid, ERR_UNKNOWN_TOPIC, -1, -1))
-                    elif ts == -2:
-                        presp.append((pid, ERR_NONE, -1,
-                                      broker.begin_offset(tname, pid)))
-                    elif ts >= 0:
-                        # ListOffsets by timestamp: the replay cursor
-                        # (earliest offset with record ts >= requested)
-                        presp.append((pid, ERR_NONE, -1,
-                                      broker.offset_for_timestamp(
-                                          tname, pid, ts)))
-                    else:
-                        presp.append((pid, ERR_NONE, -1,
-                                      broker.end_offset(tname, pid)))
+                    try:
+                        if not self._valid_part(broker, tname, pid):
+                            presp.append((pid, ERR_UNKNOWN_TOPIC, -1, -1))
+                        elif ts == -2:
+                            presp.append((pid, ERR_NONE, -1,
+                                          broker.begin_offset(tname, pid)))
+                        elif ts >= 0:
+                            # ListOffsets by timestamp: the replay cursor
+                            # (earliest offset with record ts >= requested)
+                            presp.append((pid, ERR_NONE, -1,
+                                          broker.offset_for_timestamp(
+                                              tname, pid, ts)))
+                        else:
+                            presp.append((pid, ERR_NONE, -1,
+                                          broker.end_offset(tname, pid)))
+                    except NotLeaderForPartitionError:
+                        presp.append((pid, ERR_NOT_LEADER_FOR_PARTITION,
+                                      -1, -1))
                 resp.append((tname, presp))
             w.array(resp, lambda wr, t: (wr.string(t[0]), wr.array(
                 t[1], lambda pw, p: pw.i32(p[0]).i16(p[1]).i64(p[2])
@@ -1344,7 +1475,13 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                 return (rd.i32(), rd.i64(), rd.string())
 
             tops = r.array(lambda rd: (rd.string(), rd.array(part)))
-            if self._epoch_mismatch(client_epoch):
+            if self._not_coordinator():
+                # cluster group/offset state is pinned to ONE broker:
+                # a commit accepted here would fork the offset table
+                resp = [(tname, [(pid, ERR_NOT_COORDINATOR)
+                                 for pid, _, _ in parts])
+                        for tname, parts in tops]
+            elif self._epoch_mismatch(client_epoch):
                 # stale-epoch commit: writing it would let a zombie
                 # fence-bypass the promoted log's offset streams
                 resp = [(tname, [(pid, ERR_FENCED_LEADER_EPOCH)
@@ -1383,22 +1520,39 @@ class _KafkaConn(socketserver.BaseRequestHandler):
             group = r.string()
             tops = r.array(lambda rd: (rd.string(),
                                        rd.array(lambda p: p.i32())))
+            err = ERR_NOT_COORDINATOR if self._not_coordinator() \
+                else ERR_NONE
             resp = []
             for tname, parts in tops:
                 presp = []
                 for pid in parts:
-                    off = broker.committed(group, tname, pid)
+                    off = None if err else broker.committed(group, tname,
+                                                            pid)
                     presp.append((pid, -1 if off is None else off))
                 resp.append((tname, presp))
             w.array(resp, lambda wr, t: (wr.string(t[0]), wr.array(
                 t[1], lambda pw, p: pw.i32(p[0]).i64(p[1]).string(None)
-                .i16(ERR_NONE))))
+                .i16(err))))
         elif api_key == FIND_COORDINATOR:
-            r.string()  # group id — single-broker: we coordinate everything
-            # advertise the address the client actually connected to, not
-            # the bind address (0.0.0.0 would be unconnectable)
-            host = self.request.getsockname()[0]
-            w.i16(ERR_NONE).i32(0).string(host).i32(self.server.port)
+            r.string()  # group id — ONE coordinator per cluster (pinned)
+            if cluster is not None:
+                node, host, port = cluster.coordinator()
+                w.i16(ERR_NONE).i32(node).string(host).i32(port)
+            else:
+                # advertise the address the client actually connected to,
+                # not the bind address (0.0.0.0 would be unconnectable)
+                host = self.request.getsockname()[0]
+                w.i16(ERR_NONE).i32(0).string(host).i32(self.server.port)
+        elif api_key == JOIN_GROUP and self._not_coordinator():
+            w.i16(ERR_NOT_COORDINATOR).i32(-1).string("").string("")
+            w.string("")
+            w.array([], lambda wr, x: None)
+        elif api_key == SYNC_GROUP and self._not_coordinator():
+            r.string()
+            w.i16(ERR_NOT_COORDINATOR).bytes_(b"")
+        elif api_key in (HEARTBEAT, LEAVE_GROUP) and \
+                self._not_coordinator():
+            w.i16(ERR_NOT_COORDINATOR)
         elif api_key == JOIN_GROUP:
             group = r.string()
             session_timeout_ms = r.i32()
@@ -1538,11 +1692,18 @@ class KafkaWireServer(socketserver.ThreadingTCPServer):
     def __init__(self, broker: Broker, host: str = "127.0.0.1",
                  port: int = 0,
                  credentials: Optional[Tuple[str, str]] = None,
-                 epoch: int = 0):
+                 epoch: int = 0, cluster=None):
         super().__init__((host, port), _KafkaConn)
         self.broker = broker
         self.credentials = credentials
         self.port = self.server_address[1]
+        #: cluster view (iotml.cluster duck-type: node_id, brokers(),
+        #: leader_node(topic, partition), coordinator()) — None for the
+        #: classic single-broker server.  With a view, Metadata carries
+        #: per-partition leaders, unowned partitions answer
+        #: NOT_LEADER_FOR_PARTITION, and group/offset APIs are pinned to
+        #: the view's coordinator node.
+        self.cluster = cluster
         #: leadership fencing epoch this server believes it serves at.
         #: Promotion bumps it (FollowerReplica.promote); a restarted old
         #: leader comes back with its stale value and fences itself

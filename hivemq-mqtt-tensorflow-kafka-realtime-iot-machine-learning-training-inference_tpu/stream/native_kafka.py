@@ -26,10 +26,12 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .broker import Message, OffsetOutOfRangeError, TopicSpec
-from .kafka_wire import ProducePartitionMixin
+from .kafka_wire import NotLeaderForPartitionError, ProducePartitionMixin
 from .native import LABEL_STRIDE, NativeCodec, load
 
 _ERR_NAMES = {1: "OFFSET_OUT_OF_RANGE", 3: "UNKNOWN_TOPIC_OR_PARTITION",
+              6: "NOT_LEADER_FOR_PARTITION",
+              16: "NOT_COORDINATOR",
               35: "UNSUPPORTED_VERSION", 36: "TOPIC_ALREADY_EXISTS",
               58: "SASL_AUTHENTICATION_FAILED"}
 
@@ -215,11 +217,13 @@ class NativeKafkaBroker(ProducePartitionMixin):
                     kargs = (ctypes.c_char_p(keys),
                              koff.ctypes.data_as(_i64p),
                              knull.ctypes.data_as(_u8p))
-                base = _check(self._lib.iotml_kafka_produce(
+                rc = self._lib.iotml_kafka_produce(
                     self._h, topic.encode(), p, ctypes.c_char_p(values),
                     voff.ctypes.data_as(_i64p), *kargs,
-                    ts.ctypes.data_as(_i64p), len(ents)),
-                    f"produce({topic}:{p})")
+                    ts.ctypes.data_as(_i64p), len(ents))
+                if rc == -1006:
+                    raise NotLeaderForPartitionError(topic, p)
+                base = _check(rc, f"produce({topic}:{p})")
                 last = max(last, base + len(ents) - 1)
             return last
 
@@ -234,6 +238,11 @@ class NativeKafkaBroker(ProducePartitionMixin):
             earliest = max(
                 int(self._lib.iotml_kafka_high_watermark(self._h)), 0)
             raise OffsetOutOfRangeError(topic, partition, offset, earliest)
+        if rc == -1006:
+            # NOT_LEADER_FOR_PARTITION (cluster shard routing): same
+            # typed signal as the Python wire client, so routing clients
+            # treat both transports identically
+            raise NotLeaderForPartitionError(topic, partition)
 
     def fetch(self, topic: str, partition: int, offset: int,
               max_messages: int = 1024) -> List[Message]:
@@ -402,6 +411,18 @@ class NativeKafkaBroker(ProducePartitionMixin):
             if off < -1:  # -1 itself means "no committed offset"
                 raise KafkaProtocolError(off, f"committed({group},{topic})")
             return None if off == -1 else off
+
+    def committed_many(self, group: str, pairs):
+        """Committed offsets for [(topic, partition), ...]; pairs with
+        no committed offset are omitted (Broker/wire-client contract).
+        The native library has no batched OffsetFetch entry point, so
+        this loops — callers get the uniform duck-type either way."""
+        out = {}
+        for t, p in pairs:
+            off = self.committed(group, t, p)
+            if off is not None:
+                out[(t, p)] = off
+        return out
 
     def close(self) -> None:
         with self._lock:

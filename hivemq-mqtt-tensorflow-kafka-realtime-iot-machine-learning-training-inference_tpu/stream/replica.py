@@ -76,16 +76,29 @@ class FollowerReplica:
                  retention_messages: Optional[int] = None,
                  sasl: Optional[tuple] = None,
                  commit_interval_s: float = 1.0,
-                 store_dir: Optional[str] = None, store_policy=None):
+                 store_dir: Optional[str] = None, store_policy=None,
+                 partition_filter=None, local: Optional[Broker] = None):
         #: local log bound per mirrored topic.  The wire protocol does
         #: not carry the leader's retention config, so a follower of a
         #: retention-bounded leader must be given its own bound here or
         #: it accumulates the whole stream forever.
         self._retention = retention_messages
+        #: partition_filter(topic, partition) -> bool: mirror only the
+        #: partitions it accepts (None = all).  A SHARD follower in a
+        #: partitioned cluster (iotml.cluster) mirrors exactly its shard
+        #: — fetching unowned partitions from a sharded leader would only
+        #: bounce off NOT_LEADER_FOR_PARTITION anyway.
+        self._owns = partition_filter or (lambda _t, _p: True)
         # store_dir: mount the follower's log durably (iotml.store) —
         # a restarted follower resumes replication from its retained
-        # end instead of re-copying the leader's whole history
-        self.local = Broker(store_dir=store_dir, store_policy=store_policy)
+        # end instead of re-copying the leader's whole history.
+        # `local` injects a pre-built broker instead (a cluster shard
+        # follower passes a ShardBroker so unowned partitions stay
+        # unmounted and refuse to serve).
+        if local is not None and store_dir is not None:
+            raise ValueError("pass either local= or store_dir=, not both")
+        self.local = local if local is not None else \
+            Broker(store_dir=store_dir, store_policy=store_policy)
         # epoch -1 = "not a leader": an epoch-stamped produce/commit
         # reaching this follower BEFORE promotion is fenced (the
         # pre-promotion half of split-log protection — a failed-over
@@ -235,11 +248,15 @@ class FollowerReplica:
                     # the leader's earliest retained offset so copied
                     # messages land at IDENTICAL offsets
                     for p in range(spec.partitions):
+                        if not self._owns(t, p):
+                            continue
                         begin = self._leader.begin_offset(t, p)
                         if begin > 0:
                             self.local.align_base_offset(t, p, begin)
                 self._parts[t] = spec.partitions
             for p in range(self._parts[t]):
+                if not self._owns(t, p):
+                    continue
                 while not self._stop.is_set():
                     local_end = self.local.end_offset(t, p)
                     try:
@@ -287,6 +304,11 @@ class FollowerReplica:
         if mirror_commits and self._groups:
             # ONE OffsetFetch round-trip per group covering every
             # mirrored (topic, partition) — not a wire request each
+            # commit mirroring is NOT partition-filtered: a coordinator
+            # shard's follower inherits the coordinator role on
+            # promotion, so it needs the committed offsets of EVERY
+            # partition, not just the shard's own (the offsets table is
+            # one compacted file either way)
             pairs = [(t, p) for t in list(self._parts)
                      for p in range(self._parts[t])]
             for g in self._groups:
@@ -306,7 +328,7 @@ class FollowerReplica:
             out[t] = sum(
                 max(0, self._leader.end_offset(t, p)
                     - self.local.end_offset(t, p))
-                for p in range(n))
+                for p in range(n) if self._owns(t, p))
             obs_metrics.replica_lag.set(out[t], topic=t)
         return out
 

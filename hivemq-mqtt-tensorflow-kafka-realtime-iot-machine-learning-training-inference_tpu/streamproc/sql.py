@@ -579,7 +579,8 @@ class SqlSelectTask(StreamTask):
     def __init__(self, broker: Broker, src_meta: SourceMeta,
                  sink_meta: SourceMeta, stmt: SelectStmt,
                  registry: SchemaRegistry, group: str,
-                 trusted_passthrough: bool = False):
+                 trusted_passthrough: bool = False,
+                 passthrough_sample: int = 0):
         super().__init__(broker, src_meta.topic, sink_meta.topic,
                          partitions=broker.topic(sink_meta.topic).partitions
                          if sink_meta.topic in broker.topics() else 1,
@@ -665,6 +666,12 @@ class SqlSelectTask(StreamTask):
         #: re-decoding every record was the rekey pump's dominant cost.
         #: External/untrusted source topics must keep validation on.
         self._trusted = bool(trusted_passthrough)
+        #: sample-validation cadence under trust (engine-level knob):
+        #: every Nth pass-through batch is strict-validated anyway, so a
+        #: regression in the engine's own encoder surfaces within N
+        #: batches instead of reaching downstream consumers silently
+        self._sample_every = max(int(passthrough_sample), 0)
+        self._passthrough_batches = 0
 
     def _project(self, rec: dict) -> Optional[dict]:
         out = {}
@@ -766,7 +773,10 @@ class SqlSelectTask(StreamTask):
             if not m.value or m.value[0] != 0:
                 return None  # poisoned frame: generic path drops it
             vals.append(m.value)
-        if not self._trusted:
+        self._passthrough_batches += 1
+        sampled = (self._trusted and self._sample_every
+                   and self._passthrough_batches % self._sample_every == 0)
+        if not self._trusted or sampled:
             try:
                 # strict validation — the bytes pass through, so success
                 # must guarantee forwarding the ORIGINAL payload is
@@ -774,7 +784,9 @@ class SqlSelectTask(StreamTask):
                 # minimal varints, valid UTF-8, sane union branches);
                 # anything else sends the whole batch to the generic path,
                 # which drops/canonicalizes exactly the bad rows.  Skipped
-                # under trusted_passthrough — see __init__.
+                # under trusted_passthrough — except for the 1-in-N
+                # sampled batches (passthrough_sample), which re-check
+                # the engine's own encoder output as defense in depth.
                 self._native_src.codec.decode_batch(
                     vals, strip=5, stride=_NativeAvroSource.STRIDE,
                     strict=True)
@@ -1092,7 +1104,8 @@ class SqlEngine:
 
     def __init__(self, broker: Broker, registry: Optional[SchemaRegistry] = None,
                  trusted_passthrough: bool = False,
-                 owner_token: Optional[object] = None):
+                 owner_token: Optional[object] = None,
+                 passthrough_sample: int = 0):
         self.broker = broker
         self.registry = registry or SchemaRegistry()
         self.sources: Dict[str, SourceMeta] = {}
@@ -1104,6 +1117,13 @@ class SqlEngine:
         #: engine's validating encoder one hop earlier.  Sources fed by
         #: external producers always keep validation regardless.
         self.trusted_passthrough = bool(trusted_passthrough)
+        #: defense-in-depth sampling under trust: validate one batch in
+        #: every `passthrough_sample` even on trusted legs (0 = off).
+        #: The broker's ownership grant already guarantees only the
+        #: engine writes these topics; sampling catches the remaining
+        #: failure class — a bug in the engine's own encoder — at ~1/N
+        #: of the full re-validation cost (ADVICE r5).
+        self.passthrough_sample = int(passthrough_sample)
         #: produce grant for engine-owned topics (Broker.restrict_topic):
         #: when the platform restricts the AVRO leg to this engine, pump
         #: rounds run under this token so only the engine's own tasks may
@@ -1309,7 +1329,8 @@ class SqlEngine:
                                  self.registry, group=f"CSAS_{name}_{fp}",
                                  trusted_passthrough=(
                                      self.trusted_passthrough
-                                     and src.query_id is not None))
+                                     and src.query_id is not None),
+                                 passthrough_sample=self.passthrough_sample)
         meta.query_id = qid
         self.sources[name] = meta
         self.queries[qid] = Query(qid, name, sql, task)

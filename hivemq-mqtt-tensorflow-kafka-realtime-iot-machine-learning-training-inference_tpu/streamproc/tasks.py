@@ -34,19 +34,26 @@ class StreamTask:
 
     def __init__(self, broker: Broker, src: str, dst: str,
                  partitions: int = 1, group: Optional[str] = None,
-                 src_partitions: Optional[int] = None):
+                 src_partitions: Optional[int] = None, consumer=None):
         self.broker = broker
         self.src = src
         self.dst = dst
         broker.create_topic(dst, partitions=partitions)
-        n_src = src_partitions if src_partitions is not None \
-            else broker.topic(src).partitions
-        # resume from committed group offsets so a restarted task does not
-        # re-emit already-transformed records (KSQL's continuous-query
-        # restart semantics)
-        self.consumer = StreamConsumer.from_committed(
-            broker, src, list(range(n_src)),
-            group=group or f"task-{dst}", fallback_offset=0, eof=True)
+        if consumer is not None:
+            # injected cursor — a GroupConsumer makes the task GROUP-
+            # ELASTIC: N instances of the same task split the source
+            # partitions and rebalance on member death (the
+            # partition-parallel KSQL pumps of iotml.cluster.fleet)
+            self.consumer = consumer
+        else:
+            n_src = src_partitions if src_partitions is not None \
+                else broker.topic(src).partitions
+            # resume from committed group offsets so a restarted task
+            # does not re-emit already-transformed records (KSQL's
+            # continuous-query restart semantics)
+            self.consumer = StreamConsumer.from_committed(
+                broker, src, list(range(n_src)),
+                group=group or f"task-{dst}", fallback_offset=0, eof=True)
 
     def process(self, messages: List[Message]) -> List[Tuple]:
         """Return [(key, value, timestamp_ms)] outputs."""

@@ -53,6 +53,20 @@ class Topology:
             self._fallback = [s for s in self._fallback if s != leader]
             self.generation += 1
 
+    def replace_fallback(self, old: Optional[str], new: str) -> None:
+        """Swap one fallback address for another (no leadership change).
+
+        The multi-shard ``iotml.cluster.PartitionMap`` keeps every other
+        shard's address in each cell's fallback list; when shard X fails
+        over, the OTHER cells' fallbacks must learn X's new address —
+        without touching their own leader or epoch."""
+        with self._lock:
+            self._fallback = [s for s in self._fallback
+                              if s != old and s != new]
+            if new != self._leader:
+                self._fallback.append(new)
+            self.generation += 1
+
     # ------------------------------------------------------------- read
     def resolve(self) -> Tuple[List[str], int]:
         with self._lock:

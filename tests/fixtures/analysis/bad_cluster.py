@@ -1,0 +1,32 @@
+"""R10 fixture: direct broker-instance addressing outside
+iotml/cluster/ — a ShardBroker built by hand (1 finding) and controller
+collections subscripted for a specific instance (2 findings) — plus the
+clean shapes: routing through the client/map and a justified
+suppression (0 findings)."""
+
+
+def hand_built_shard(pmap):
+    from iotml.cluster import ShardBroker
+
+    # flagged: broker instances belong to the ClusterController
+    return ShardBroker(lambda t, p: True, shard_id=0)
+
+
+def pick_a_broker(controller):
+    # both flagged: indexing a specific instance bypasses PartitionMap
+    # routing (NOT_LEADER re-route + epoch fencing never run)
+    b = controller.brokers[2]
+    controller.serving[0].produce("t", b"oops", partition=3)
+    return b
+
+
+def routed_is_fine(controller):
+    client = controller.client()
+    client.produce("t", b"routed", key=b"car-1")
+    servers, epoch = controller.pmap.resolve("t", 3)
+    return servers, epoch
+
+
+def justified(controller):
+    # lint-ok: R10 drill harness assertion reads the victim's end offset
+    return controller.serving[1].end_offset("t", 1)

@@ -357,6 +357,23 @@ def test_broker_crash_recover_scenario_holds_the_invariants(tmp_path):
     assert by_name["consumer_resumed_from_committed"].ok
 
 
+def test_rebalance_under_chaos_scenario_holds_the_invariants():
+    """The cluster topology: a group member AND a shard leader die
+    mid-epoch on a 3-broker cluster; every record must be scored
+    exactly once across the rebalance + per-shard failover."""
+    report = _run("rebalance-under-chaos", records=200)
+    assert report.ok, _failed(report)
+    assert report.topology == "cluster"
+    assert report.injected.get("runner.kill_member:kill_member") == 1
+    assert report.injected.get(
+        "runner.kill_shard_leader:kill_shard_leader") == 1
+    by_name = {i.name: i for i in report.invariants}
+    assert by_name["zero_records_lost"].ok
+    assert by_name["zero_double_scored"].ok
+    assert by_name["member_death_rebalanced"].ok
+    assert by_name["shard_failover_one_shard_only"].ok
+
+
 def test_loss_bug_fixture_fails_the_checker(tmp_path):
     """The checker checked: a committed-then-silently-dropped record
     (the seeded unledgered drop) must FAIL, naming the lost trace."""

@@ -1,0 +1,421 @@
+"""iotml.cluster: partitioned multi-broker data plane.
+
+The reference runs 10-partition topics on a 3-broker cluster
+(PAPER.md L3); these tests prove the rebuild's equivalent — shard-aware
+brokers, per-partition Metadata routing, NOT_LEADER bounces + cached-
+metadata refresh, coordinator pinning, per-shard failover, and the
+cluster edges the ISSUE names: stale-metadata handoff vs in-flight
+fetch, coordinator death mid-generation, cold restart from store dirs,
+and revocation committing before release.
+"""
+
+import os
+
+import pytest
+
+from iotml.cluster import ClusterClient, ClusterController, PartitionMap
+from iotml.stream.group import GroupConsumer, GroupCoordinator
+from iotml.stream.kafka_wire import (KafkaWireBroker,
+                                     NotLeaderForPartitionError,
+                                     RemoteGroupCoordinator)
+
+TOPIC = "sensor-data"
+PARTS = 6
+
+
+@pytest.fixture
+def cluster():
+    ctl = ClusterController(brokers=3).start()
+    ctl.create_topic(TOPIC, partitions=PARTS)
+    yield ctl
+    ctl.stop()
+
+
+def fill(client, n=60):
+    for i in range(n):
+        client.produce(TOPIC, f"v{i}".encode(), key=f"car{i}".encode())
+
+
+# ------------------------------------------------------------- sharding
+def test_each_broker_materializes_only_its_partitions(cluster):
+    cli = cluster.client()
+    fill(cli)
+    for i, b in enumerate(cluster.brokers):  # lint-not-applicable: tests
+        owned = [p for p in range(PARTS) if b.owns(TOPIC, p)]
+        assert owned == [p for p in range(PARTS) if p % 3 == i]
+        for p in range(PARTS):
+            if b.owns(TOPIC, p):
+                b.end_offset(TOPIC, p)  # serves its own
+            else:
+                with pytest.raises(NotLeaderForPartitionError):
+                    b.end_offset(TOPIC, p)
+    # nothing lost in routing: all records across all shards
+    assert sum(cli.end_offset(TOPIC, p) for p in range(PARTS)) == 60
+    cli.close()
+
+
+def test_keyed_routing_is_cross_client_stable(cluster):
+    """The same key lands on the same partition through the cluster
+    client and the plain wire client (per-key ordering invariant)."""
+    cli = cluster.client()
+    raw = KafkaWireBroker(cluster.pmap.leader(0))
+    import zlib
+
+    for key in (b"car1", b"car42", b"x"):
+        expect = zlib.crc32(key) % PARTS
+        assert cli._partition_for(TOPIC, key) == expect
+        assert raw._partition_for(TOPIC, key) == expect
+    raw.close()
+    cli.close()
+
+
+def test_metadata_carries_per_partition_leaders(cluster):
+    raw = KafkaWireBroker(cluster.pmap.leader(1))
+    meta = raw.cluster_metadata([TOPIC])
+    assert {n for n, _h, _p, _r in meta["brokers"]} == {0, 1, 2}
+    for p in range(PARTS):
+        assert meta["leaders"][(TOPIC, p)] == p % 3
+    raw.close()
+
+
+def test_unowned_partition_bounces_error_6(cluster):
+    raw = KafkaWireBroker(cluster.pmap.leader(0))
+    with pytest.raises(NotLeaderForPartitionError):
+        raw.fetch(TOPIC, 1, 0)  # partition 1 lives on broker 1
+    with pytest.raises(NotLeaderForPartitionError):
+        raw.produce(TOPIC, b"x", partition=2)
+    raw.close()
+
+
+# ------------------------------------------- stale metadata vs handoff
+def test_stale_metadata_fetch_refreshes_and_reroutes(cluster):
+    """The ISSUE edge: an in-flight consumer holding a STALE map fetches
+    from the wrong broker, gets NOT_LEADER, refreshes its cached
+    metadata and retries against the real owner — no error escapes."""
+    seed = cluster.client()
+    fill(seed)
+    wc = ClusterClient(bootstrap=cluster.pmap.leader(0))
+    before = wc.fetch(TOPIC, 1, 0, 100)
+    assert before
+    # poison the cache: claim partition 1 lives on node 0
+    wc._leaders[(TOPIC, 1)] = 0
+    again = wc.fetch(TOPIC, 1, 0, 100)
+    assert [(m.offset, m.value) for m in again] == \
+        [(m.offset, m.value) for m in before]
+    # the bounce healed the cache
+    assert wc._leaders[(TOPIC, 1)] == 1
+    wc.close()
+    seed.close()
+
+
+def test_stale_metadata_produce_retries_without_duplication(cluster):
+    wc = ClusterClient(bootstrap=cluster.pmap.leader(2))
+    wc.produce(TOPIC, b"a", partition=1)
+    wc._leaders[(TOPIC, 1)] = 2  # stale: wrong owner
+    wc.produce(TOPIC, b"b", partition=1)
+    msgs = wc.fetch(TOPIC, 1, 0, 10)
+    # NOT_LEADER means nothing was appended on the bounce: exactly two
+    assert [m.value for m in msgs] == [b"a", b"b"]
+    wc.close()
+
+
+def test_handoff_during_drain_keeps_offsets_identical():
+    """Per-shard failover mid-drain: the promoted follower serves the
+    SAME offsets, and the in-flight consumer resumes seamlessly."""
+    ctl = ClusterController(brokers=3, replicated=True,
+                            replica_sync="manual").start()
+    try:
+        ctl.create_topic(TOPIC, partitions=PARTS)
+        cli = ctl.client()
+        fill(cli, 90)
+        # drain halfway
+        cursors = {p: 0 for p in range(PARTS)}
+        seen = []
+        for p in range(PARTS):
+            got = cli.fetch(TOPIC, p, 0, 5)
+            seen.extend((m.partition, m.offset, m.value) for m in got)
+            cursors[p] = got[-1].offset + 1 if got else 0
+        ctl.sync_replicas_once()
+        victim = 1
+        pre_end = {p: cli.end_offset(TOPIC, p) for p in range(PARTS)}
+        ctl.fail_shard(victim)
+        assert ctl.pmap.epoch(victim) == 1
+        # resume the drain through the SAME client: moved shard's
+        # partitions serve at identical offsets from the follower
+        for p in range(PARTS):
+            got = cli.fetch(TOPIC, p, cursors[p], 1000)
+            seen.extend((m.partition, m.offset, m.value) for m in got)
+        assert len(seen) == len(set(seen)) == 90
+        assert {p: cli.end_offset(TOPIC, p)
+                for p in range(PARTS)} == pre_end
+        cli.close()
+    finally:
+        ctl.stop()
+
+
+# ------------------------------------------------ group over the wire
+def test_group_members_split_partitions_across_shards(cluster):
+    seed = cluster.client()
+    fill(seed, 60)
+    c1, c2 = cluster.client(), cluster.client()
+    g1 = GroupConsumer(RemoteGroupCoordinator(c1, "g"), [TOPIC])
+    g2 = GroupConsumer(RemoteGroupCoordinator(c2, "g"), [TOPIC])
+    g1.poll(0)  # heartbeat: pick up the rebalance g2's join triggered
+    assert sorted(g1.assignment + g2.assignment) == \
+        [(TOPIC, p) for p in range(PARTS)]
+    seen = []
+    for gc in (g1, g2):
+        while True:
+            batch = gc.poll(1000)
+            if not batch:
+                break
+            seen.extend((m.partition, m.offset) for m in batch)
+        assert gc.commit() is True
+    assert len(seen) == len(set(seen)) == 60
+    for c in (c1, c2, seed):
+        c.close()
+
+
+def test_coordinator_death_mid_generation():
+    """The ISSUE edge: the coordinator broker dies mid-generation.
+    Members re-find the promoted coordinator, the group re-forms, and
+    they resume from the MIRRORED committed offsets — nothing lost,
+    nothing double-consumed after the committed frontier."""
+    ctl = ClusterController(brokers=3, replicated=True,
+                            replica_sync="manual",
+                            mirror_groups=("g",)).start()
+    try:
+        ctl.create_topic(TOPIC, partitions=PARTS)
+        seed = ctl.client()
+        fill(seed, 60)
+        c1, c2 = ctl.client(), ctl.client()
+        g1 = GroupConsumer(RemoteGroupCoordinator(c1, "g"), [TOPIC])
+        g2 = GroupConsumer(RemoteGroupCoordinator(c2, "g"), [TOPIC])
+        seen = []
+        for gc in (g1, g2):
+            while True:
+                batch = gc.poll(1000)
+                if not batch:
+                    break
+                seen.extend((m.partition, m.offset) for m in batch)
+            assert gc.commit() is True
+        assert len(seen) == 60
+        # 60 more records arrive, replication drains to zero lag
+        # (the zero-loss handoff contract: async replication's loss
+        # window is the lag at kill), THEN the coordinator dies
+        fill(seed, 60)
+        while ctl.sync_replicas_once() > 0:
+            pass
+        assert ctl.pmap.coordinator()[0] == 0
+        ctl.fail_shard(0)
+        # committed offsets survived the coordinator move
+        assert seed.committed("g", TOPIC, 0) is not None
+        # members heal: polls rejoin against the promoted coordinator
+        seen2 = []
+        for _ in range(30):
+            for gc in (g1, g2):
+                batch = gc.poll(1000)
+                for m in batch:
+                    seen2.append((m.partition, m.offset))
+                if batch:
+                    # commit-after-poll: the zero-duplicate discipline —
+                    # a partition handed to the peer resumes at this
+                    # member's committed (== scored) frontier
+                    gc.commit()
+            assigned = set()
+            for gc in (g1, g2):
+                assigned.update(gc.assignment)
+            if len(seen2) >= 60 and \
+                    assigned == {(TOPIC, p) for p in range(PARTS)}:
+                break
+        assert g1.rebalances + g2.rebalances > 0
+        # every NEW record seen exactly once; nothing before the
+        # mirrored frontier redelivered
+        assert sorted(set(seen2)) == sorted(seen2)
+        assert len(seen2) == 60
+        assert not (set(seen2) & set(seen))
+        for c in (c1, c2, seed):
+            c.close()
+    finally:
+        ctl.stop()
+
+
+# ------------------------------------------------------- cold restart
+def test_cold_restart_resumes_every_shard_from_store(tmp_path):
+    """The ISSUE edge: stop the whole cluster, boot a fresh controller
+    on the same store root — every shard remounts its own partition
+    dirs, offsets resume, and each broker dir holds ONLY its shard."""
+    root = str(tmp_path / "cluster")
+    ctl = ClusterController(brokers=3, store_root=root).start()
+    ctl.create_topic(TOPIC, partitions=PARTS)
+    cli = ctl.client()
+    fill(cli, 60)
+    ends = {p: cli.end_offset(TOPIC, p) for p in range(PARTS)}
+    payload = {p: [m.value for m in cli.fetch(TOPIC, p, 0, 1000)]
+               for p in range(PARTS)}
+    cli.commit("g", TOPIC, 1, 4)
+    cli.close()
+    ctl.stop()
+    # each broker dir materialized exactly its own partitions
+    for i in range(3):
+        pdir = os.path.join(root, f"broker-{i}", "segments", TOPIC)
+        assert sorted(os.listdir(pdir)) == \
+            sorted(str(p) for p in range(PARTS) if p % 3 == i)
+    ctl2 = ClusterController(brokers=3, store_root=root).start()
+    try:
+        # the manifests re-created the topics cluster-wide
+        assert ctl2.pmap.topics()[TOPIC] == PARTS
+        cli2 = ctl2.client()
+        assert {p: cli2.end_offset(TOPIC, p)
+                for p in range(PARTS)} == ends
+        assert {p: [m.value for m in cli2.fetch(TOPIC, p, 0, 1000)]
+                for p in range(PARTS)} == payload
+        # committed offsets persisted on the coordinator's store
+        assert cli2.committed("g", TOPIC, 1) == 4
+        cli2.close()
+    finally:
+        ctl2.stop()
+
+
+# ------------------------------------- revocation commits before release
+def test_revocation_commits_before_release(broker_10):
+    """A member that polled-but-not-committed loses partitions in a
+    rebalance: its pre-rejoin commit (inside the coordinator's grace
+    window) hands the successor its REAL frontier — no redelivery of
+    work already done."""
+    coord = GroupCoordinator(broker_10, "g", session_timeout_s=30.0)
+    c1 = GroupConsumer(coord, ["sensor-data"])
+    for _ in range(3):
+        c1.poll(40)  # progress WITHOUT an explicit commit
+    polled = {p: off for _t, p, off in c1.positions()}
+    c2 = GroupConsumer(coord, ["sensor-data"])  # rebalance: c1 fenced
+    c1.poll(1)  # heartbeat fails -> grace commit -> rejoin
+    # partitions c1 RELEASED to c2 start at c1's polled frontier
+    for t, p in c2.assignment:
+        committed = broker_10.committed("g", t, p)
+        assert committed == polled[p], (p, committed, polled[p])
+
+
+def test_revocation_grace_never_rewinds_successor(broker_10):
+    clock = __import__("tests.test_group", fromlist=["FakeClock"]) \
+        .FakeClock()
+    coord = GroupCoordinator(broker_10, "g", session_timeout_s=30.0,
+                             clock=clock)
+    m1, gen1, _ = coord.join(["sensor-data"])
+    # rebalance twice: m1 is pending at gen1
+    coord.join(["sensor-data"])
+    # the successor commits FURTHER than m1's stale cursor
+    members = coord.members()
+    m2 = [m for m in members if m != m1][0]
+    _, gen2, assigned2 = coord.join(["sensor-data"], m2)
+    t, p = assigned2[0]
+    assert coord.fenced_commit(m2, gen2, [(t, p, 15)])
+    # m1's grace commit with an OLDER offset must not rewind it
+    owned_then = [(tt, pp, 3) for tt, pp in [(t, p)]]
+    coord.fenced_commit(m1, gen1, owned_then)
+    assert broker_10.committed("g", t, p) == 15
+
+
+def test_expired_member_gets_no_grace(broker_10):
+    from tests.test_group import FakeClock
+
+    clock = FakeClock()
+    coord = GroupCoordinator(broker_10, "g", session_timeout_s=5.0,
+                             clock=clock)
+    m1, gen1, assigned = coord.join(["sensor-data"])
+    clock.t += 10.0
+    coord.members()  # expiry sweep
+    t, p = assigned[0]
+    assert coord.fenced_commit(m1, gen1, [(t, p, 7)]) is False
+    assert broker_10.committed("g", t, p) is None
+
+
+# ----------------------------------------------------------- supervise
+def test_supervised_per_shard_failover_moves_one_shard():
+    ctl = ClusterController(brokers=3, replicated=True,
+                            replica_sync="manual").start()
+    try:
+        ctl.create_topic(TOPIC, partitions=PARTS)
+        cli = ctl.client()
+        fill(cli, 30)
+        ctl.sync_replicas_once()
+        sup = ctl.supervised(poll_interval_s=0.02).start()
+        try:
+            before = {s: ctl.pmap.leader(s) for s in range(3)}
+            ctl.kill_shard(2)
+            assert ctl.await_failover(2, timeout_s=10.0)
+            # exactly one shard moved
+            assert ctl.pmap.leader(2) != before[2]
+            assert ctl.pmap.leader(0) == before[0]
+            assert ctl.pmap.leader(1) == before[1]
+            assert ctl.pmap.epoch(2) == 1
+        finally:
+            sup.stop()
+        # the moved shard serves; the others never blinked
+        assert sum(cli.end_offset(TOPIC, p) for p in range(PARTS)) == 30
+        cli.produce(TOPIC, b"post", partition=2)
+        assert cli.fetch(TOPIC, 2, 0, 100)[-1].value == b"post"
+        cli.close()
+    finally:
+        ctl.stop()
+
+
+# ------------------------------------------------------------- fleets
+def test_pump_fleet_rebalances_on_member_death():
+    from iotml.cluster import PumpFleet
+    from iotml.streamproc.tasks import StreamTask
+
+    class Upper(StreamTask):
+        def process(self, messages):
+            return [(m.key, m.value.upper(), m.timestamp_ms)
+                    for m in messages]
+
+    ctl = ClusterController(brokers=3).start()
+    try:
+        ctl.create_topic("src", partitions=PARTS)
+        seed = ctl.client()
+        for i in range(40):
+            seed.produce("src", f"r{i}".encode(), key=f"k{i}".encode())
+
+        fleet = PumpFleet(
+            lambda: ctl.client(),
+            lambda client, consumer: Upper(client, "src", "dst",
+                                           partitions=PARTS,
+                                           consumer=consumer),
+            n_members=2, src_topic="src", group="pumps",
+            session_timeout_ms=400)
+        for _ in range(5):
+            fleet.pump_once()
+        fleet.kill(0)
+        import time as _t
+
+        deadline = _t.monotonic() + 10.0
+        while _t.monotonic() < deadline:
+            fleet.pump_once()
+            survivor = fleet.members[1].consumer
+            if set(survivor.assignment) == \
+                    {("src", p) for p in range(PARTS)}:
+                break
+            _t.sleep(0.05)
+        # drain everything through the survivor
+        for _ in range(10):
+            fleet.pump_once()
+        total = sum(seed.end_offset("dst", p) for p in range(PARTS))
+        # exactly-once into dst across the rebalance: every src record
+        # transformed once (commits fence the dead member's frontier)
+        assert total == 40
+        fleet.stop()
+        seed.close()
+    finally:
+        ctl.stop()
+
+
+@pytest.fixture
+def broker_10():
+    from iotml.stream.broker import Broker
+
+    b = Broker()
+    b.create_topic("sensor-data", partitions=10)
+    for i in range(200):
+        b.produce("sensor-data", f"r{i}".encode(), partition=i % 10)
+    return b
