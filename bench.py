@@ -296,6 +296,143 @@ def bench_store_log():
                 n_passes=len(walls))
 
 
+def bench_checkpoint():
+    """Async-checkpointing overhead on the streaming train loop
+    (iotml.mlops): the same ContinuousTrainer rounds run three ways —
+    publication OFF (the do-nothing upper bound), ASYNC registry
+    checkpointing (snapshot on the train thread, serialize+fsync on
+    the writer thread), and the legacy SYNC h5-export-per-round.  The
+    ISSUE 7 claim is async-vs-off within 10%; the sync column shows
+    what the hot loop used to pay.  Also measured: the train-thread
+    snapshot cost (the ONLY part async adds to the hot path) and the
+    off-thread serialize+publish cost it moved away."""
+    import shutil
+    import tempfile
+
+    from iotml.mlops import AsyncCheckpointer, ModelRegistry
+    from iotml.stream.broker import Broker
+    from iotml.train.artifacts import ArtifactStore
+    from iotml.train.live import ContinuousTrainer
+
+    import statistics
+
+    # enough rounds that each timed pass spans several checkpoint
+    # cadence periods — an 8-round (~60ms) window would charge one
+    # whole 35ms write against it and measure the ratio of two
+    # accidents, not the steady-state overhead.  Passes are
+    # INTERLEAVED across modes (off pass, async pass, sync pass,
+    # repeat) and the overhead is the median of PAIRED off/async
+    # ratios: this box's available CPU drifts by 2-3x across a bench
+    # run (shared 2-core host), so back-to-back pairs see the same
+    # machine and the ratio cancels the drift a sequential
+    # mode-at-a-time comparison would book as checkpoint cost.
+    # each pass must span >= 2 checkpoint-cadence periods, or writes
+    # get charged at an inflated effective rate (a 0.27s window books
+    # its ~1.3 writes as one per 200ms against a 500ms cadence)
+    rounds = int(os.environ.get("IOTML_BENCH_CKPT_ROUNDS", "120"))
+    n_passes = int(os.environ.get("IOTML_BENCH_CKPT_PASSES", "3"))
+    take, batch = 10, 100
+    per_round = take * batch
+    n_records = (n_passes * (rounds + 1) + 2) * per_round
+    modes = ("off", "async", "sync_store")
+
+    def make_mode(mode):
+        broker = _fill_broker(Broker(), n_records)
+        tmp = tempfile.mkdtemp(prefix="iotml_bench_ckpt_")
+        ck = None
+        if mode == "async":
+            # production cadence (cli defaults): at most ~2
+            # versions/s — sub-second rounds coalesce, a slow round
+            # still checkpoints every round
+            ck = AsyncCheckpointer(ModelRegistry(tmp), min_interval_s=0.5)
+            tr = ContinuousTrainer(broker, "SENSOR_DATA_S_AVRO", None,
+                                   checkpointer=ck, take_batches=take,
+                                   batch_size=batch, group=f"b-{mode}")
+            ck.start()
+        else:
+            tr = ContinuousTrainer(broker, "SENSOR_DATA_S_AVRO",
+                                   ArtifactStore(tmp),
+                                   take_batches=take, batch_size=batch,
+                                   group=f"b-{mode}")
+            if mode == "off":
+                tr.publish = lambda: "off"  # rounds pay zero
+                # publication cost: the do-nothing upper bound
+        return tr, ck, tmp
+
+    setups = {m: make_mode(m) for m in modes}
+    passes = {m: [] for m in modes}
+    written = 0
+    try:
+        for m in modes:
+            setups[m][0].train_round()  # compile warm-up, off-window
+        # drain the warm-up checkpoint BEFORE the window: the first
+        # write of a process pays the h5py import + allocator warmup on
+        # the writer thread — one-time cost, not steady-state overhead
+        setups["async"][1].flush(timeout_s=30.0)
+        for _ in range(n_passes):
+            for m in modes:
+                tr = setups[m][0]
+                t0 = time.perf_counter()
+                for _ in range(rounds):
+                    tr.train_round()
+                passes[m].append(rounds * per_round
+                                 / (time.perf_counter() - t0))
+        ck = setups["async"][1]
+        ck.stop(flush=True)
+        written = ck.written
+        assert written >= 1
+    finally:
+        for tr, ck, tmp in setups.values():
+            if ck is not None:
+                ck.stop(flush=False)
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    rps = {m: statistics.median(passes[m]) for m in modes}
+    # paired per-pass overhead: each async pass vs the off pass run
+    # seconds before it on the same machine state
+    pair_overheads = [100.0 * (o - a) / o
+                      for o, a in zip(passes["off"], passes["async"])]
+    # the two costs the split separates: what stayed on the train
+    # thread (device->host snapshot) vs what moved off it
+    import jax
+    import numpy as np
+
+    from iotml.models.autoencoder import CAR_AUTOENCODER
+    from iotml.train.loop import Trainer
+
+    trn = Trainer(CAR_AUTOENCODER)
+    trn._ensure_state(np.zeros((batch, 18), np.float32))
+    tmp = tempfile.mkdtemp(prefix="iotml_bench_ckpt_")
+    try:
+        ck = AsyncCheckpointer(ModelRegistry(tmp), queue_depth=64)
+        snaps = []
+        for _ in range(16):
+            t0 = time.perf_counter()
+            ck.snapshot(trn.state, [("SENSOR_DATA_S_AVRO", 0, 1)])
+            snaps.append(time.perf_counter() - t0)
+        writes = []
+        while ck.pending():
+            t0 = time.perf_counter()
+            ck.write_once()
+            writes.append(time.perf_counter() - t0)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    overhead = statistics.median(pair_overheads)
+    return dict(value=rps["async"],
+                rps_checkpoint_off=round(rps["off"], 1),
+                rps_sync_store=round(rps["sync_store"], 1),
+                async_overhead_pct=round(overhead, 2),
+                checkpoints_written=written,
+                passes_off=[round(p, 1) for p in passes["off"]],
+                passes_async=[round(p, 1) for p in passes["async"]],
+                snapshot_ms_p50=round(
+                    1e3 * _percentiles(snaps)[0], 3),
+                offthread_write_ms_p50=round(
+                    1e3 * _percentiles(writes)[0], 3),
+                rounds=rounds, n_passes=n_passes,
+                records_per_round=per_round)
+
+
 # ------------------------------------------------------ cluster saturation
 _CLUSTER_NODE_SRC = r"""
 import sys
@@ -2190,6 +2327,12 @@ def main():
         # recovery wall time; no reference twin (its retention lived in
         # managed Kafka), so vs_baseline deliberately 0
         ("store_append_mb_per_sec", "MB/s", None),
+        # async-checkpointing overhead (iotml.mlops): train throughput
+        # with async registry checkpoints vs publication-off vs the
+        # legacy sync h5 export — the "no training stall" claim as a
+        # measured percentage (ISSUE 7: async within 10% of off)
+        ("train_ckpt_async_records_per_sec", "records/s",
+         TRAIN_BASELINE_RPS),
         # the partitioned data plane's saturation knee at 3 brokers
         # (separate processes), vs the r05 single-LEADER platform knee
         # it exists to move; on >=8-core hosts scaling_x also shows the
@@ -2234,6 +2377,7 @@ def main():
         run("serve_rows_per_sec", bench_serve)
         run("ksql_pipeline_records_per_sec", bench_ksql_pipeline)
         run("store_append_mb_per_sec", bench_store_log)
+        run("train_ckpt_async_records_per_sec", bench_checkpoint)
         try:
             run("cluster_saturation_records_per_sec",
                 bench_cluster_saturation)

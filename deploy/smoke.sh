@@ -24,6 +24,10 @@ echo "== 2/5 supervised restart: live scorer-crash drill (the scorer"
 echo "        thread dies twice; the supervisor must heal the pipeline)"
 JAX_PLATFORMS=cpu python -m iotml.supervise drill --drill scorer-crash \
   --seed 7 --records 500
+echo "==      live model rollout drill (iotml.mlops): 3 promotions"
+echo "        hot-swap under load, every record scored exactly once"
+JAX_PLATFORMS=cpu python -m iotml.mlops drill --drill rollout \
+  --seed 7 --records 500
 
 echo "== 3/5 validate manifests against the codebase"
 python deploy/validate_manifests.py

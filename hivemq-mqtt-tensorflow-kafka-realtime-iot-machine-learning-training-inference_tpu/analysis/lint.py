@@ -53,6 +53,14 @@ Rules (see ARCHITECTURE.md §analysis for the full table):
       the durability promises (fsync accounting, torn-tail recovery
       semantics, atomic-rename publication) are made in exactly one
       place.
+  R11 model-registry write discipline (R9's story for model
+      artifacts): outside ``iotml/mlops/``, no ``open()``/``os.open()``
+      or ``atomic_write()`` whose arguments name a registry path
+      (``registry_dir`` / ``registry_root`` / ``version_dir`` /
+      ``artifact_path`` / ``manifest.json``) — every byte under a
+      registry goes through ``mlops.registry.ModelRegistry`` (the one
+      writer), or the manifest-as-commit-marker recovery contract (a
+      version is committed IFF its manifest parses) silently breaks.
 
 Suppression: append ``# lint-ok: RN <reason>`` to the flagged line (for
 R4, to the ``with`` line holding the lock).  A suppression WITHOUT a
@@ -99,8 +107,15 @@ CHAOS_ALLOWED_MODULES = frozenset({
     ("stream", "kafka_wire.py"), ("stream", "broker.py"),
     ("stream", "replica.py"), ("mqtt", "broker.py"),
     ("serve", "scorer.py"), ("train", "live.py"),
+    ("mlops", "checkpoint.py"), ("mlops", "registry.py"),
 })
 CHAOS_SHIM_MODULE = "faults"
+# Drill-harness modules outside chaos/supervise: live-drill peers of
+# chaos.runner (they arm engines / reuse its Invariant machinery against
+# real platforms), exempt from R7 exactly like the supervise drills.
+CHAOS_HARNESS_MODULES = frozenset({
+    ("mlops", "drill.py"), ("mlops", "__main__.py"),
+})
 
 # R6 (naming): metric families and span/stage names are lowercase
 # snake_case; framework-owned names (iotml-prefixed) must follow the
@@ -139,6 +154,10 @@ RULES: Dict[str, str] = {
            "(ShardBroker(...) construction, or subscripting a "
            "controller's .brokers/.servers/.serving/.replicas): clients "
            "route via PartitionMap / ClusterClient",
+    "R11": "naked model-registry write (open()/os.open()/atomic_write() "
+           "on a registry path) outside iotml/mlops/: all registry "
+           "bytes go through ModelRegistry (manifest-as-commit-marker "
+           "recovery depends on the one-writer discipline)",
 }
 
 # R10: the cluster-internal collections whose per-instance subscripting
@@ -153,6 +172,13 @@ _R10_COLLECTIONS = frozenset({"brokers", "servers", "serving", "replicas"})
 # suppression, the lint's usual direction.
 _STORE_PATH_NAME_RE = re.compile(
     r"store_dir|store_path|storedir|segment_path|\.slog\b", re.IGNORECASE)
+
+# R11: identifier substrings marking an open()/atomic_write() argument
+# as a model-registry path.  Same conservative name-based matching as
+# R9 (flagging errs toward a justified suppression, not silence).
+_REGISTRY_PATH_NAME_RE = re.compile(
+    r"registry_dir|registry_root|version_dir|artifact_path"
+    r"|manifest\.json|model_registry", re.IGNORECASE)
 
 _SUPPRESS_RE = re.compile(r"#\s*lint-ok:\s*(R\d)\b[ \t]*(.*)")
 _RETRY_OK_RE = re.compile(r"#\s*retry-ok:[ \t]*(.*)")
@@ -399,7 +425,9 @@ class _FileLinter(ast.NodeVisitor):
         # supervise package — its live drills are the threaded peer of
         # chaos.runner (harness code arming engines against real
         # platforms), not a hot path
-        self.in_chaos = "chaos" in parts or "supervise" in parts
+        self.in_chaos = "chaos" in parts or "supervise" in parts or (
+            len(parts) >= 2 and (parts[-2], parts[-1])
+            in CHAOS_HARNESS_MODULES)
         self.chaos_allowed = self.in_chaos or (
             len(parts) >= 2 and (parts[-2], parts[-1])
             in CHAOS_ALLOWED_MODULES)
@@ -412,6 +440,8 @@ class _FileLinter(ast.NodeVisitor):
         # R9 scoping: the store package OWNS the bytes (SegmentWriter,
         # atomic_write) and is the one place fsync may appear
         self.in_store = "store" in parts
+        # R11 scoping: the mlops package owns registry bytes
+        self.in_mlops = "mlops" in parts
         #: Thread(...) call nodes already seen as a register_thread(...)
         #: argument — outer calls visit before inner ones, so by the
         #: time visit_Call reaches the Thread node it is marked
@@ -638,6 +668,22 @@ class _FileLinter(ast.NodeVisitor):
                                "dir go through SegmentWriter (framing, "
                                "CRC, fsync accounting, recovery "
                                "semantics)")
+
+        # R11 — model-registry write discipline: registry bytes are
+        # ModelRegistry's alone; a naked open/atomic_write on a registry
+        # path bypasses the staged-rename + manifest-as-commit-marker
+        # protocol that torn-publish recovery depends on
+        if not self.in_mlops and name in ("open", "atomic_write"):
+            arg_src = " ".join(
+                ast.unparse(a) for a in list(node.args)
+                + [kw.value for kw in node.keywords])
+            if _REGISTRY_PATH_NAME_RE.search(arg_src):
+                self._emit("R11", node,
+                           f"naked {name}() on a model-registry path "
+                           "outside iotml/mlops/: all registry bytes "
+                           "go through ModelRegistry (staged rename + "
+                           "manifest commit marker + checksum; a "
+                           "version is immutable once committed)")
 
         # R10 — broker instances are the cluster package's to build:
         # constructing a ShardBroker elsewhere bypasses the controller's

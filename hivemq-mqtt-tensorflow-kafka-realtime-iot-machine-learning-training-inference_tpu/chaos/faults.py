@@ -56,6 +56,14 @@ KNOWN_POINTS: Dict[str, str] = {
     "scorer.poll": "scorer drain loop: stall (delay), simulated crash "
                    "(error -> rewind-to-committed redelivery)",
     "trainer.poll": "continuous-trainer poll loop: stall (delay), error",
+    "ckpt.write": "checkpoint writer, between serialize and the atomic "
+                  "registry publication: crash (error) = killed "
+                  "mid-checkpoint with host state gone, registry "
+                  "untouched; delay = slow disk (drop-oldest backlog)",
+    "registry.commit": "registry publish, between artifact staging and "
+                       "the manifest write: crash (error) leaves a "
+                       "manifest-less (torn) version dir that readers "
+                       "skip and recover() sweeps",
 }
 
 #: runner-orchestrated pseudo-points: process-level acts (killing a wire
@@ -91,6 +99,8 @@ POINT_ACTIONS: Dict[str, frozenset] = {
     "mqtt.deliver": frozenset({"drop", "dup", "delay"}),
     "scorer.poll": frozenset({"error", "delay"}),
     "trainer.poll": frozenset({"error", "delay"}),
+    "ckpt.write": frozenset({"error", "delay"}),
+    "registry.commit": frozenset({"error", "delay"}),
     "runner.kill_leader": frozenset({"kill_leader"}),
     "runner.crash_broker": frozenset({"crash_broker"}),
     "runner.kill_member": frozenset({"kill_member"}),

@@ -220,6 +220,8 @@ class ChaosRunner:
                 report = self._run_store(eng, span_path)
             elif self.schedule.topology == "cluster":
                 report = self._run_cluster(eng)
+            elif self.schedule.topology == "mlops":
+                report = self._run_mlops(eng)
             else:
                 report = self._run_inproc(eng, span_path)
         finally:
@@ -506,6 +508,293 @@ class ChaosRunner:
             dropped_accounted=eng.dropped_count,
             injected=dict(sorted(eng.injected.items())),
             invariants=invariants, span_path=span_path)
+
+    # -------------------------------------------------------------- mlops
+    def _run_mlops(self, eng: faults.ChaosEngine) -> ChaosReport:
+        """Model-lifecycle scenarios (iotml.mlops) on a temp registry."""
+        import shutil
+        import tempfile
+
+        root = tempfile.mkdtemp(prefix="iotml_chaos_mlops_")
+        try:
+            if self.schedule.name == "rollout-regression-rollback":
+                return self._run_mlops_rollback(eng, root)
+            return self._run_mlops_trainer_crash(eng, root)
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+    def _run_mlops_trainer_crash(self, eng: faults.ChaosEngine,
+                                 root: str) -> ChaosReport:
+        """Trainer killed INSIDE a registry publication.
+
+        The checkpoint writer is driven deterministically (write_once on
+        the drive thread) so the scheduled ``registry.commit`` error
+        lands on an exact publish: artifacts visible, manifest never
+        written — the torn version dir a real kill leaves.  The "process"
+        then dies (trainer/checkpointer objects abandoned, host state
+        gone) and a second incarnation mounts the same registry root:
+        recover() must sweep exactly the torn dir, readers must never
+        have seen it, and the restarted trainer must resume model AND
+        stream cursors from the last DURABLE manifest — re-consuming
+        forward from its stamped offsets (no gap), never behind them
+        (no double-train)."""
+        import os as _os
+
+        from ..gen.simulator import FleetGenerator, FleetScenario
+        from ..mlops import AsyncCheckpointer, ModelRegistry
+        from ..stream.broker import Broker
+        from ..train.live import ContinuousTrainer
+
+        group = "chaos-mlops-train"
+        broker = Broker()
+        commit_log: List[tuple] = []
+        _record_commits(broker, commit_log, "stream")
+        gen = FleetGenerator(FleetScenario(num_cars=CARS_PER_TICK,
+                                           seed=self.schedule.seed,
+                                           failure_rate=0.02))
+        ticks = max(1, -(-self.schedule.records // CARS_PER_TICK))
+        published = gen.publish(broker, IN_TOPIC, n_ticks=ticks,
+                                partitions=2)
+
+        def incarnation():
+            reg = ModelRegistry(root)
+            swept = reg.recover()
+            ck = AsyncCheckpointer(reg)
+            tr = ContinuousTrainer(broker, IN_TOPIC, None, checkpointer=ck,
+                                   group=group, batch_size=25,
+                                   take_batches=2, only_normal=False)
+            return reg, ck, tr, swept
+
+        def version_dirs(reg):
+            return sorted(n for n in _os.listdir(
+                _os.path.join(root, "versions")) if n.startswith("v"))
+
+        reg, ck, tr, _ = incarnation()
+        crash: dict = {"round": None}
+        trained = 0
+        while tr.available() >= tr.min_available:
+            stats = tr.train_round()
+            trained += stats.get("records", 0)
+            try:
+                ck.write_once()
+            except RuntimeError:
+                # the kill: snapshot the on-disk evidence the "dead
+                # process" leaves, then abandon every live object
+                crash["round"] = tr.rounds
+                crash["versions_visible"] = reg.versions()
+                crash["dirs"] = version_dirs(reg)
+                crash["committed"] = {
+                    p: broker.committed(group, IN_TOPIC, p)
+                    for p in range(2)}
+                break
+
+        committed_names = {f"v{v:010d}" for v
+                           in crash.get("versions_visible", [])}
+        torn_dirs = sorted(set(crash.get("dirs", [])) - committed_names)
+
+        # ---- restart: fresh mount of the same registry root
+        reg2, ck2, tr2, swept = incarnation()
+        post_recover_versions = reg2.versions()
+        last_durable = reg2.latest()
+        manifest = reg2.manifest(last_durable) \
+            if last_durable is not None else None
+        resumed = {p: off for _t, p, off in tr2.consumer.positions()}
+        post_crash_versions = []
+        while tr2.available() >= tr2.min_available:
+            stats = tr2.train_round()
+            trained += stats.get("records", 0)
+            v = ck2.write_once()
+            if v is not None:
+                post_crash_versions.append(v)
+        final_versions = reg2.versions()
+
+        manifest_offsets = {p: off for _t, p, off
+                            in (manifest.offsets if manifest else [])}
+        commit_behind = all(
+            (crash["committed"].get(p) or 0) <= manifest_offsets.get(p, 0)
+            for p in range(2)) if manifest else False
+        final_manifest = reg2.manifest(final_versions[-1]) \
+            if final_versions else None
+        final_committed_ok = final_manifest is not None and all(
+            broker.committed(group, t, p) == off
+            for t, p, off in final_manifest.offsets)
+        invariants = [
+            Invariant(
+                "crash_injected_mid_publish",
+                crash["round"] is not None,
+                f"registry.commit crash landed on round {crash['round']}"
+                if crash["round"] is not None else
+                "the scheduled mid-publish crash never fired"),
+            Invariant(
+                "torn_version_never_served",
+                len(torn_dirs) == 1 and not any(
+                    int(torn_dirs[0][1:]) in vs for vs in
+                    (crash.get("versions_visible", []),)),
+                f"torn dir {torn_dirs} existed on disk, invisible to "
+                f"versions() before AND after recovery" if torn_dirs else
+                "no torn version dir found — the crash left no artifact"),
+            Invariant(
+                "recover_swept_torn_only",
+                swept == len(torn_dirs) and
+                post_recover_versions == crash.get("versions_visible", []),
+                f"recover() swept {swept} dir(s) == the {len(torn_dirs)} "
+                f"torn; committed set unchanged"),
+            Invariant(
+                "commit_trails_checkpoint",
+                commit_behind,
+                "committed offsets at crash <= last durable manifest's "
+                "stamped offsets on every partition" if commit_behind else
+                f"COMMITTED RAN AHEAD of durable state: "
+                f"committed={crash.get('committed')} "
+                f"manifest={manifest_offsets}"),
+            Invariant(
+                "resumed_exactly_at_manifest",
+                manifest is not None and resumed == manifest_offsets,
+                f"restart cursors {resumed} == durable manifest offsets "
+                f"{manifest_offsets} (no gap, no double-train)"
+                if resumed == manifest_offsets else
+                f"restart cursors {resumed} DIVERGED from manifest "
+                f"{manifest_offsets}"),
+            Invariant(
+                "version_ids_number_commits",
+                bool(final_versions) and final_versions == list(
+                    range(1, len(final_versions) + 1)),
+                f"{len(final_versions)} committed versions, contiguous "
+                f"ids (the torn publish's id was reused)"),
+            Invariant(
+                "training_resumed_to_end",
+                bool(post_crash_versions)
+                and tr2.available() < tr2.min_available
+                and final_committed_ok,
+                f"{len(post_crash_versions)} post-crash versions "
+                f"published; stream consumed to the round boundary; "
+                f"final committed == final manifest offsets"),
+            _check_commits_monotonic(commit_log),
+        ]
+        return ChaosReport(
+            scenario=self.schedule.name, seed=self.schedule.seed,
+            records=self.schedule.records, topology="mlops",
+            published=published, scored=trained, rewinds=0,
+            dropped_accounted=eng.dropped_count,
+            injected=dict(sorted(eng.injected.items())),
+            invariants=invariants, span_path=None)
+
+    def _run_mlops_rollback(self, eng: faults.ChaosEngine,
+                            root: str) -> ChaosReport:
+        """Deploy a degraded candidate; the gate must roll it back.
+
+        Baseline = a quickly-trained autoencoder published through the
+        async checkpointer; candidate = the same weights wrecked with
+        seeded noise, published and DEPLOYED (serving points at it for
+        the evaluation window).  Both score the full seeded stream into
+        their own prediction topics; the r04 detection-quality gate
+        must detect the AUC/F1 regression and re-point serving at the
+        baseline — within one pass over the stream."""
+        import numpy as np
+
+        from ..gen.simulator import FleetGenerator, FleetScenario
+        from ..mlops import (ABRollout, AsyncCheckpointer, ModelRegistry,
+                             RolloutGate)
+        from ..mlops.checkpoint import (params_from_h5_bytes,
+                                        params_to_h5_bytes)
+        from ..stream.broker import Broker
+        from ..train.live import ContinuousTrainer
+
+        broker = Broker()
+        commit_log: List[tuple] = []
+        _record_commits(broker, commit_log, "stream")
+        gen = FleetGenerator(FleetScenario(num_cars=CARS_PER_TICK,
+                                           seed=self.schedule.seed,
+                                           failure_rate=0.05))
+        ticks = max(1, -(-self.schedule.records // CARS_PER_TICK))
+        published = gen.publish(broker, IN_TOPIC, n_ticks=ticks,
+                                partitions=2)
+
+        reg = ModelRegistry(root)
+        tr = ContinuousTrainer(
+            broker, IN_TOPIC, None, checkpointer=AsyncCheckpointer(reg),
+            group="chaos-ab-train", batch_size=50,
+            take_batches=max(2, min(8, published // 60)),
+            epochs_per_round=3)
+        tr.train_round()
+        tr.checkpointer.write_once()
+        baseline = reg.latest()
+
+        import jax
+
+        params = params_from_h5_bytes(reg.load_bytes(baseline, "model.h5"))
+        noise = np.random.RandomState(self.schedule.seed)
+        bad = jax.tree_util.tree_map(
+            lambda a: np.asarray(a)
+            + noise.normal(0, 1.0, np.shape(a)).astype(np.float32),
+            params)
+        candidate = reg.publish(
+            {"model.h5": params_to_h5_bytes(bad)},
+            metrics={"degraded": 1.0}).version
+
+        gate = RolloutGate(
+            min_records=max(50, min(300, published // 2)), epsilon=0.02)
+        ab = ABRollout(broker, IN_TOPIC, reg, baseline, candidate,
+                       gate=gate, threshold=5.0, deploy_candidate=True,
+                       from_start=True)
+        serving_during = reg.channel("serving")
+        # one deterministic pass: both sides drain the retained stream;
+        # the gate must settle before the data runs out
+        for _ in range(512):
+            if ab.step(max_rows=5_000) == 0:
+                break
+        scored = sum(s.scored for s in ab.sides.values())
+        qb, qc = ab.quality("baseline"), ab.quality("candidate")
+        serving_after = reg.channel("serving")
+        events = [e["event"] for e in reg.history()]
+        pred_ok = all(
+            broker.end_offset(f"model-predictions.v{v}", 0) == s.scored
+            for v, s in ((baseline, ab.sides["baseline"]),
+                         (candidate, ab.sides["candidate"])))
+        invariants = [
+            Invariant(
+                "regression_rolled_back",
+                ab.decision == "rollback",
+                f"gate verdict: {ab.decision!r} "
+                f"(baseline auc={qb['auc']}, candidate auc={qc['auc']})"),
+            Invariant(
+                "candidate_served_during_eval",
+                serving_during == candidate,
+                f"serving pointed at the candidate (v{serving_during}) "
+                f"for the evaluation window"),
+            Invariant(
+                "serving_restored_to_baseline",
+                serving_after == baseline and "rollback" in events,
+                f"serving back at v{serving_after} == baseline "
+                f"v{baseline}; history records the rollback"),
+            Invariant(
+                "quality_gap_real",
+                qb["auc"] is not None and qc["auc"] is not None
+                and qb["auc"] > qc["auc"] + gate.epsilon,
+                f"measured regression: baseline auc {qb['auc']} vs "
+                f"candidate {qc['auc']} (epsilon {gate.epsilon})"),
+            Invariant(
+                "decided_within_one_pass",
+                ab.decision is not None and all(
+                    s.scored <= published for s in ab.sides.values()),
+                f"verdict after {max(s.scored for s in ab.sides.values())}"
+                f"/{published} records per side — no replay needed"),
+            Invariant(
+                "ab_prediction_streams_on_log",
+                pred_ok,
+                "both versions' prediction topics hold exactly one "
+                "record per scored row (the comparison artifact is "
+                "itself replayable)" if pred_ok else
+                "prediction topic row counts diverge from scored rows"),
+            _check_commits_monotonic(commit_log),
+        ]
+        return ChaosReport(
+            scenario=self.schedule.name, seed=self.schedule.seed,
+            records=self.schedule.records, topology="mlops",
+            published=published, scored=scored, rewinds=0,
+            dropped_accounted=eng.dropped_count,
+            injected=dict(sorted(eng.injected.items())),
+            invariants=invariants, span_path=None)
 
     @staticmethod
     def _publish_tick_mqtt(gen, mqtt) -> int:

@@ -34,6 +34,13 @@ Built-ins:
   leader dies mid-epoch; the runner proves every produced record was
   scored exactly once (zero lost, zero double-scored) across the
   rebalance and the per-shard failover.
+- ``trainer-crash-mid-checkpoint`` (mlops): the checkpoint writer dies
+  inside a registry publication (torn version dir left behind); a
+  restarted trainer must resume model + stream offsets from the last
+  durable manifest with the torn state swept, never served.
+- ``rollout-regression-rollback`` (mlops): a deliberately degraded
+  candidate model is deployed to serving; the A/B quality gate must
+  detect the live regression and roll serving back to the baseline.
 """
 
 from __future__ import annotations
@@ -186,6 +193,46 @@ def _rebalance_under_chaos(rng: random.Random, records: int) -> list:
     return events
 
 
+def _trainer_crash_mid_checkpoint(rng: random.Random, records: int) -> list:
+    # the continuous trainer's checkpoint writer dies INSIDE a registry
+    # publication — after the artifacts became visible, before the
+    # manifest (the commit marker) landed.  That is the worst spot: a
+    # naive registry would serve the torn version.  The runner then
+    # "restarts the process" (fresh registry mount + trainer warm start)
+    # and proves: readers never saw the torn version, recover() swept
+    # exactly it, and training resumed from the last DURABLE manifest's
+    # stamped offsets — no gap, no double-train.  registry.commit is hit
+    # once per publish (~one per ~50-record round in the runner), so the
+    # crash lands on an early-but-not-first checkpoint.
+    publishes = max(3, records // 60)
+    crash_at = rng.randint(2, max(2, min(4, publishes - 1)))
+    events = [FaultEvent(crash_at, "registry.commit", "error",
+                         params=(("exc", "RuntimeError"),))]
+    # slow-disk flavor on a couple of OTHER writes: serialize/fsync
+    # stalls must degrade checkpoint freshness, never training
+    for _ in range(2):
+        events.append(FaultEvent(rng.randint(1, max(2, publishes)),
+                                 "ckpt.write", "delay",
+                                 params=(("seconds", 0.002),)))
+    return events
+
+
+def _rollout_regression_rollback(rng: random.Random, records: int) -> list:
+    # no injected faults needed — the "failure" is a deliberately
+    # degraded CANDIDATE MODEL deployed to serving (deploy-during-eval),
+    # and the system under test is the A/B quality gate: it must detect
+    # the regression from live scored quality and re-point serving at
+    # the baseline within the drill budget.  A couple of scorer stalls
+    # ride along so the gate decides under an unquiet clock.
+    ticks = max(4, records // CARS_PER_TICK)
+    events = []
+    for _ in range(2):
+        events.append(FaultEvent(rng.randint(1, max(2, ticks)),
+                                 "scorer.poll", "delay",
+                                 params=(("seconds", 0.001),)))
+    return events
+
+
 def _loss_bug_fixture(rng: random.Random, records: int) -> list:
     # the seeded bug: one delivery silently lost — NOT ledgered, so the
     # scored-or-accounted invariant must fail (the checker's own test)
@@ -233,6 +280,17 @@ SCENARIOS: Dict[str, Tuple[Callable, str, str]] = {
         "3-broker cluster: a group member AND a shard leader die "
         "mid-epoch; every record scored exactly once across the "
         "rebalance + per-shard failover"),
+    "trainer-crash-mid-checkpoint": (
+        _trainer_crash_mid_checkpoint, "mlops",
+        "checkpoint writer killed INSIDE a registry publication (torn "
+        "version dir); restart resumes model+offsets from the last "
+        "durable manifest — no torn state served, no gap, no "
+        "double-train"),
+    "rollout-regression-rollback": (
+        _rollout_regression_rollback, "mlops",
+        "a degraded candidate model is deployed to serving; the A/B "
+        "quality gate must detect the regression live and roll serving "
+        "back to the baseline within the drill budget"),
 }
 
 

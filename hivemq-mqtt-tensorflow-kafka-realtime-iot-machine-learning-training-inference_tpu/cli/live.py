@@ -79,6 +79,10 @@ def main(argv=None) -> int:
     tr.add_argument("--take-batches", type=int, default=20)
     tr.add_argument("--batch-size", type=int, default=100)
     tr.add_argument("--epochs-per-round", type=int, default=1)
+    tr.add_argument("--checkpoint-interval-s", type=float, default=0.5,
+                    help="async-checkpoint cadence with --registry: "
+                         "snapshots arriving faster are coalesced "
+                         "(newest wins); 0 archives every round")
     tr.add_argument("--backfill-since-ms", type=int, default=None,
                     help="cold start: begin from the first retained "
                          "record at/after this timestamp (durable-store "
@@ -105,6 +109,13 @@ def main(argv=None) -> int:
     sc.add_argument("--wait-model-seconds", type=float, default=120.0)
 
     for p in (tr, sc):
+        p.add_argument("--registry", default=None, metavar="DIR",
+                       help="versioned model registry root (iotml.mlops): "
+                            "train publishes async checkpoints stamped "
+                            "with stream offsets (crash-consistent "
+                            "resume, no training stall); score follows "
+                            "the registry's serving channel — promote/"
+                            "rollback flips hot-swap the scorer")
         p.add_argument("--normalize", choices=("parity", "full"),
                        default="parity",
                        help="parity = the reference's normalization "
@@ -151,6 +162,27 @@ def main(argv=None) -> int:
     normalizer = (FULL_NORMALIZER if args.normalize == "full"
                   else CAR_NORMALIZER)
     store = ArtifactStore(args.artifact_root)
+    registry = None
+    checkpointer = None
+    if args.registry:
+        from ..config import load_config
+        from ..mlops import ModelRegistry
+
+        registry = ModelRegistry(args.registry)
+        if args.cmd == "train":
+            from ..mlops.checkpoint import AsyncCheckpointer
+
+            registry.recover()  # sweep torn publishes from a prior kill
+            # env-resolved mlops policy (IOTML_MLOPS_*): queue depth,
+            # promote-on-publish vs gate-owned, optimizer archival,
+            # retention — the CLI flag only owns the cadence
+            mcfg = load_config([])[0].mlops
+            checkpointer = AsyncCheckpointer(
+                registry, queue_depth=mcfg.queue_depth,
+                save_opt_state=mcfg.save_opt_state,
+                auto_promote=mcfg.auto_promote,
+                keep_versions=mcfg.keep_versions,
+                min_interval_s=args.checkpoint_interval_s)
     if args.cmd == "train":
         from ..train.live import ContinuousTrainer
 
@@ -160,11 +192,16 @@ def main(argv=None) -> int:
                                 take_batches=args.take_batches,
                                 epochs_per_round=args.epochs_per_round,
                                 normalizer=normalizer,
-                                backfill_since_ms=args.backfill_since_ms)
+                                backfill_since_ms=args.backfill_since_ms,
+                                registry=registry,
+                                checkpointer=checkpointer)
         print(f"live train: {args.topic} rounds of "
               f"{args.take_batches}x{args.batch_size} -> "
-              f"{args.artifact_root}/{args.model_name}", flush=True)
+              f"{args.artifact_root}/{args.model_name}"
+              + (f" + registry {args.registry}" if registry else ""),
+              flush=True)
         rounds = svc.run(stop=stop, on_round=emit)
+        svc.close()  # flush pending checkpoints, stop the writer
         print(f"live train done: {rounds} rounds, "
               f"{svc.records_trained} records, last loss {svc.last_loss}",
               flush=True)
@@ -179,7 +216,7 @@ def main(argv=None) -> int:
                          car_threshold=car_th,
                          car_feature_heads=args.car_feature_heads,
                          batch_size=args.batch_size,
-                         normalizer=normalizer)
+                         normalizer=normalizer, registry=registry)
         artifact = svc.wait_for_model(args.wait_model_seconds)
         print(f"live score: model {artifact} loaded; "
               f"{args.topic} -> {args.result_topic}", flush=True)

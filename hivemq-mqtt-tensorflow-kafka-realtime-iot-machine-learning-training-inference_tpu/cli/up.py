@@ -45,7 +45,9 @@ class Platform:
                  connect_port: int = 0, host: str = "127.0.0.1",
                  retention_messages: Optional[int] = None, cc_port: int = 0,
                  store_dir: Optional[str] = None, store_policy=None,
-                 trusted_passthrough: Optional[bool] = None):
+                 trusted_passthrough: Optional[bool] = None,
+                 registry_dir: Optional[str] = None,
+                 registry_watch_poll_s: float = 0.25):
         from ..connect import ConnectServer, ConnectWorker
         from ..core.schema import CAR_SCHEMA, KSQL_CAR_SCHEMA
         from ..mqtt.bridge import KafkaBridge
@@ -144,6 +146,27 @@ class Platform:
         self.mqtt = MqttEventServer(self.mqtt_broker, host=host,
                                     port=mqtt_port)
 
+        # model-lifecycle wing (iotml.mlops): mount the versioned
+        # registry, sweep torn publishes from a prior kill, and keep a
+        # watcher on the serving channel — scorers attach to it, and
+        # /healthz + the version gauge carry the platform's model
+        # identity.  A trainer process hands its AsyncCheckpointer to
+        # attach_checkpointer() so --supervise owns the writer loop.
+        self.registry_dir = registry_dir
+        self.model_registry = None
+        self.registry_watcher = None
+        self.checkpoint_writer = None
+        if registry_dir:
+            from ..mlops import ModelRegistry
+            from ..mlops.rollout import RegistryWatcher
+
+            self.model_registry = ModelRegistry(registry_dir,
+                                                component="platform")
+            self.model_registry.recover()
+            self.registry_watcher = RegistryWatcher(
+                self.model_registry, component="platform",
+                poll_interval_s=registry_watch_poll_s)
+
         from ..obs.control_center import ControlCenter
 
         self.control_center = ControlCenter(self, host=host, port=cc_port)
@@ -153,12 +176,21 @@ class Platform:
         self._fleet_thread: Optional[threading.Thread] = None
         self.started = False
 
+    def attach_checkpointer(self, checkpointer):
+        """Register a trainer's AsyncCheckpointer so ``supervised()``
+        runs its writer as a supervised unit (crash -> restart under
+        backoff, pending snapshots surviving in the queue)."""
+        self.checkpoint_writer = checkpointer
+        return checkpointer
+
     def start(self, metrics_port: Optional[int] = None) -> "Platform":
         self.kafka.start()
         self.registry_server.start()
         self.ksql.start()
         self.connect.start()
         self.mqtt.start()
+        if self.registry_watcher is not None:
+            self.registry_watcher.start()
         if metrics_port is not None:
             self.metrics_server = self._obs.start_http_server(metrics_port)
         self.control_center.start()
@@ -167,6 +199,8 @@ class Platform:
 
     def endpoints(self) -> dict:
         out = {} if self.store_dir is None else {"store": self.store_dir}
+        if self.registry_dir:
+            out["registry"] = self.registry_dir
         out.update({
             "kafka": f"{self.host}:{self.kafka.port}",
             "mqtt": f"{self.host}:{self.mqtt.port}",
@@ -322,12 +356,30 @@ class Platform:
         if self._fleet_thread is not None:
             sup.add_probed(
                 "fleet", thread_alive(lambda: self._fleet_thread))
+        # the model-lifecycle units (ISSUE 7): the registry watcher's
+        # poll thread is probed+respawned like every serving thread, and
+        # an attached checkpoint writer runs as a supervised LOOP unit —
+        # a crashed writer restarts under backoff with its pending
+        # snapshots intact in the bounded queue
+        if self.registry_watcher is not None:
+            sup.add_probed(
+                "registry-watcher",
+                thread_alive(lambda: self.registry_watcher._thread),
+                restart=respawn(lambda: self.registry_watcher._thread,
+                                self.registry_watcher.start))
+        if self.checkpoint_writer is not None:
+            sup.add_loop("ckpt-writer", self.checkpoint_writer.unit_loop(),
+                         heartbeat_timeout_s=30.0)
         return sup
 
     def stop(self) -> None:
         self._fleet_stop.set()
         if self._fleet_thread is not None:
             self._fleet_thread.join(timeout=3)
+        if self.registry_watcher is not None:
+            self.registry_watcher.stop()
+        if self.checkpoint_writer is not None:
+            self.checkpoint_writer.stop(flush=True)
         for s in (self.connect, self.ksql, self.registry_server,
                   self.control_center):
             s.stop()
@@ -376,6 +428,12 @@ def main(argv=None) -> int:
     ap.add_argument("--store-dir", default=None, metavar="DIR",
                     help="store directory for --durable (also enables "
                          "durable mode when given)")
+    ap.add_argument("--registry", default=None, metavar="DIR",
+                    help="mount a versioned model registry (iotml.mlops): "
+                         "torn publishes swept at boot, the serving "
+                         "channel watched (supervised under --supervise), "
+                         "model identity on /healthz.  Also via "
+                         "IOTML_MLOPS_REGISTRY_DIR.")
     ap.add_argument("--supervise", action="store_true",
                     help="run component lifecycles under the "
                          "iotml.supervise supervisor (crashed serving "
@@ -416,7 +474,10 @@ def main(argv=None) -> int:
                         store_dir=store_dir,
                         store_policy=(StorePolicy.from_config(cfg.store)
                                       if store_dir else None),
-                        trusted_passthrough=args.trust_passthrough)
+                        trusted_passthrough=args.trust_passthrough,
+                        registry_dir=args.registry
+                        or (cfg.mlops.registry_dir or None),
+                        registry_watch_poll_s=cfg.mlops.watch_poll_s)
     except ValueError as e:  # e.g. negative retention: clean usage error
         ap.error(str(e))
     plat.start(metrics_port=args.metrics_port)

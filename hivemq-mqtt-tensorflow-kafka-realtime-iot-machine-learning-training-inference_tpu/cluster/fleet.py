@@ -138,13 +138,25 @@ class ScorerFleet(_Fleet):
                  in_topic: str, out_topic: str,
                  group: str = "scorer-fleet",
                  session_timeout_ms: int = 10_000,
-                 batch_size: int = 100):
+                 batch_size: int = 100, registry=None,
+                 registry_poll_s: float = 0.25):
         super().__init__()
         from ..data.dataset import SensorBatches
         from ..serve.scorer import StreamScorer
         from ..stream.producer import OutputSequence
 
         self.group = group
+        #: zero-downtime rollout across the whole fleet (iotml.mlops):
+        #: one shared watcher hot-swaps EVERY member between drains when
+        #: the registry's serving channel moves — the PR 6 partition-
+        #: parallel shape of the single-scorer hot swap, driven by
+        #: pump_once (deterministic) or the watcher thread (start()).
+        self.watcher = None
+        if registry is not None:
+            from ..mlops.rollout import RegistryWatcher
+
+            self.watcher = RegistryWatcher(registry,
+                                           poll_interval_s=registry_poll_s)
         for i in range(n_members):
             client = client_factory()
             coord = RemoteGroupCoordinator(
@@ -165,6 +177,25 @@ class ScorerFleet(_Fleet):
             self.members.append(
                 _Member(f"scorer-{i}", consumer, drive, payload=scorer,
                         client=client))
+            if self.watcher is not None:
+                self.watcher.attach(scorer)
+
+    def pump_once(self) -> int:
+        if self.watcher is not None:
+            # swap-before-drive: a promotion lands on every member at
+            # the same deterministic point (between fleet rounds)
+            self.watcher.poll_once()
+        return super().pump_once()
+
+    def start(self, poll_interval_s: float = 0.05) -> "_Fleet":
+        if self.watcher is not None:
+            self.watcher.start()
+        return super().start(poll_interval_s)
+
+    def stop(self) -> None:
+        if self.watcher is not None:
+            self.watcher.stop()
+        super().stop()
 
     def scored(self) -> int:
         return sum(m.payload.scored for m in self.members)

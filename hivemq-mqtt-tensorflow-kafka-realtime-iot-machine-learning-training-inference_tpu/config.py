@@ -117,6 +117,25 @@ class StoreConfig:
 
 
 @dataclasses.dataclass
+class MlopsConfig:
+    """Model lifecycle (iotml.mlops): versioned registry + async
+    checkpointing + rollout.
+
+    ``registry_dir`` empty (the default) keeps the legacy artifact-
+    store pointer flow; set it (``IOTML_MLOPS_REGISTRY_DIR``) — or pass
+    ``--registry`` to the live/up CLIs — to publish every training
+    round as a committed, offsets-stamped registry version that scorers
+    hot-swap to."""
+
+    registry_dir: str = ""        # empty = no registry
+    queue_depth: int = 2          # pending snapshots before drop-oldest
+    auto_promote: bool = True     # serving follows every publish
+    watch_poll_s: float = 0.25    # scorer-side channel poll cadence
+    save_opt_state: bool = True   # archive optimizer moments per version
+    keep_versions: int = 16       # prune beyond newest N (0 = keep all)
+
+
+@dataclasses.dataclass
 class Config:
     broker: BrokerConfig = dataclasses.field(default_factory=BrokerConfig)
     stream: StreamConfig = dataclasses.field(default_factory=StreamConfig)
@@ -126,6 +145,7 @@ class Config:
     scenario: ScenarioConfig = dataclasses.field(default_factory=ScenarioConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     store: StoreConfig = dataclasses.field(default_factory=StoreConfig)
+    mlops: MlopsConfig = dataclasses.field(default_factory=MlopsConfig)
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)

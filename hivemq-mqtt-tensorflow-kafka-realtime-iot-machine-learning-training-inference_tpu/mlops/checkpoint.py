@@ -1,0 +1,432 @@
+"""Async checkpointing — snapshot on the train thread, bytes off it.
+
+The seed `train.checkpoint.CheckpointManager` serializes and fsyncs on
+the train thread: every save stalls the hot loop for the full disk
+round trip.  The Orbax async design (PAPERS: *Orbax: Distributed
+Checkpointing with JAX*) splits the save at the device boundary:
+
+- ``snapshot()`` (train thread): one ``jax.device_get`` copies params/
+  opt-state to host memory and the pytree joins a BOUNDED queue with
+  the stream cursors captured at the same instant.  Cost: the device→
+  host copy only (``iotml_checkpoint_seconds{phase="snapshot"}``).
+- the **writer** (background thread, or ``write_once()`` driven
+  deterministically): serializes the snapshot (phase ``serialize``)
+  and commits it to the ``ModelRegistry`` (phase ``fsync`` — the
+  atomic-write + dir-fsync publication), stamping the manifest with
+  the captured offsets so model state and stream position land as one
+  atomic unit.
+
+The queue is **drop-oldest**: when the disk falls behind, pending
+snapshots are evicted (``iotml_checkpoint_dropped_total``) and the
+newest wins — a slow disk degrades checkpoint FREQUENCY, never
+training throughput.  A crash mid-write leaves a torn stage the
+registry never serves (see ``registry.publish``); the writer's loop is
+supervisable (``unit_loop``) so the PR 4 supervisor restarts a crashed
+writer under backoff.
+"""
+
+from __future__ import annotations
+
+import collections
+import io
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..chaos import faults as chaos
+from ..obs import metrics as obs_metrics
+from .registry import ModelRegistry
+
+
+# ------------------------------------------------------- state codecs
+def state_to_npz_bytes(params, opt_state, step: int) -> bytes:
+    """Flatten the (params, opt_state) pytrees to one .npz blob.
+
+    Leaves are stored positionally (``p_<i>`` / ``o_<i>``): restore
+    unflattens onto a template state with the same structure (a freshly
+    initialized Trainer), which is exactly the resume contract — the
+    model architecture is code, only the numbers are data."""
+    import jax
+
+    p_leaves = jax.tree_util.tree_leaves(params)
+    o_leaves = jax.tree_util.tree_leaves(opt_state)
+    arrays = {f"p_{i}": np.asarray(a) for i, a in enumerate(p_leaves)}
+    arrays.update({f"o_{i}": np.asarray(a) for i, a in enumerate(o_leaves)})
+    arrays["step"] = np.asarray(int(step))
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def state_from_npz_bytes(data: bytes, template_state):
+    """Rebuild a TrainState from ``state_to_npz_bytes`` output onto a
+    template with identical tree structure (shape-checked leaf by
+    leaf)."""
+    import jax
+
+    with np.load(io.BytesIO(data)) as z:
+        arrays = {k: z[k] for k in z.files}
+    p_def = jax.tree_util.tree_structure(template_state.params)
+    o_def = jax.tree_util.tree_structure(template_state.opt_state)
+    p_tmpl = jax.tree_util.tree_leaves(template_state.params)
+    o_tmpl = jax.tree_util.tree_leaves(template_state.opt_state)
+    p_leaves = [arrays[f"p_{i}"] for i in range(len(p_tmpl))]
+    o_leaves = [arrays[f"o_{i}"] for i in range(len(o_tmpl))]
+    for got, want in zip(p_leaves + o_leaves, p_tmpl + o_tmpl):
+        if tuple(got.shape) != tuple(np.shape(want)):
+            raise ValueError(
+                f"checkpoint leaf shape {tuple(got.shape)} does not "
+                f"match template {tuple(np.shape(want))} — wrong model "
+                f"architecture for this checkpoint")
+    return template_state.replace(
+        step=arrays["step"],
+        params=jax.tree_util.tree_unflatten(p_def, p_leaves),
+        opt_state=jax.tree_util.tree_unflatten(o_def, o_leaves))
+
+
+def params_to_h5_bytes(params) -> bytes:
+    """Serving weights as the reference's h5 byte layout (what scorers
+    hot-swap; see models/h5_export)."""
+    import os
+    import tempfile
+
+    import jax
+
+    from ..models.h5_export import autoencoder_params_to_h5
+
+    with tempfile.TemporaryDirectory(prefix="iotml_ckpt_") as tmp:
+        path = os.path.join(tmp, "model.h5")
+        autoencoder_params_to_h5(jax.tree.map(np.asarray, params), path)
+        with open(path, "rb") as fh:
+            return fh.read()
+
+
+def params_from_h5_bytes(data: bytes):
+    import os
+    import tempfile
+
+    from ..models.h5_import import autoencoder_params_from_h5
+
+    with tempfile.TemporaryDirectory(prefix="iotml_swap_") as tmp:
+        path = os.path.join(tmp, "model.h5")
+        with open(path, "wb") as fh:
+            fh.write(data)
+        return autoencoder_params_from_h5(path)
+
+
+class _Snapshot:
+    __slots__ = ("params", "opt_state", "step", "offsets", "metrics",
+                 "end_offsets", "t_captured")
+
+    def __init__(self, params, opt_state, step, offsets, metrics,
+                 end_offsets):
+        self.params = params
+        self.opt_state = opt_state
+        self.step = step
+        self.offsets = offsets
+        self.metrics = metrics
+        self.end_offsets = end_offsets
+        self.t_captured = time.monotonic()
+
+
+class AsyncCheckpointer:
+    """Bounded-queue async checkpoint pipeline into a ModelRegistry.
+
+    Args:
+      registry: the destination ``ModelRegistry`` (this object is its
+        single writer).
+      queue_depth: max pending snapshots; beyond it the OLDEST pending
+        snapshot is dropped (counted), newest-wins.
+      save_opt_state: also serialize optimizer moments (``state.npz``)
+        so a resumed trainer continues the same Adam trajectory; off,
+        checkpoints are weights+offsets only (smaller, scorer-grade).
+    """
+
+    def __init__(self, registry: ModelRegistry, queue_depth: int = 2,
+                 save_opt_state: bool = True, auto_promote: bool = True,
+                 min_interval_s: float = 0.0, keep_versions: int = 0):
+        self.registry = registry
+        self.queue_depth = max(1, int(queue_depth))
+        self.save_opt_state = save_opt_state
+        #: registry retention: after each commit, prune committed
+        #: versions beyond the newest ``keep_versions`` (channel targets
+        #: are never pruned).  0 keeps everything — but a continuously-
+        #: checkpointing trainer then grows the registry without bound,
+        #: so the CLIs wire a finite default (MlopsConfig.keep_versions)
+        self.keep_versions = int(keep_versions)
+        #: checkpoint cadence (Orbax's save_interval, in seconds): a
+        #: snapshot arriving sooner than this after the last ACCEPTED
+        #: one is coalesced away (counted) — sub-second training rounds
+        #: must not serialize a version per round; staleness is bounded
+        #: by the interval, correctness by commit-trails-durability
+        #: (a coalesced snapshot just means the next one commits
+        #: further ahead).  0 accepts every snapshot (tests, drills).
+        self.min_interval_s = float(min_interval_s)
+        self.coalesced = 0
+        self._last_accept = float("-inf")
+        #: point the ``serving`` channel at each committed version — the
+        #: continuous-delivery default (watchers hot-swap immediately).
+        #: An A/B-gated deployment turns this OFF and lets the gate own
+        #: promotion through the ``candidate`` channel instead.
+        self.auto_promote = auto_promote
+        #: post-durability hook (set by ContinuousTrainer): called with
+        #: the committed Manifest AFTER publication, ON THE WRITER
+        #: thread — this is where the group commit trails checkpoint
+        #: durability, so committed offsets never outrun a restorable
+        #: model state
+        self.commit_fn: Optional[Callable] = None
+        #: an external supervisor owns the writer loop (unit_loop());
+        #: start() must not race it with a second drainer
+        self._external = False
+        self._queue: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        #: serializes whole drains: flush()/stop() on a caller thread
+        #: may run while a supervised unit_loop (or the owned writer
+        #: thread) is mid-write_once — ModelRegistry.publish is single-
+        #: writer (listdir-based next_version, pid-named stage dir), so
+        #: two concurrent drains could mint the same version id and
+        #: tear each other's stage
+        self._drain_lock = threading.Lock()
+        self._kick = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._idle = threading.Event()
+        self._idle.set()
+        self.written = 0
+        self.dropped = 0
+        self.last_error: Optional[str] = None
+        self.last_version: Optional[int] = None
+
+    # ------------------------------------------------- train-thread side
+    def snapshot(self, state, cursors: Sequence[Tuple[str, int, int]],
+                 metrics: Optional[Dict[str, float]] = None,
+                 end_offsets: Optional[Dict[Tuple[str, int], int]] = None,
+                 force: bool = False) -> None:
+        """Capture (device→host) and enqueue; returns immediately.
+
+        ``cursors`` are the consumer positions AT THIS INSTANT — they
+        ride the snapshot into the manifest, so the committed version
+        names exactly the data this state was trained through.
+        ``end_offsets`` (optional ``(topic, part) → end``) lets the
+        writer export the offsets-lag gauge without touching the
+        broker from the writer thread.  ``force`` bypasses the cadence
+        throttle (shutdown wants the newest state archived)."""
+        import jax
+
+        if not self.would_accept(force):
+            self.coalesced += 1
+            return
+        self._last_accept = time.monotonic()
+        with obs_metrics.checkpoint_seconds.time(phase="snapshot"):
+            params, opt_state = jax.device_get(
+                (state.params, state.opt_state))
+            snap = _Snapshot(params,
+                             opt_state if self.save_opt_state else None,
+                             int(state.step),
+                             [tuple(c) for c in cursors],
+                             dict(metrics or {}),
+                             dict(end_offsets or {}))
+        with self._lock:
+            while len(self._queue) >= self.queue_depth:
+                self._queue.popleft()
+                self.dropped += 1
+                obs_metrics.checkpoint_dropped.inc()
+            self._queue.append(snap)
+            self._idle.clear()
+        self._kick.set()
+
+    def would_accept(self, force: bool = False) -> bool:
+        """Cheap cadence pre-check: would ``snapshot()`` accept right
+        now?  Callers use it to skip the capture itself — consumer
+        positions plus one broker ``end_offset`` round trip per
+        partition are wasted work on a snapshot the throttle would
+        coalesce anyway (see ``ContinuousTrainer._snapshot``)."""
+        return force or self.min_interval_s <= 0 or \
+            time.monotonic() - self._last_accept >= self.min_interval_s
+
+    # ------------------------------------------------------ writer side
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def write_once(self) -> Optional[int]:
+        """Drain ONE pending snapshot into the registry; returns the
+        committed version (None when idle).  This is the deterministic
+        drive point — the writer thread, the chaos runner and tests all
+        come through here, so a fault injected at ``ckpt.write`` tears
+        the same publication step everywhere.  Whole drains are
+        serialized (``_drain_lock``): a shutdown flush may overlap the
+        supervised writer's loop, and the registry is single-writer."""
+        with self._drain_lock:
+            return self._write_one()
+
+    def _write_one(self) -> Optional[int]:
+        with self._lock:
+            if not self._queue:
+                self._idle.set()
+                return None
+            snap = self._queue.popleft()
+        try:
+            with obs_metrics.checkpoint_seconds.time(phase="serialize"):
+                artifacts = {"model.h5": params_to_h5_bytes(snap.params)}
+                if snap.opt_state is not None:
+                    artifacts["state.npz"] = state_to_npz_bytes(
+                        snap.params, snap.opt_state, snap.step)
+            # the faultpoint sits between serialize and the atomic
+            # publication: an injected crash here IS "killed
+            # mid-checkpoint" — host state gone, registry untouched
+            chaos.point("ckpt.write")
+            with obs_metrics.checkpoint_seconds.time(phase="fsync"):
+                manifest = self.registry.publish(
+                    artifacts, offsets=snap.offsets, metrics=snap.metrics,
+                    step=snap.step)
+        except Exception as e:
+            self.last_error = f"{type(e).__name__}: {e}"
+            raise
+        self.written += 1
+        self.last_version = manifest.version
+        try:
+            if self.auto_promote:
+                self.registry.promote(manifest.version)
+            if self.commit_fn is not None:
+                self.commit_fn(manifest)
+        except Exception as e:  # noqa: BLE001 - both edges self-heal:
+            # the next publish re-promotes, the next checkpoint
+            # re-commits forward; surface and let the supervisor decide
+            self.last_error = f"{type(e).__name__}: {e}"
+            raise
+        if snap.end_offsets:
+            lag = sum(max(0, snap.end_offsets.get((t, p), o) - o)
+                      for t, p, o in snap.offsets)
+            obs_metrics.model_offsets_lag.set(
+                lag, component=self.registry.component)
+        if self.keep_versions > 0:
+            self.registry.prune(self.keep_versions)
+        with self._lock:
+            if not self._queue:
+                self._idle.set()
+        return manifest.version
+
+    def flush(self, timeout_s: float = 30.0) -> bool:
+        """Block until every enqueued snapshot is committed (the
+        synchronous edge for shutdown/tests)."""
+        if self._thread is None or not self._thread.is_alive():
+            while self.write_once() is not None:
+                pass
+            return True
+        return self._idle.wait(timeout_s)
+
+    # -------------------------------------------------------- lifecycle
+    def unit_loop(self) -> Callable:
+        """The writer body as a ``SupervisedUnit`` loop: heartbeats per
+        round, crash (injected or real) surfaces to the supervisor,
+        which restarts a fresh incarnation under backoff — pending
+        snapshots survive in the queue."""
+
+        self._external = True
+
+        def loop(unit):
+            while not unit.should_stop():
+                unit.heartbeat()
+                if self.write_once() is None:
+                    self._kick.wait(0.05)
+                    self._kick.clear()
+
+        return loop
+
+    def start(self) -> "AsyncCheckpointer":
+        """Spawn an UNsupervised writer thread (callers that already
+        run a supervisor should register ``unit_loop()`` instead)."""
+        from ..supervise.registry import register_thread
+
+        if self._external or (self._thread is not None
+                              and self._thread.is_alive()):
+            return self
+        self._stop.clear()
+
+        def run():
+            while not self._stop.is_set():
+                try:
+                    wrote = self.write_once()
+                except Exception:  # noqa: BLE001 - a failed write must
+                    # not kill the writer; the snapshot is gone (newest
+                    # wins anyway), the error is surfaced on last_error
+                    wrote = None
+                if wrote is None:
+                    self._kick.wait(0.05)
+                    self._kick.clear()
+
+        self._thread = register_thread(threading.Thread(
+            target=run, daemon=True, name="iotml-ckpt-writer"))
+        self._thread.start()
+        return self
+
+    def stop(self, flush: bool = True, timeout_s: float = 30.0) -> None:
+        if flush:
+            self.flush(timeout_s)
+        self._stop.set()
+        self._kick.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+            self._thread = None
+
+
+# --------------------------------------------------------- restore side
+def restore_trainer(trainer, registry: ModelRegistry,
+                    version: Optional[int] = None):
+    """Warm-start a ``train.loop.Trainer`` from a committed version.
+
+    Returns the manifest (its ``offsets`` are the resume cursors), or
+    None when the registry is empty.  Params always load; optimizer
+    moments load when the version carries ``state.npz`` AND the tree
+    matches, else the optimizer restarts fresh (documented degradation,
+    not an error — weights + offsets are the atomic unit).
+
+    The default version is the NEWEST committed one — the training
+    lineage's tip — never the ``serving`` channel: serving is
+    deployment state (a rollback points it at an OLD version while the
+    group's committed offsets keep following the newest manifest), so
+    resuming from it would pair old weights with new cursors and leave
+    records trained into no model.  The gate protects serving; the
+    trainer resumes where training actually stopped."""
+    if version is None:
+        version = registry.latest()
+    if version is None:
+        return None
+    m = registry.manifest(version)
+    params = params_from_h5_bytes(registry.load_bytes(version, "model.h5"))
+    in_dim = _params_input_dim(params)
+    trainer._ensure_state(np.zeros((1, in_dim), np.float32))
+    if "state.npz" in m.artifacts:
+        try:
+            trainer.state = state_from_npz_bytes(
+                registry.load_bytes(version, "state.npz"), trainer.state)
+            return m
+        except (ValueError, KeyError):
+            pass  # architecture drift: fall through to weights-only
+    # weights-only warm start: graft the loaded leaves onto the template
+    # params' own tree structure (dict vs FrozenDict must not fork the
+    # pytree the optimizer state was built against)
+    import jax
+
+    t_def = jax.tree_util.tree_structure(trainer.state.params)
+    t_leaves = jax.tree_util.tree_leaves(trainer.state.params)
+    l_leaves = jax.tree_util.tree_leaves(params)
+    if len(t_leaves) != len(l_leaves) or any(
+            tuple(np.shape(a)) != tuple(np.shape(b))
+            for a, b in zip(l_leaves, t_leaves)):
+        raise ValueError(
+            f"version {version} weights do not match the trainer's "
+            f"model architecture")
+    trainer.state = trainer.state.replace(
+        params=jax.tree_util.tree_unflatten(t_def, l_leaves),
+        step=np.asarray(m.step, np.int32))
+    return m
+
+
+def _params_input_dim(params) -> int:
+    """First layer's fan-in — the sample-x width state init needs."""
+    first = params.get("encoder0") or params[sorted(params.keys())[0]]
+    return int(np.shape(first["kernel"])[0])

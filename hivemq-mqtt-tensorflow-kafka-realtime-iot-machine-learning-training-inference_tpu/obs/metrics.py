@@ -112,8 +112,9 @@ class Histogram:
                     return
             counts[-1] += 1
 
-    def time(self):
-        """Context manager: observe elapsed seconds."""
+    def time(self, **labels):
+        """Context manager: observe elapsed seconds (optionally into a
+        labeled series, e.g. ``checkpoint_seconds.time(phase="fsync")``)."""
         hist = self
 
         class _T:
@@ -122,7 +123,7 @@ class Histogram:
                 return self
 
             def __exit__(self, *exc):
-                hist.observe(time.perf_counter() - self.t0)
+                hist.observe(time.perf_counter() - self.t0, **labels)
 
         return _T()
 
@@ -291,6 +292,45 @@ cluster_coordinator_moves = default_registry.counter(
     "iotml_cluster_coordinator_moves_total",
     "group-coordinator re-discoveries after NOT_COORDINATOR or a "
     "coordinator broker death")
+# model lifecycle (iotml.mlops): the continuous-delivery loop's own
+# telemetry — which model every process is running (version gauges by
+# component role), how far behind the log the serving model's training
+# data is, and where checkpoint wall-time goes (the "no training stall"
+# claim is only a claim until phase=snapshot is measured on the train
+# thread and serialize/fsync are measured OFF it)
+model_version = default_registry.gauge(
+    "iotml_model_version",
+    "registry version currently loaded, by component "
+    "(trainer = last published, scorer = serving)")
+model_offsets_lag = default_registry.gauge(
+    "iotml_model_offsets_lag",
+    "records between the current model's stamped train offsets and the "
+    "log end (staleness of the serving model's knowledge)")
+checkpoint_seconds = default_registry.histogram(
+    "iotml_checkpoint_seconds",
+    "checkpoint wall-time by phase: snapshot (train thread, device->"
+    "host), serialize + fsync (background writer thread)")
+checkpoint_dropped = default_registry.counter(
+    "iotml_checkpoint_dropped_total",
+    "pending snapshots evicted drop-oldest from the bounded writer "
+    "queue (a slow disk sheds checkpoints, never stalls training)")
+registry_publishes = default_registry.counter(
+    "iotml_registry_publishes_total",
+    "model versions committed to the registry (manifest written)")
+registry_torn_recovered = default_registry.counter(
+    "iotml_registry_torn_recovered_total",
+    "torn/uncommitted version dirs swept by registry recovery")
+registry_pruned = default_registry.counter(
+    "iotml_registry_pruned_total",
+    "committed versions removed by retention (keep-newest-N; channel "
+    "targets are never pruned)")
+model_swaps = default_registry.counter(
+    "iotml_model_swaps_total",
+    "scorer hot-swaps applied by registry watchers (no restart, no "
+    "dropped records)")
+rollouts = default_registry.counter(
+    "iotml_rollouts_total",
+    "A/B rollout gate decisions, by outcome (promoted | rolled_back)")
 # dead-letter queue (streamproc.dlq): poisoned frames routed, by source
 dlq_total = default_registry.counter(
     "iotml_dlq_total",
@@ -334,6 +374,20 @@ def start_http_server(port: int = 9100, registry: Registry = default_registry):
                     for u in units.values()) else doc["status"]
         except Exception:  # noqa: BLE001 - health endpoint stays up
             pass
+        # model identity (ISSUE 7): which registry version this process
+        # runs, per component role, plus the offsets staleness of that
+        # model — the rollout/rollback machinery's state surfaced where
+        # probes already look
+        with model_version._lock:
+            mv = dict(model_version._vals)
+        if mv:
+            with model_offsets_lag._lock:
+                lag = dict(model_offsets_lag._vals)
+            doc["model"] = {
+                dict(k).get("component", ""): {
+                    "version": int(v),
+                    "offsets_lag": lag.get(k)}
+                for k, v in mv.items()}
         with replica_lag._lock:
             lag_vals = dict(replica_lag._vals)
         if lag_vals:

@@ -35,19 +35,36 @@ class LiveScorer:
     """Continuous scoring with pointer-driven weight hot-swap."""
 
     def __init__(self, broker, topic: str, result_topic: str,
-                 store: ArtifactStore, model_name: str = "cardata-live.h5",
+                 store: Optional[ArtifactStore],
+                 model_name: str = "cardata-live.h5",
                  model=None, threshold: Optional[float] = 5.0,
                  group: str = "cardata-live-score", batch_size: int = 100,
                  out_partition: Optional[int] = 0,
                  carhealth_topic: Optional[str] = "car-health",
                  car_threshold=0.38, car_feature_heads: bool = False,
-                 normalizer=None):
+                 normalizer=None, registry=None):
         if model is None:
             from ..models.autoencoder import CAR_AUTOENCODER
 
             model = CAR_AUTOENCODER
+        if store is None and registry is None:
+            raise ValueError("need an ArtifactStore pointer or a "
+                             "ModelRegistry (iotml.mlops) to follow")
         self.broker = broker
         self.store = store
+        #: versioned-registry mode (iotml.mlops): follow the registry's
+        #: ``serving`` channel instead of the `.latest` pointer file —
+        #: promote/rollback flips land here between super-batches.  The
+        #: swap protocol itself (channel read, checksum-verified h5
+        #: load, set_params fan-out, swap metrics) lives in ONE place:
+        #: a RegistryWatcher polled inline from this loop
+        self.registry = registry
+        self._watcher = None
+        if registry is not None:
+            from ..mlops.rollout import RegistryWatcher
+
+            self._watcher = RegistryWatcher(registry, component="scorer")
+        self._current_version: Optional[int] = None
         self.model_name = model_name
         self.model = model
         parts = range(broker.topic(topic).partitions)
@@ -86,6 +103,8 @@ class LiveScorer:
                                    carhealth=carhealth,
                                    carhealth_topic=carhealth_topic,
                                    verdict_mask=verdict_mask)
+        if self._watcher is not None:
+            self._watcher.attach(self.scorer)
         self._current_artifact: Optional[str] = None
         self.model_updates = 0
 
@@ -103,7 +122,16 @@ class LiveScorer:
         obs_metrics.live_model_updates.inc()
 
     def maybe_swap(self) -> bool:
-        """Poll the pointer; swap when it names a new immutable blob."""
+        """Poll the pointer (or the registry's serving channel); swap
+        when it names a new version."""
+        if self._watcher is not None:
+            if not self._watcher.poll_once():
+                return False
+            self._current_version = self._watcher.current_version
+            self._current_artifact = f"registry:v{self._current_version}"
+            self.model_updates += 1
+            obs_metrics.live_model_updates.inc()
+            return True
         latest = self.store.get_text(f"{self.model_name}.latest")
         if latest is None or latest == self._current_artifact:
             return False

@@ -117,6 +117,9 @@ class StreamScorer:
         self.carhealth_topic = carhealth_topic
         self._eval = make_eval_step(model)
         self.scored = 0
+        #: registry version of the loaded weights (None = not registry-
+        #: managed); stamped by set_params(version=) / RegistryWatcher
+        self.model_version: Optional[int] = None
         #: suspended (iterator, index_base) of a max_rows-truncated drain
         self._resume = None
         #: confusion counts of the threshold verdicts against stream labels
@@ -133,7 +136,7 @@ class StreamScorer:
         self.err_hist = {"true": np.zeros(len(ERR_BUCKETS) + 1, np.int64),
                          "false": np.zeros(len(ERR_BUCKETS) + 1, np.int64)}
 
-    def set_params(self, params) -> None:
+    def set_params(self, params, version: Optional[int] = None) -> None:
         """Hot-swap model weights; takes effect at the next super-batch.
 
         The handoff the reference performs by restarting its predict pod
@@ -141,8 +144,12 @@ class StreamScorer:
         scorer swaps in place instead.  The jit eval traces params as
         arguments, so same-shaped params reuse the compiled program, and
         the swap cannot drop or reorder output: the OutputSequence index
-        stream is untouched."""
+        stream is untouched.  ``version`` (a registry id) stamps the
+        scorer's model identity for /healthz + the version gauge."""
         self.params = params
+        if version is not None:
+            self.model_version = version
+            obs_metrics.model_version.set(version, component="scorer")
         if self.carhealth is not None and \
                 hasattr(self.carhealth, "notify_model_swap"):
             # new weights shift every car's error together: the detector

@@ -18,7 +18,7 @@ package (`segment.SegmentWriter` owns the bytes and the fsync ledger).
 from .log import SegmentedLog, StorePolicy
 from .mount import StoreMount
 from .offsets import OffsetsFile
-from .segment import SegmentWriter, crc32c
+from .segment import SegmentWriter, atomic_write, crc32c, fsync_dir
 
 __all__ = ["SegmentedLog", "StorePolicy", "StoreMount", "OffsetsFile",
-           "SegmentWriter", "crc32c"]
+           "SegmentWriter", "atomic_write", "crc32c", "fsync_dir"]

@@ -317,3 +317,18 @@ def atomic_write(path: str, data: bytes, fsync: bool = True) -> None:
             fh.flush()
             os.fsync(fh.fileno())
     os.replace(tmp, path)
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so a completed rename survives power loss.
+
+    `os.replace` makes publication atomic against readers; making it
+    durable needs the parent directory's metadata flushed too.  Lives
+    here for the R9 reason above: fsync promises are made in one
+    package (the mlops registry and the orbax checkpoint wrapper call
+    this instead of growing their own fsync)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)

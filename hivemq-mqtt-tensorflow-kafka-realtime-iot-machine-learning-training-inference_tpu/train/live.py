@@ -47,17 +47,22 @@ class ContinuousTrainer:
         sub-second so the scorer sees fresh weights quickly).
     """
 
-    def __init__(self, broker, topic: str, store: ArtifactStore,
+    def __init__(self, broker, topic: str, store: Optional[ArtifactStore],
                  model_name: str = "cardata-live.h5",
                  group: str = "cardata-live-train",
                  model=None, batch_size: int = 100, take_batches: int = 20,
                  epochs_per_round: int = 1, only_normal: bool = True,
                  learning_rate: float = 1e-3, normalizer=None,
-                 backfill_since_ms: Optional[int] = None):
+                 backfill_since_ms: Optional[int] = None,
+                 registry=None, checkpointer=None, warm_start: bool = True,
+                 checkpoint_interval_s: float = 0.0):
         if model is None:
             from ..models.autoencoder import CAR_AUTOENCODER
 
             model = CAR_AUTOENCODER
+        if store is None and registry is None and checkpointer is None:
+            raise ValueError("need an ArtifactStore, a ModelRegistry, or "
+                             "an AsyncCheckpointer to publish models to")
         self.broker = broker
         self.topic = topic
         self.store = store
@@ -68,26 +73,70 @@ class ContinuousTrainer:
         self.take_batches = take_batches
         self.epochs_per_round = epochs_per_round
         self.trainer = Trainer(model, learning_rate=learning_rate)
+        # versioned-registry mode (iotml.mlops): checkpoints publish
+        # async into the registry, each stamped with the cursors it was
+        # trained through, and the GROUP COMMIT trails checkpoint
+        # durability (the writer commits the manifest's offsets after
+        # publication) — so committed <= manifest offsets always, and a
+        # crash resumes model + stream position as one consistent unit
+        self.registry = registry
+        self.checkpointer = checkpointer
+        if registry is not None and checkpointer is None:
+            from ..mlops.checkpoint import AsyncCheckpointer
+
+            self.checkpointer = AsyncCheckpointer(
+                registry, min_interval_s=checkpoint_interval_s)
+        if self.checkpointer is not None:
+            self.registry = self.checkpointer.registry
+            self.checkpointer.commit_fn = self._commit_checkpointed
         parts = range(broker.topic(topic).partitions)
+        self._parts = list(parts)
         # ONE persistent cursor for the process lifetime: rebuilding a
         # consumer per round (and re-reading committed offsets) was the
         # dominant cost of the naive loop
         self.consumer = StreamConsumer.from_committed(broker, topic, parts,
                                                       group=group)
+        # registry warm start: reload the newest committed version's
+        # weights (+ optimizer moments when archived) and its stamped
+        # offsets — the manifest beats BOTH offset 0 and backfill for
+        # its partitions, because the restored model already knows the
+        # data up to those cursors (re-reading it is double-train, and
+        # a timestamp seek past them is a gap in the model's knowledge)
+        manifest_offsets = {}
+        if self.registry is not None and warm_start:
+            from ..mlops.checkpoint import restore_trainer
+
+            m = restore_trainer(self.trainer, self.registry)
+            if m is not None:
+                manifest_offsets = {(t, p): off for t, p, off in m.offsets}
+                self.restored_version: Optional[int] = m.version
+            else:
+                self.restored_version = None
+        else:
+            self.restored_version = None
         # cold-start backfill (the durable store's replay API): a FIRST
-        # incarnation of this group — no committed cursor — starts from
-        # the log's history at `backfill_since_ms` instead of offset 0 of
-        # whatever happens to be retained, so a trainer deployed against
-        # a long-retained durable topic trains on exactly the requested
-        # window.  Partitions WITH a committed cursor are never moved
-        # (resume beats replay; the committed contract stays intact).
+        # incarnation of this group — no committed cursor, no manifest —
+        # starts from the log's history at `backfill_since_ms` instead
+        # of offset 0 of whatever happens to be retained, so a trainer
+        # deployed against a long-retained durable topic trains on
+        # exactly the requested window.  Partitions WITH a committed
+        # cursor or a manifest cursor are never moved (resume beats
+        # replay; the committed contract stays intact).
         if backfill_since_ms is not None:
             oft = getattr(broker, "offset_for_timestamp", None)
             if oft is not None:
                 for p in parts:
-                    if broker.committed(group, topic, p) is None:
+                    if broker.committed(group, topic, p) is None and \
+                            (topic, p) not in manifest_offsets:
                         self.consumer.seek(
                             topic, p, oft(topic, p, backfill_since_ms))
+        # apply manifest cursors FORWARD-ONLY: committed can trail the
+        # manifest (commit follows checkpoint) but must never be
+        # rewound — commits stay monotonic even across a restore
+        for (t, p), off in manifest_offsets.items():
+            cur = broker.committed(group, t, p) or 0
+            if off > cur:
+                self.consumer.seek(t, p, off)
         # large poll chunks: each wire fetch is a round trip into the
         # broker process (expensive when that process is busy), and the
         # batcher's poll budgeting (_need_rows) guarantees a bounded
@@ -122,10 +171,23 @@ class ContinuousTrainer:
         self.last_loss = float(history["loss"][-1])
         obs_metrics.live_train_rounds.inc()
         obs_metrics.live_train_loss.set(self.last_loss)
-        artifact = self.publish()
-        # commit AFTER the artifact is durable (the `committed` resume
-        # contract: a crash re-trains the slice rather than skipping it)
-        self.consumer.commit()
+        if self.checkpointer is not None:
+            # async path: capture (device->host) the state + the exact
+            # cursors it was trained through and return to training —
+            # serialize/fsync happen on the writer thread, and the
+            # GROUP COMMIT trails durability (_commit_checkpointed runs
+            # after the manifest lands), so a crash at ANY point
+            # resumes model + stream position as one consistent unit
+            self._snapshot()
+            artifact = f"registry:r{self.rounds}"
+            if self.store is not None:  # legacy pointer riders along
+                artifact = self.publish()
+        else:
+            artifact = self.publish()
+            # commit AFTER the artifact is durable (the `committed`
+            # resume contract: a crash re-trains the slice rather than
+            # skipping it)
+            self.consumer.commit()
         return {"t": time.time(), "round": self.rounds,
                 "loss": self.last_loss,
                 "records": history["records"][-1],
@@ -148,11 +210,65 @@ class ContinuousTrainer:
         self.store.put_text(f"{self.model_name}.latest", name)
         return name
 
+    def _snapshot(self, force: bool = False) -> None:
+        """Enqueue the current state + cursors for the async writer.
+        The checkpointer's cadence throttle may coalesce it away
+        (tracked so a clean exit can force-archive the newest state)."""
+        if not self.checkpointer.would_accept(force):
+            # skip the capture entirely: positions() plus one broker
+            # end_offset round trip per partition is wasted work on a
+            # snapshot the throttle would discard — with sub-second
+            # rounds that's nearly every round
+            self.checkpointer.coalesced += 1
+            self._last_coalesced = True
+            return
+        before = self.checkpointer.coalesced
+        cursors = self.consumer.positions()
+        ends = {(t, p): self.broker.end_offset(t, p)
+                for t, p, _off in cursors}
+        self.checkpointer.snapshot(
+            self.trainer.state, cursors,
+            metrics={"loss": self.last_loss if self.last_loss is not None
+                     else float("nan"),
+                     "records": float(self.records_trained)},
+            end_offsets=ends, force=force)
+        self._last_coalesced = self.checkpointer.coalesced > before
+
+    def _commit_checkpointed(self, manifest) -> None:
+        """The writer's post-durability hook: commit the manifest's
+        stamped offsets for this group, FORWARD-ONLY.  Runs on the
+        checkpoint-writer thread after publication, so committed <=
+        newest-durable-manifest offsets at every instant — the crash-
+        consistency edge the warm start relies on.  A skipped (dropped)
+        snapshot just means the next one commits further ahead."""
+        by_topic: dict = {}
+        for t, p, off in manifest.offsets:
+            cur = self.broker.committed(self.group, t, p)
+            if cur is None or off > cur:
+                by_topic.setdefault(t, []).append((p, off))
+        commit_many = getattr(self.broker, "commit_many", None)
+        for t, entries in by_topic.items():
+            if commit_many is not None:
+                commit_many(self.group, t, entries)
+            else:
+                for p, off in entries:
+                    self.broker.commit(self.group, t, p, off)
+
+    def close(self, timeout_s: float = 30.0) -> None:
+        """Flush pending checkpoints and stop an owned writer thread."""
+        if self.checkpointer is not None:
+            self.checkpointer.stop(flush=True, timeout_s=timeout_s)
+
     def run(self, stop: Optional[Callable[[], bool]] = None,
             max_rounds: Optional[int] = None,
             poll_interval_s: float = 0.05,
             on_round: Optional[Callable[[dict], None]] = None) -> int:
         """Train rounds until `stop()` or `max_rounds`; returns rounds run."""
+        if self.checkpointer is not None:
+            # live mode owns its writer thread (idempotent; a no-op when
+            # a supervisor registered unit_loop() instead); deterministic
+            # tests call train_round() + write_once() directly
+            self.checkpointer.start()
         start = self.rounds
         while (stop is None or not stop()) and \
                 (max_rounds is None or self.rounds - start < max_rounds):
@@ -163,4 +279,12 @@ class ContinuousTrainer:
             stats = self.train_round()
             if stats and on_round is not None:
                 on_round(stats)
+        if self.checkpointer is not None:
+            # the newest state must not die on a clean exit: re-enqueue
+            # it when the cadence throttle coalesced the last round's
+            # snapshot, then drain the queue
+            if self.rounds > start and getattr(self, "_last_coalesced",
+                                               False):
+                self._snapshot(force=True)
+            self.checkpointer.flush(timeout_s=30.0)
         return self.rounds - start

@@ -1,0 +1,45 @@
+"""R11 fixture: naked model-registry writes, every way to get it
+wrong — a builtin open() on a registry path (1 finding), an os.open on
+a version dir (1 finding), an atomic_write landing a manifest by hand
+(1 finding) — plus the clean shapes: a registry READ through the
+ModelRegistry API, an open() on an unrelated path, and a justified
+suppression (0 findings)."""
+
+import os
+
+
+def hand_rolled_publish(registry_dir):
+    # flagged: the manifest is the COMMIT MARKER — writing it by hand
+    # skips the staged rename, the checksums and the fsync, so a crash
+    # can leave a manifest that lies about its artifacts
+    with open(os.path.join(registry_dir, "versions", "v42",
+                           "manifest.json"), "w") as fh:
+        fh.write("{}")
+
+
+def poke_version_dir(version_dir):
+    # flagged: registry version dirs are immutable once committed
+    fd = os.open(os.path.join(version_dir, "model.h5"), os.O_WRONLY)
+    os.close(fd)
+
+
+def atomic_but_still_wrong(registry_root, atomic_write):
+    # flagged: atomicity is not the point — ONE writer is; this blob
+    # has no manifest entry, no checksum, no lineage
+    atomic_write(os.path.join(registry_root, "versions", "v7",
+                              "extra.bin"), b"orphan artifact")
+
+
+def reading_is_fine(registry):
+    # the API is the boundary, not the disk: reads go through it
+    return registry.load_bytes(registry.latest(), "model.h5")
+
+
+def unrelated_write_is_fine(tmp_dir):
+    with open(os.path.join(tmp_dir, "manifest.txt"), "w") as fh:
+        fh.write("not a registry manifest: no finding")
+
+
+def justified(registry_dir):
+    # lint-ok: R11 read-only existence probe; opens nothing for writing
+    return os.path.exists(os.path.join(registry_dir, "versions"))

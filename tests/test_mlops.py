@@ -1,0 +1,592 @@
+"""iotml.mlops — versioned registry, async checkpointing, hot-swap
+rollout, rollback gate, and the trainer crash/resume contract.
+
+The ISSUE-7 checklist drives the crash cases: a publish killed between
+artifact staging and the manifest leaves a torn (manifest-less) version
+dir that readers never see and recover() sweeps; a restarted trainer
+resumes model + stream cursors from the last DURABLE manifest — no gap,
+no double-train — and manifest cursors beat backfill_since_ms for their
+partitions (PR 5 interaction).  The live drills and chaos scenarios
+cover the threaded / under-load shapes; these tests pin the unit
+semantics deterministically (write_once-driven, no writer thread).
+"""
+
+import json
+import os
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+from iotml.gen.simulator import FleetGenerator, FleetScenario
+from iotml.mlops import (ABRollout, AsyncCheckpointer, Manifest,
+                         ModelRegistry, RegistryWatcher, RolloutGate,
+                         restore_trainer)
+from iotml.mlops.checkpoint import (params_from_h5_bytes,
+                                    params_to_h5_bytes)
+from iotml.models.autoencoder import CAR_AUTOENCODER
+from iotml.stream.broker import Broker
+from iotml.train.live import ContinuousTrainer
+from iotml.train.loop import Trainer
+
+TOPIC = "SENSOR_DATA_S_AVRO"
+
+
+def _seed(broker, n_records, failure_rate=0.02, partitions=2):
+    gen = FleetGenerator(FleetScenario(num_cars=100,
+                                       failure_rate=failure_rate))
+    return gen.publish(broker, TOPIC, n_ticks=n_records // 100,
+                       partitions=partitions)
+
+
+def _params(seed=0):
+    import jax
+
+    tr = Trainer(CAR_AUTOENCODER, rng=jax.random.PRNGKey(seed))
+    tr._ensure_state(np.zeros((4, 18), np.float32))
+    return jax.device_get(tr.state.params)
+
+
+# ------------------------------------------------------------- registry
+def test_registry_publish_channels_history_checksum(tmp_path):
+    reg = ModelRegistry(str(tmp_path))
+    assert reg.versions() == [] and reg.latest() is None
+    m1 = reg.publish({"model.h5": params_to_h5_bytes(_params(0))},
+                     offsets=[(TOPIC, 0, 10), (TOPIC, 1, 12)],
+                     metrics={"loss": 0.5}, step=7)
+    assert m1.version == 1 and m1.parent is None and m1.step == 7
+    m2 = reg.publish({"model.h5": params_to_h5_bytes(_params(1))})
+    assert m2.version == 2
+    assert reg.versions() == [1, 2]
+    # manifest round-trips offsets/metrics through disk
+    got = reg.manifest(1)
+    assert got.offsets == [(TOPIC, 0, 10), (TOPIC, 1, 12)]
+    assert got.metrics == {"loss": 0.5}
+    # channels: promote / rollback are pointer flips with history
+    reg.promote(2)
+    assert reg.channel("serving") == 2
+    reg.rollback(1)
+    assert reg.channel("serving") == 1
+    events = [e["event"] for e in reg.history()]
+    assert events == ["publish", "publish", "promote", "rollback"]
+    with pytest.raises(ValueError):
+        reg.channel("staging")  # unknown channel names fail loudly
+    with pytest.raises(KeyError):
+        reg.set_channel("serving", 99)  # uncommitted version
+    # the serving cell moves like a leadership topology: rollback is a
+    # NEW epoch serving an OLD version
+    assert reg.cell.leader == "v0000000001"
+    assert reg.cell.epoch == 3  # v2's epoch 2, then rollback bumped
+    # artifact reads are checksum-verified
+    blob = reg.load_bytes(1, "model.h5")
+    assert params_from_h5_bytes(blob) is not None
+    path = reg.artifact_path(2, "model.h5")
+    with open(path, "r+b") as fh:
+        fh.seek(0, os.SEEK_END)
+        fh.truncate(fh.tell() - 1)  # torn blob
+    with pytest.raises(ValueError, match="checksum"):
+        reg.load_bytes(2, "model.h5")
+
+
+def test_registry_torn_publish_invisible_and_swept(tmp_path):
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish({"model.h5": b"x" * 10})
+    # simulate a kill between the stage rename and the manifest write:
+    # a version dir without a manifest (exactly what the registry.commit
+    # faultpoint produces) plus an abandoned stage dir
+    torn = reg.version_dir(2)
+    os.makedirs(torn)
+    with open(os.path.join(torn, "model.h5"), "wb") as fh:
+        fh.write(b"torn")
+    stage = os.path.join(str(tmp_path), ".stage-v0000000003-999")
+    os.makedirs(stage)
+    # readers never see either
+    assert reg.versions() == [1]
+    assert reg.latest() == 1
+    with pytest.raises(KeyError):
+        reg.manifest(2)
+    # recover() sweeps both; committed state untouched
+    assert reg.recover() == 2
+    assert not os.path.isdir(torn) and not os.path.isdir(stage)
+    assert reg.versions() == [1]
+    # the torn id is REUSED: ids number commits, not attempts
+    m = reg.publish({"model.h5": b"y" * 10})
+    assert m.version == 2
+    assert reg.versions() == [1, 2]
+
+
+def test_channel_pointer_to_missing_version_falls_back(tmp_path):
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish({"model.h5": b"a"})
+    reg.publish({"model.h5": b"b"})
+    reg.promote(2)
+    # manual surgery / crash between sweep and re-point: the pointer
+    # names a version that no longer exists
+    shutil.rmtree(reg.version_dir(2))
+    assert reg.channel("serving") == 1  # newest intact, not None
+
+
+def test_registry_prune_keeps_newest_and_channel_targets(tmp_path):
+    reg = ModelRegistry(str(tmp_path))
+    for i in range(6):
+        reg.publish({"model.h5": bytes([i]) * 8})
+    reg.set_channel("serving", 2)  # a rolled-back-to old version
+    assert reg.prune(keep=2) == 3  # v1, v3, v4 removed
+    # newest 2 survive, and the serving target is never pruned
+    assert reg.versions() == [2, 5, 6]
+    assert reg.channel("serving") == 2
+    # ids stay monotonic across a prune: latest() survives every sweep
+    assert reg.next_version() == 7
+    assert reg.prune(keep=2) == 0  # idempotent at the bound
+    events = [e["event"] for e in reg.history()]
+    assert events.count("prune") == 1
+
+
+# ------------------------------------------------- async checkpointer
+def test_checkpointer_queue_coalesce_drop_oldest_and_metrics(tmp_path):
+    from iotml.obs import metrics as obs_metrics
+
+    reg = ModelRegistry(str(tmp_path))
+    ck = AsyncCheckpointer(reg, queue_depth=2, min_interval_s=0.0)
+    tr = Trainer(CAR_AUTOENCODER)
+    tr._ensure_state(np.zeros((4, 18), np.float32))
+    # bounded queue: 3 snapshots into depth 2 evicts the OLDEST
+    for i in range(3):
+        ck.snapshot(tr.state, [(TOPIC, 0, 10 + i)])
+    assert ck.pending() == 2 and ck.dropped == 1
+    v1 = ck.write_once()
+    v2 = ck.write_once()
+    assert ck.write_once() is None
+    assert (v1, v2) == (1, 2)
+    # the dropped snapshot was the oldest: offsets jump 11 -> 12
+    assert reg.manifest(1).offsets == [(TOPIC, 0, 11)]
+    assert reg.manifest(2).offsets == [(TOPIC, 0, 12)]
+    # auto_promote pointed serving at each commit
+    assert reg.channel("serving") == 2
+    # cadence throttle: a snapshot arriving inside min_interval_s is
+    # coalesced away; force= bypasses (the shutdown edge)
+    ck.min_interval_s = 60.0
+    ck._last_accept = time.monotonic()
+    ck.snapshot(tr.state, [(TOPIC, 0, 13)])
+    assert ck.coalesced == 1 and ck.pending() == 0
+    ck.snapshot(tr.state, [(TOPIC, 0, 13)], force=True)
+    assert ck.pending() == 1 and ck.write_once() == 3
+    # phase-labeled checkpoint timings recorded
+    with obs_metrics.checkpoint_seconds._lock:
+        phases = {dict(k).get("phase")
+                  for k in obs_metrics.checkpoint_seconds._series}
+    assert {"snapshot", "serialize", "fsync"} <= phases
+
+
+def test_commit_fn_runs_after_durability(tmp_path):
+    reg = ModelRegistry(str(tmp_path))
+    ck = AsyncCheckpointer(reg)
+    seen = []
+    ck.commit_fn = lambda m: seen.append(
+        (m.version, reg.versions()[-1]))
+    tr = Trainer(CAR_AUTOENCODER)
+    tr._ensure_state(np.zeros((4, 18), np.float32))
+    ck.snapshot(tr.state, [(TOPIC, 0, 5)])
+    ck.write_once()
+    # by the time the hook ran, the manifest it names was committed
+    assert seen == [(1, 1)]
+
+
+def test_restore_trainer_full_state_and_weights_only(tmp_path):
+    import jax
+
+    reg = ModelRegistry(str(tmp_path))
+    assert restore_trainer(Trainer(CAR_AUTOENCODER), reg) is None  # empty
+    src = Trainer(CAR_AUTOENCODER)
+    src._ensure_state(np.zeros((4, 18), np.float32))
+    src.state = src.state.replace(step=np.asarray(41, np.int32))
+    ck = AsyncCheckpointer(reg)  # save_opt_state=True
+    ck.snapshot(src.state, [(TOPIC, 0, 3)], metrics={"loss": 1.0})
+    ck.write_once()
+    # full restore: params AND optimizer moments AND step
+    dst = Trainer(CAR_AUTOENCODER)
+    m = restore_trainer(dst, reg)
+    assert m.version == 1 and int(dst.state.step) == 41
+    for a, b in zip(jax.tree_util.tree_leaves(dst.state.params),
+                    jax.tree_util.tree_leaves(src.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree_util.tree_leaves(dst.state.opt_state),
+                    jax.tree_util.tree_leaves(src.state.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # weights-only version (scorer-grade checkpoint): opt restarts fresh
+    ck2 = AsyncCheckpointer(reg, save_opt_state=False)
+    ck2.snapshot(src.state, [(TOPIC, 0, 9)])
+    ck2.write_once()
+    assert "state.npz" not in reg.manifest(2).artifacts
+    dst2 = Trainer(CAR_AUTOENCODER)
+    m2 = restore_trainer(dst2, reg)
+    assert m2.version == 2
+    for a, b in zip(jax.tree_util.tree_leaves(dst2.state.params),
+                    jax.tree_util.tree_leaves(src.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_restore_trainer_resumes_lineage_tip_not_serving(tmp_path):
+    """A rollback points serving at an OLD version while committed
+    offsets keep following the newest manifest — the resumed trainer
+    must load the lineage tip, or records in between would be trained
+    into no model."""
+    reg = ModelRegistry(str(tmp_path))
+    src = Trainer(CAR_AUTOENCODER)
+    src._ensure_state(np.zeros((4, 18), np.float32))
+    ck = AsyncCheckpointer(reg)
+    ck.snapshot(src.state, [(TOPIC, 0, 10)])
+    ck.write_once()
+    ck.snapshot(src.state, [(TOPIC, 0, 20)])
+    ck.write_once()
+    reg.rollback(1)  # quality gate rejected v2; serving back at v1
+    m = restore_trainer(Trainer(CAR_AUTOENCODER), reg)
+    assert m.version == 2  # newest committed, NOT the serving channel
+    assert m.offsets == [(TOPIC, 0, 20)]
+
+
+def test_checkpointer_keep_versions_prunes_after_commit(tmp_path):
+    reg = ModelRegistry(str(tmp_path))
+    ck = AsyncCheckpointer(reg, keep_versions=2)
+    tr = Trainer(CAR_AUTOENCODER)
+    tr._ensure_state(np.zeros((4, 18), np.float32))
+    for i in range(4):
+        ck.snapshot(tr.state, [(TOPIC, 0, i)])
+        ck.write_once()
+    # retention rode every commit: only the newest 2 remain (serving —
+    # auto-promoted to the newest — is inside the window)
+    assert reg.versions() == [3, 4]
+    assert reg.channel("serving") == 4
+
+
+# ------------------------------------------- trainer crash / resume
+def test_trainer_crash_resumes_from_stamped_offsets(tmp_path):
+    """Kill-and-remount: the resumed trainer re-consumes from EXACTLY
+    the last durable manifest's offsets — no gap (work past the
+    checkpoint is re-trained), no double-train (work inside it is
+    not)."""
+    broker = Broker()
+    _seed(broker, 3000)
+    group = "crash-train"
+
+    tr = ContinuousTrainer(broker, TOPIC, None,
+                           registry=ModelRegistry(str(tmp_path)),
+                           group=group, take_batches=5)
+    tr.train_round()
+    tr.checkpointer.write_once()
+    tr.train_round()
+    tr.checkpointer.write_once()
+    durable = dict(tr.registry.manifest(2).offsets and
+                   {(t, p): o for t, p, o in tr.registry.manifest(2).offsets})
+    # a third round trains but its checkpoint never lands (the crash):
+    # the snapshot sits in the abandoned incarnation's queue
+    tr.train_round()
+    assert tr.checkpointer.pending() == 1
+    advanced = {(t, p): o for t, p, o in tr.consumer.positions()}
+    assert any(advanced[k] > durable[k] for k in durable)
+    # commit trailed durability: committed == manifest-2 offsets, NOT
+    # the crashed round's progress
+    for (t, p), off in durable.items():
+        assert broker.committed(group, t, p) == off
+
+    # ---- incarnation 2 mounts the same registry root
+    reg2 = ModelRegistry(str(tmp_path))
+    reg2.recover()
+    tr2 = ContinuousTrainer(broker, TOPIC, None, registry=reg2,
+                            group=group, take_batches=5)
+    assert tr2.restored_version == 2
+    assert int(tr2.trainer.state.step) == reg2.manifest(2).step
+    resumed = {(t, p): o for t, p, o in tr2.consumer.positions()}
+    assert resumed == durable  # the contract, exactly
+    # and it trains forward from there
+    stats = tr2.train_round()
+    v = tr2.checkpointer.write_once()
+    assert stats["records"] > 0 and v == 3
+    after = {(t, p): o for t, p, o in reg2.manifest(3).offsets}
+    assert all(after[k] >= durable[k] for k in durable)
+
+
+def test_manifest_cursors_beat_backfill_since_ms(tmp_path):
+    """PR 5 interaction: a restored manifest's stamped cursors win over
+    backfill_since_ms for their partitions (re-reading data the model
+    already knows is double-train); a partition the manifest does not
+    cover still backfills."""
+    b = Broker(store_dir=str(tmp_path / "store"))
+    try:
+        b.create_topic("t", partitions=2)
+        for i in range(50):
+            b.produce("t", str(i).encode(), partition=i % 2,
+                      timestamp_ms=1000 + i)
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        src = Trainer(CAR_AUTOENCODER)
+        src._ensure_state(np.zeros((4, 18), np.float32))
+        ck = AsyncCheckpointer(reg)
+        ck.snapshot(src.state, [("t", 0, 17)])  # partition 1 not stamped
+        ck.write_once()
+        ct = ContinuousTrainer(b, "t", None, registry=reg,
+                               group="cold-mlops",
+                               backfill_since_ms=1030)
+        pos = {p: off for _t, p, off in ct.consumer.positions()}
+        assert pos[0] == 17  # manifest beats backfill
+        assert pos[1] == b.offset_for_timestamp("t", 1, 1030)
+        assert pos[1] > 0  # uncovered partition still backfills
+    finally:
+        b.close()
+
+
+def test_manifest_cursor_never_rewinds_committed(tmp_path):
+    """Committed offsets ahead of the manifest (a later incarnation
+    committed further under a different registry) are never rewound —
+    commits stay monotonic across restore."""
+    broker = Broker()
+    broker.create_topic("t", partitions=1)
+    for i in range(40):
+        broker.produce("t", str(i).encode(), partition=0)
+    broker.commit("fwd", "t", 0, 30)
+    reg = ModelRegistry(str(tmp_path))
+    src = Trainer(CAR_AUTOENCODER)
+    src._ensure_state(np.zeros((4, 18), np.float32))
+    ck = AsyncCheckpointer(reg)
+    ck.snapshot(src.state, [("t", 0, 12)])  # manifest BEHIND the commit
+    ck.write_once()
+    ct = ContinuousTrainer(broker, "t", None, registry=reg, group="fwd")
+    pos = {p: off for _t, p, off in ct.consumer.positions()}
+    assert pos[0] == 30  # resume from committed, not the older manifest
+
+
+# --------------------------------------- legacy CheckpointManager (R10)
+def test_ckptmanager_atomic_save_and_torn_restore(tmp_path):
+    from iotml.train.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path))
+    src = Trainer(CAR_AUTOENCODER)
+    src._ensure_state(np.zeros((4, 18), np.float32))
+    src.state = src.state.replace(step=np.asarray(1, np.int32))
+    mgr.save(src.state, step=1, cursors=[(TOPIC, 0, 5)])
+    src.state = src.state.replace(step=np.asarray(2, np.int32))
+    mgr.save(src.state, step=2, cursors=[(TOPIC, 0, 9)])
+    assert mgr.steps() == [1, 2]
+    # no staged .tmp dirs survive a completed save
+    assert not [n for n in os.listdir(str(tmp_path))
+                if n.startswith(".tmp_step_")]
+    # tear the LATEST checkpoint (pre-atomic-save legacy / bit rot):
+    # restore() must skip back to the newest intact step, not raise
+    step2 = os.path.join(str(tmp_path), "step_0000000002")
+    shutil.rmtree(step2)
+    os.makedirs(step2)
+    with open(os.path.join(step2, "checkpoint"), "wb") as fh:
+        fh.write(b"garbage that is not an orbax tree")
+    payload = mgr.restore()
+    assert payload is not None
+    assert int(payload["step"]) == 1
+    assert payload["cursors"] == [(TOPIC, 0, 5)]
+    assert mgr.skipped_torn == 1
+    # an explicitly named torn step still raises: the caller named it
+    with pytest.raises(Exception):
+        mgr.restore(step=2)
+
+
+# ------------------------------------------------- watcher + rollout
+class _StubScorer:
+    def __init__(self):
+        self.params = None
+        self.model_version = None
+
+    def set_params(self, params, version=None):
+        self.params = params
+        self.model_version = version
+
+
+def test_registry_watcher_swaps_and_late_attach(tmp_path):
+    reg = ModelRegistry(str(tmp_path))
+    v1 = reg.publish({"model.h5": params_to_h5_bytes(_params(0))}).version
+    reg.promote(v1)
+    s1 = _StubScorer()
+    w = RegistryWatcher(reg, scorers=[s1])
+    assert w.poll_once() is True
+    assert s1.model_version == v1 and s1.params is not None
+    assert w.poll_once() is False  # no change, no re-apply
+    # a promotion fans out to every attached scorer
+    v2 = reg.publish({"model.h5": params_to_h5_bytes(_params(1))}).version
+    reg.promote(v2)
+    assert w.poll_once() is True and s1.model_version == v2
+    # a late joiner immediately receives the CURRENT model
+    s2 = _StubScorer()
+    w.attach(s2)
+    assert s2.model_version == v2 and s2.params is not None
+    assert w.swaps == 2
+
+
+def test_rollout_gate_verdicts():
+    gate = RolloutGate(min_records=100, epsilon=0.02)
+    base = {"labeled": 500, "f1": 0.8, "auc": 0.9, "precision": 1,
+            "recall": 1}
+    # not enough evidence on either side -> no verdict
+    assert gate.decide(dict(base, labeled=10), base) is None
+    assert gate.decide(base, dict(base, labeled=10)) is None
+    # no positives seen (undefined AUC) -> wait, never decide on nothing
+    assert gate.decide(dict(base, auc=None), base) is None
+    # within epsilon -> promote
+    assert gate.decide(base, dict(base, f1=0.79, auc=0.89)) == "promote"
+    # f1 OR auc regressed past epsilon -> rollback
+    assert gate.decide(base, dict(base, f1=0.7)) == "rollback"
+    assert gate.decide(base, dict(base, auc=0.8)) == "rollback"
+
+
+def test_ab_rollout_rolls_back_degraded_candidate(tmp_path):
+    broker = Broker()
+    n = _seed(broker, 2000, failure_rate=0.05)
+    reg = ModelRegistry(str(tmp_path))
+    tr = ContinuousTrainer(broker, TOPIC, None, registry=reg,
+                           group="ab-train", batch_size=50,
+                           take_batches=4, epochs_per_round=3)
+    tr.train_round()
+    tr.checkpointer.write_once()
+    baseline = reg.latest()
+    # candidate: baseline weights wrecked with seeded noise
+    import jax
+
+    good = params_from_h5_bytes(reg.load_bytes(baseline, "model.h5"))
+    noise = np.random.RandomState(7)
+    bad = jax.tree_util.tree_map(
+        lambda a: np.asarray(a)
+        + noise.normal(0, 1.0, np.shape(a)).astype(np.float32), good)
+    candidate = reg.publish(
+        {"model.h5": params_to_h5_bytes(bad)}).version
+    ab = ABRollout(broker, TOPIC, reg, baseline, candidate,
+                   gate=RolloutGate(min_records=200, epsilon=0.02),
+                   threshold=5.0, deploy_candidate=True, from_start=True,
+                   group_prefix="ab-test")
+    assert reg.channel("serving") == candidate  # deployed during eval
+    for _ in range(64):
+        if ab.step(max_rows=5_000) == 0:
+            break
+    assert ab.decision == "rollback"
+    assert reg.channel("serving") == baseline
+    # both sides scored the whole stream into their own topics: the
+    # comparison artifact is itself on the log
+    for v, side in ((baseline, "baseline"), (candidate, "candidate")):
+        assert broker.end_offset(f"model-predictions.v{v}", 0) == \
+            ab.sides[side].scored == n
+
+
+def test_scorer_fleet_hot_swaps_every_member():
+    """The PR 6 partition-parallel shape: ONE watcher swaps the whole
+    fleet between drains when serving moves."""
+    import tempfile
+
+    from iotml.cluster import ClusterController, ScorerFleet
+
+    tmp = tempfile.mkdtemp(prefix="iotml_fleet_reg_")
+    ctl = ClusterController(brokers=2).start()
+    try:
+        reg = ModelRegistry(tmp)
+        v1 = reg.publish(
+            {"model.h5": params_to_h5_bytes(_params(0))}).version
+        reg.promote(v1)
+        ctl.create_topic(TOPIC, partitions=2)
+        ctl.create_topic("preds", partitions=2)
+        seed_client = ctl.client()
+        gen = FleetGenerator(FleetScenario(num_cars=100))
+        gen.publish(seed_client, TOPIC, n_ticks=2, partitions=2)
+        fleet = ScorerFleet(
+            lambda: ctl.client(), CAR_AUTOENCODER,
+            params_from_h5_bytes(reg.load_bytes(v1, "model.h5")),
+            n_members=2, in_topic=TOPIC, out_topic="preds",
+            group="fleet-swap", registry=reg)
+        for _ in range(6):
+            fleet.pump_once()
+        assert all(m.payload.model_version == v1 for m in fleet.members)
+        scored_before = fleet.scored()
+        v2 = reg.publish(
+            {"model.h5": params_to_h5_bytes(_params(1))}).version
+        reg.promote(v2)
+        gen.publish(seed_client, TOPIC, n_ticks=2, partitions=2)
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            fleet.pump_once()
+            if all(m.payload.model_version == v2
+                   for m in fleet.members) and \
+                    fleet.scored() == 400:
+                break
+            time.sleep(0.02)
+        # every member swapped AND kept scoring: nothing dropped
+        assert all(m.payload.model_version == v2 for m in fleet.members)
+        assert fleet.scored() == 400 > scored_before
+        seed_client.close()
+        fleet.stop()
+    finally:
+        ctl.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def test_live_scorer_follows_registry_serving_channel(tmp_path):
+    from iotml.serve.live import LiveScorer
+
+    broker = Broker()
+    _seed(broker, 1000)
+    reg = ModelRegistry(str(tmp_path))
+    v1 = reg.publish({"model.h5": params_to_h5_bytes(_params(0))}).version
+    reg.promote(v1)
+    svc = LiveScorer(broker, TOPIC, "preds", None, registry=reg,
+                     carhealth_topic=None)
+    assert svc.wait_for_model(5.0) == "registry:v1"
+    assert svc.scorer.score_available() == 1000
+    v2 = reg.publish({"model.h5": params_to_h5_bytes(_params(1))}).version
+    reg.promote(v2)
+    assert svc.maybe_swap() is True
+    assert svc.scorer.model_version == v2
+    assert svc.maybe_swap() is False
+    with pytest.raises(ValueError):
+        LiveScorer(broker, TOPIC, "p2", None)  # neither store nor registry
+
+
+# ------------------------------------------------- platform + config
+def test_platform_mounts_registry_and_supervises_units(tmp_path):
+    from iotml.cli.up import Platform
+
+    reg0 = ModelRegistry(str(tmp_path))
+    reg0.publish({"model.h5": b"x"})
+    # leave a torn publish behind: the platform mount must sweep it
+    os.makedirs(reg0.version_dir(2))
+    plat = Platform(registry_dir=str(tmp_path)).start()
+    try:
+        assert plat.model_registry.versions() == [1]
+        assert not os.path.isdir(plat.model_registry.version_dir(2))
+        assert plat.endpoints()["registry"] == str(tmp_path)
+        ck = plat.attach_checkpointer(
+            AsyncCheckpointer(plat.model_registry))
+        sup = plat.supervised()
+        names = {u.name for u in sup.units()}
+        assert {"registry-watcher", "ckpt-writer"} <= names
+        assert ck._external  # the supervisor owns the writer loop
+    finally:
+        plat.stop()
+
+
+def test_mlops_config_section_resolves_from_env():
+    from iotml.config import load_config
+
+    cfg, _ = load_config([], env={"IOTML_MLOPS_REGISTRY_DIR": "/tmp/r",
+                                  "IOTML_MLOPS_QUEUE_DEPTH": "4",
+                                  "IOTML_MLOPS_AUTO_PROMOTE": "false"})
+    assert cfg.mlops.registry_dir == "/tmp/r"
+    assert cfg.mlops.queue_depth == 4
+    assert cfg.mlops.auto_promote is False
+    with pytest.raises(ValueError):
+        load_config([], env={"IOTML_MLOPS_REGISTRY_DIRR": "/tmp/x"})
+
+
+def test_mlops_cli_registry_inspect(tmp_path, capsys):
+    from iotml.mlops.__main__ import main
+
+    reg = ModelRegistry(str(tmp_path))
+    v = reg.publish({"model.h5": b"m"}, offsets=[(TOPIC, 0, 4)],
+                    metrics={"loss": 0.25}).version
+    reg.promote(v)
+    assert main(["registry", "--root", str(tmp_path), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["registry"]["versions"] == [1]
+    assert doc["registry"]["serving"] == 1
+    assert [e["event"] for e in doc["history"]] == ["publish", "promote"]
