@@ -19,6 +19,8 @@ JAX_PLATFORMS=cpu python -m iotml.chaos run --scenario broker-crash-recover \
   --seed 7 --records 500
 JAX_PLATFORMS=cpu python -m iotml.chaos run --scenario rebalance-under-chaos \
   --seed 7 --records 500
+JAX_PLATFORMS=cpu python -m iotml.chaos run --scenario compaction-under-crash \
+  --seed 7 --records 500
 
 echo "== 2/5 supervised restart: live scorer-crash drill (the scorer"
 echo "        thread dies twice; the supervisor must heal the pipeline)"
@@ -28,6 +30,10 @@ echo "==      live model rollout drill (iotml.mlops): 3 promotions"
 echo "        hot-swap under load, every record scored exactly once"
 JAX_PLATFORMS=cpu python -m iotml.mlops drill --drill rollout \
   --seed 7 --records 500
+echo "==      live twin-rebuild drill (iotml.twin): kill the twin"
+echo "        service, rebuild from the compacted changelog, state"
+echo "        equals the pre-kill snapshot"
+JAX_PLATFORMS=cpu python -m iotml.twin drill --seed 7 --records 1500
 
 echo "== 3/5 validate manifests against the codebase"
 python deploy/validate_manifests.py

@@ -61,6 +61,14 @@ Rules (see ARCHITECTURE.md §analysis for the full table):
       registry goes through ``mlops.registry.ModelRegistry`` (the one
       writer), or the manifest-as-commit-marker recovery contract (a
       version is committed IFF its manifest parses) silently breaks.
+  R12 compaction / twin-changelog write discipline: the ``CAR_TWIN``
+      changelog has ONE writer (``iotml/twin/``'s TwinService — a
+      foreign producer corrupts every rebuild), and the segment
+      compaction rewrite machinery (``compact_log`` / ``sweep_cleaned``
+      / any write on a ``.cleaned`` rewrite path) is
+      ``iotml/store/``-internal — everyone else triggers compaction
+      through ``Broker.run_compaction`` so the swap protocol, the
+      broker lock and the crash-safety story live in exactly one place.
 
 Suppression: append ``# lint-ok: RN <reason>`` to the flagged line (for
 R4, to the ``with`` line holding the lock).  A suppression WITHOUT a
@@ -108,6 +116,7 @@ CHAOS_ALLOWED_MODULES = frozenset({
     ("stream", "replica.py"), ("mqtt", "broker.py"),
     ("serve", "scorer.py"), ("train", "live.py"),
     ("mlops", "checkpoint.py"), ("mlops", "registry.py"),
+    ("store", "compact.py"),
 })
 CHAOS_SHIM_MODULE = "faults"
 # Drill-harness modules outside chaos/supervise: live-drill peers of
@@ -115,6 +124,7 @@ CHAOS_SHIM_MODULE = "faults"
 # real platforms), exempt from R7 exactly like the supervise drills.
 CHAOS_HARNESS_MODULES = frozenset({
     ("mlops", "drill.py"), ("mlops", "__main__.py"),
+    ("twin", "drill.py"), ("twin", "__main__.py"),
 })
 
 # R6 (naming): metric families and span/stage names are lowercase
@@ -158,7 +168,18 @@ RULES: Dict[str, str] = {
            "on a registry path) outside iotml/mlops/: all registry "
            "bytes go through ModelRegistry (manifest-as-commit-marker "
            "recovery depends on the one-writer discipline)",
+    "R12": "twin-changelog produce outside iotml/twin/ (CAR_TWIN has "
+           "one writer: TwinService), or compaction rewrite machinery "
+           "(compact_log / sweep_cleaned / a write on a .cleaned path) "
+           "outside iotml/store/: compact via Broker.run_compaction",
 }
+
+# R12: the compacted twin-changelog topics whose produce is confined to
+# iotml/twin/, the store-internal compaction entry points, and the
+# rewrite-tmp path marker (same conservative name-matching as R9/R11).
+_TWIN_CHANGELOG_TOPICS = frozenset({"CAR_TWIN"})
+_COMPACT_WRITE_CALLS = frozenset({"compact_log", "sweep_cleaned"})
+_CLEANED_PATH_RE = re.compile(r"\.cleaned|CLEANED_SUFFIX")
 
 # R10: the cluster-internal collections whose per-instance subscripting
 # outside the package bypasses PartitionMap routing (and with it the
@@ -442,6 +463,8 @@ class _FileLinter(ast.NodeVisitor):
         self.in_store = "store" in parts
         # R11 scoping: the mlops package owns registry bytes
         self.in_mlops = "mlops" in parts
+        # R12 scoping: the twin package owns the CAR_TWIN changelog
+        self.in_twin = "twin" in parts
         #: Thread(...) call nodes already seen as a register_thread(...)
         #: argument — outer calls visit before inner ones, so by the
         #: time visit_Call reaches the Thread node it is marked
@@ -684,6 +707,52 @@ class _FileLinter(ast.NodeVisitor):
                            "go through ModelRegistry (staged rename + "
                            "manifest commit marker + checksum; a "
                            "version is immutable once committed)")
+
+        # R12 — compaction / twin-changelog write discipline.  First
+        # half: CAR_TWIN (the twin's compacted changelog) has ONE
+        # writer, TwinService — a foreign producer corrupts every
+        # rebuild the changelog exists to make possible.
+        if not self.in_twin and name in ("produce", "produce_many",
+                                         "produce_batch"):
+            topic = None
+            topic_nodes = list(node.args)[:1] + [
+                kw.value for kw in node.keywords if kw.arg == "topic"]
+            for a in topic_nodes:
+                if isinstance(a, ast.Constant) and \
+                        isinstance(a.value, str):
+                    topic = a.value
+                elif isinstance(a, ast.Name) and \
+                        a.id == "CHANGELOG_TOPIC":
+                    topic = "CAR_TWIN"
+                elif isinstance(a, ast.Attribute) and \
+                        a.attr == "CHANGELOG_TOPIC":
+                    topic = "CAR_TWIN"
+            if topic in _TWIN_CHANGELOG_TOPICS:
+                self._emit("R12", node,
+                           f"produce to twin changelog {topic!r} outside "
+                           "iotml/twin/: the changelog has one writer "
+                           "(TwinService) — a foreign record corrupts "
+                           "every rebuild that replays it")
+        # Second half: the segment-rewrite machinery is store-internal;
+        # compaction is triggered through Broker.run_compaction so the
+        # swap protocol and its crash-safety live in one place
+        if not self.in_store:
+            if name in _COMPACT_WRITE_CALLS:
+                self._emit("R12", node,
+                           f"{name}() outside iotml/store/: segment "
+                           "compaction machinery is store-internal — "
+                           "trigger it via Broker.run_compaction")
+            if name in ("open", "atomic_write", "SegmentWriter"):
+                arg_src = " ".join(
+                    ast.unparse(a) for a in list(node.args)
+                    + [kw.value for kw in node.keywords])
+                if _CLEANED_PATH_RE.search(arg_src):
+                    self._emit("R12", node,
+                               f"{name}() on a .cleaned rewrite path "
+                               "outside iotml/store/: the compaction "
+                               "swap protocol (durable tmp + atomic "
+                               "os.replace + mount-time sweep) is the "
+                               "store's alone")
 
         # R10 — broker instances are the cluster package's to build:
         # constructing a ShardBroker elsewhere bypasses the controller's

@@ -64,6 +64,12 @@ KNOWN_POINTS: Dict[str, str] = {
                        "the manifest write: crash (error) leaves a "
                        "manifest-less (torn) version dir that readers "
                        "skip and recover() sweeps",
+    "store.compact_swap": "segment compaction, between the durable "
+                          ".cleaned rewrite and its atomic swap over the "
+                          "live segment: crash (error) = compactor killed "
+                          "mid-pass (stale tmp left, live segment intact, "
+                          "a prefix of segments already swapped); delay = "
+                          "slow disk",
 }
 
 #: runner-orchestrated pseudo-points: process-level acts (killing a wire
@@ -101,6 +107,7 @@ POINT_ACTIONS: Dict[str, frozenset] = {
     "trainer.poll": frozenset({"error", "delay"}),
     "ckpt.write": frozenset({"error", "delay"}),
     "registry.commit": frozenset({"error", "delay"}),
+    "store.compact_swap": frozenset({"error", "delay"}),
     "runner.kill_leader": frozenset({"kill_leader"}),
     "runner.crash_broker": frozenset({"crash_broker"}),
     "runner.kill_member": frozenset({"kill_member"}),

@@ -336,6 +336,8 @@ class ChaosRunner:
 
         store_dir = tempfile.mkdtemp(prefix="iotml_chaos_store_")
         try:
+            if self.schedule.name == "compaction-under-crash":
+                return self._run_compact_in(eng, span_path, store_dir)
             return self._run_store_in(eng, span_path, store_dir)
         finally:
             # CI/smoke run this scenario repeatedly; a leaked segment
@@ -505,6 +507,168 @@ class ChaosRunner:
             scenario=self.schedule.name, seed=self.schedule.seed,
             records=self.schedule.records, topology="store",
             published=published, scored=scored_total, rewinds=rewinds,
+            dropped_accounted=eng.dropped_count,
+            injected=dict(sorted(eng.injected.items())),
+            invariants=invariants, span_path=span_path)
+
+    # --------------------------------------------------------- compaction
+    def _run_compact_in(self, eng: faults.ChaosEngine, span_path: str,
+                        store_dir: str) -> ChaosReport:
+        """The compaction-under-crash drill: a TwinService changelogs
+        per-car state into the compacted ``CAR_TWIN`` topic on a durable
+        broker, then the compactor is KILLED at a scheduled mid-pass
+        segment swap (injected error at ``store.compact_swap``: the
+        ``.cleaned`` rewrite is durable, the live segment untouched, a
+        prefix of earlier segments already swapped) and the store is
+        REMOUNTED.  Proven: the stale tmp is swept at mount, no key (or
+        tombstone) is lost, every surviving record re-serves
+        byte-identically, and a finished pass stays byte-stable across a
+        second remount."""
+        import glob
+
+        from ..gen.simulator import FleetGenerator, FleetScenario
+        from ..store import StorePolicy
+        from ..store.compact import CLEANED_SUFFIX
+        from ..stream.broker import Broker
+        from ..twin import CHANGELOG_TOPIC, TwinService
+
+        policy = dict(fsync="interval", segment_bytes=16 * 1024,
+                      compact_grace_ms=10 ** 9)
+        parts = 2
+
+        def read_all(b):
+            """Every live changelog record, as comparable tuples (fetch
+            batches end at compaction holes; the loop walks across)."""
+            out = {}
+            for p in range(parts):
+                recs = []
+                off = b.begin_offset(CHANGELOG_TOPIC, p)
+                end = b.end_offset(CHANGELOG_TOPIC, p)
+                while off < end:
+                    batch = b.fetch(CHANGELOG_TOPIC, p, off, 1 << 20)
+                    if not batch:
+                        break
+                    recs.extend((m.offset, m.key, m.value, m.timestamp_ms)
+                                for m in batch)
+                    off = batch[-1].offset + 1
+                out[p] = recs
+            return out
+
+        def latest_per_key(reads):
+            latest = {}
+            for p, recs in reads.items():
+                for off, key, value, ts in recs:
+                    latest[(p, key)] = (off, value, ts)
+            return latest
+
+        def cleaned_tmps():
+            return sum(
+                len(glob.glob(os.path.join(d, "*" + CLEANED_SUFFIX)))
+                for d in part_dirs)
+
+        broker = Broker(store_dir=store_dir,
+                        store_policy=StorePolicy(**policy))
+        broker.create_topic(IN_TOPIC, partitions=parts)
+        svc = TwinService(broker)
+        gen = FleetGenerator(FleetScenario(num_cars=CARS_PER_TICK,
+                                           seed=self.schedule.seed))
+        ticks = max(2, -(-self.schedule.records // CARS_PER_TICK))
+        published = 0
+        for _ in range(ticks):
+            published += gen.publish(broker, IN_TOPIC, n_ticks=1,
+                                     partitions=parts)
+            svc.pump_once()
+        while svc.pump_once():
+            pass
+        svc.retire(svc.cars()[-1])  # a tombstone rides the changelog
+        table_snapshot = svc.table.snapshot()
+        part_dirs = [broker.store.log_for(CHANGELOG_TOPIC, p).dir
+                     for p in range(parts)]
+
+        pre_kill = read_all(broker)
+        latest_pre = latest_per_key(pre_kill)
+        for p in range(parts):
+            broker.store.log_for(CHANGELOG_TOPIC, p).roll()
+
+        # --- the kill: the scheduled error fires INSIDE the pass, at
+        # the gap between the durable rewrite and its atomic swap
+        crashed = False
+        try:
+            broker.run_compaction(force=True)
+        except RuntimeError:
+            crashed = True
+        tmps_left = cleaned_tmps()
+        # the crashed incarnation is DEAD: nothing flushed, nothing
+        # closed.  Remount from disk.
+        broker2 = Broker(store_dir=store_dir,
+                         store_policy=StorePolicy(**policy))
+        tmps_after = cleaned_tmps()
+        post_kill = read_all(broker2)
+        pre_sets = {p: set(recs) for p, recs in pre_kill.items()}
+        foreign = [r for p, recs in post_kill.items() for r in recs
+                   if r not in pre_sets[p]]
+
+        # finish the interrupted job on the remounted store, then
+        # remount AGAIN: the finished pass must be byte-stable
+        stats = broker2.run_compaction(force=True)
+        removed = sum(s.records_removed for s in stats.values())
+        done = read_all(broker2)
+        broker3 = Broker(store_dir=store_dir,
+                         store_policy=StorePolicy(**policy))
+        stable = read_all(broker3)
+        svc2 = TwinService(broker3)
+        rebuilt = svc2.table.snapshot()
+        broker3.close()
+
+        keys_ok = (latest_per_key(post_kill) == latest_pre
+                   and latest_per_key(done) == latest_pre
+                   and latest_per_key(stable) == latest_pre)
+        invariants = [
+            Invariant(
+                "crash_injected",
+                crashed and tmps_left > 0,
+                f"compactor killed mid-pass with {tmps_left} durable "
+                f".cleaned tmp(s) left unswapped" if crashed else
+                "the scheduled store.compact_swap error NEVER FIRED"),
+            Invariant(
+                "cleaned_tmp_swept",
+                tmps_after == 0,
+                "remount swept every stale .cleaned rewrite tmp"
+                if tmps_after == 0 else
+                f"{tmps_after} stale .cleaned tmp(s) SURVIVED the mount"),
+            Invariant(
+                "no_key_lost",
+                keys_ok,
+                f"latest-per-key table identical across kill, remount "
+                f"and finished compaction ({len(latest_pre)} keys incl. "
+                f"the tombstone)" if keys_ok else
+                "latest-per-key table DIVERGED across the crash"),
+            Invariant(
+                "survivors_byte_identical",
+                not foreign,
+                "every post-remount record existed pre-kill with "
+                "identical (offset, key, value, timestamp) — compaction "
+                "only ever removes" if not foreign else
+                f"{len(foreign)} record(s) MUTATED by the crashed pass"),
+            Invariant(
+                "compacted_reads_byte_stable",
+                done == stable and removed > 0,
+                f"finished pass removed {removed} shadowed records and "
+                f"reads are byte-identical across a remount"
+                if done == stable and removed > 0 else
+                f"compacted reads NOT byte-stable (removed={removed})"),
+            Invariant(
+                "twin_rebuild_equals_snapshot",
+                rebuilt == table_snapshot,
+                f"twin table rebuilt from the compacted changelog == the "
+                f"live service's snapshot ({len(table_snapshot)} cars)"
+                if rebuilt == table_snapshot else
+                "rebuilt twin table DIVERGED from the live snapshot"),
+        ]
+        return ChaosReport(
+            scenario=self.schedule.name, seed=self.schedule.seed,
+            records=self.schedule.records, topology="store",
+            published=published, scored=svc.applied, rewinds=0,
             dropped_accounted=eng.dropped_count,
             injected=dict(sorted(eng.injected.items())),
             invariants=invariants, span_path=span_path)

@@ -34,6 +34,12 @@ Built-ins:
   leader dies mid-epoch; the runner proves every produced record was
   scored exactly once (zero lost, zero double-scored) across the
   rebalance and the per-shard failover.
+- ``compaction-under-crash`` (store): the segment compactor is killed
+  at a mid-pass swap on the twin's compacted changelog (durable
+  ``.cleaned`` rewrite written, live segment not yet replaced); the
+  remount must sweep the tmp, lose no key, serve byte-identical
+  compacted reads, and a finished pass must stay byte-stable across a
+  second remount.
 - ``trainer-crash-mid-checkpoint`` (mlops): the checkpoint writer dies
   inside a registry publication (torn version dir left behind); a
   restarted trainer must resume model + stream offsets from the last
@@ -170,6 +176,22 @@ def _broker_crash_recover(rng: random.Random, records: int) -> list:
     return events
 
 
+def _compaction_under_crash(rng: random.Random, records: int) -> list:
+    # the compactor dies at its Nth segment SWAP: the .cleaned rewrite
+    # is durable, the live segment still holds the old bytes, and a
+    # prefix of earlier segments already swapped — the worst mid-pass
+    # shape.  The runner remounts and proves no key lost + byte-stable
+    # compacted reads.  A couple of fetch stalls ride along so the
+    # pre-kill reads happen under an unquiet consumer.
+    events = [FaultEvent(rng.randint(1, 3), "store.compact_swap", "error",
+                         params=(("exc", "RuntimeError"),))]
+    for _ in range(2):
+        events.append(FaultEvent(rng.randint(1, max(2, records // 20)),
+                                 "broker.fetch", "delay",
+                                 params=(("seconds", 0.001),)))
+    return events
+
+
 def _rebalance_under_chaos(rng: random.Random, records: int) -> list:
     # the cluster drill: a consumer-group member dies mid-epoch, then a
     # SHARD leader dies mid-epoch (after the member's rebalance window
@@ -275,6 +297,11 @@ SCENARIOS: Dict[str, Tuple[Callable, str, str]] = {
         _broker_crash_recover, "store",
         "durable broker killed mid-write; remount recovers the torn "
         "tail, acked records re-serve, consumers resume from committed"),
+    "compaction-under-crash": (
+        _compaction_under_crash, "store",
+        "segment compactor killed mid-swap on the compacted twin "
+        "changelog; remount sweeps the tmp, loses no key, and compacted "
+        "reads stay byte-stable across a second remount"),
     "rebalance-under-chaos": (
         _rebalance_under_chaos, "cluster",
         "3-broker cluster: a group member AND a shard leader die "

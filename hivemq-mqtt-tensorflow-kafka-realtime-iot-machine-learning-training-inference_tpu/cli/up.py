@@ -66,6 +66,18 @@ class Platform:
         # "no data lake" training substrate surviving the process
         self.store_dir = store_dir
         self.broker = Broker(store_dir=store_dir, store_policy=store_policy)
+        # durable brokers get the background dirty-ratio compactor: a
+        # platform with compacted topics (the CAR_TWIN changelog) must
+        # actually reclaim them, not only when a drill calls
+        # run_compaction by hand.  No-op cadence on brokers with no
+        # compact topics; None on the in-memory backend.  Built here,
+        # STARTED in start() like every other component thread.
+        self.compactor = None
+        if self.broker.store is not None:
+            from ..store import StoreCompactor
+            self.compactor = StoreCompactor(
+                self.broker,
+                interval_s=self.broker.store.policy.compact_interval_s)
         # the reference's two topics, its partition count.  retention
         # bounds the in-memory log for long-running platforms (the
         # reference sets retention.ms=100000 — aggressive 100s retention,
@@ -191,6 +203,8 @@ class Platform:
         self.mqtt.start()
         if self.registry_watcher is not None:
             self.registry_watcher.start()
+        if self.compactor is not None:
+            self.compactor.start()
         if metrics_port is not None:
             self.metrics_server = self._obs.start_http_server(metrics_port)
         self.control_center.start()
@@ -390,6 +404,8 @@ class Platform:
             self.metrics_server.shutdown()
             self.metrics_server.server_close()
             self.metrics_server = None
+        if self.compactor is not None:
+            self.compactor.stop()
         self.broker.close()  # durable: fsync + release fds (no-op else)
         self.started = False
 

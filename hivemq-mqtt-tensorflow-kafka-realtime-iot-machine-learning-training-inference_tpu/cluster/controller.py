@@ -160,6 +160,7 @@ class ClusterController:
                 # metadata (per-partition leaders, pinned coordinator)
                 rep.server.cluster = ShardView(self.pmap, i)
                 self.replicas[i] = rep
+        self._compactors: list = []
         self.started = False
 
     def _owns_fn(self, shard: int):
@@ -177,10 +178,23 @@ class ClusterController:
                 rep.start()          # sync loop + serving follower
             else:
                 rep.server.start()   # serve only; caller steps sync
+        # durable shards reclaim their compacted topics in the
+        # background, each shard compacting only the partitions it leads
+        # (run_compaction skips unowned placeholders)
+        if self._store_root:
+            from ..store import StoreCompactor
+            for b in self.brokers:
+                if b.store is not None:
+                    self._compactors.append(StoreCompactor(
+                        b, interval_s=b.store.policy.compact_interval_s,
+                    ).start())
         self.started = True
         return self
 
     def stop(self) -> None:
+        for c in self._compactors:
+            c.stop()
+        self._compactors = []
         for rep in self.replicas:
             if rep is not None:
                 try:

@@ -114,6 +114,12 @@ class StoreConfig:
     retention_ms: int = 0            # 0 = unbounded (reference: 100000)
     retention_messages: int = 0      # 0 = unbounded (segment-granular)
     index_interval_bytes: int = 4096
+    # cleanup.policy=compact topics: dirty-ratio trigger for the
+    # background compactor and the tombstone grace window (Kafka's
+    # min.cleanable.dirty.ratio / delete.retention.ms analogs)
+    compact_min_dirty_ratio: float = 0.5
+    compact_grace_ms: int = 60_000
+    compact_interval_s: float = 5.0  # background compactor cadence
 
 
 @dataclasses.dataclass

@@ -25,6 +25,14 @@ Endpoints:
   DELETE /connectors/{name}               → 204
   GET    /connector-plugins               → available classes
 
+With a digital twin attached (`attach_twin`, iotml.twin), the surface
+the reference queried MongoDB for is served here directly:
+  GET    /twin                            → {"count", "cars": [ids...]}
+  GET    /twin/{car_id}                   → latest state + rolling
+                                            aggregates (404 unknown car)
+  DELETE /twin/{car_id}                   → 204; tombstones the car out
+                                            of the compacted changelog
+
 A background thread drives `ConnectWorker.run_once()` continuously
 (Connect's task threads); `pump_now()` runs one deterministic pass for
 tests.
@@ -73,6 +81,7 @@ class ConnectServer(RestServer):
         self._counts: Dict[str, int] = {}
         self._stop = threading.Event()
         self._driver: Optional[threading.Thread] = None
+        self.twin = None  # iotml.twin.TwinService via attach_twin
 
         name = r"([^/]+)"
         self.route("GET", r"/connectors", self._list)
@@ -162,6 +171,35 @@ class ConnectServer(RestServer):
             self._configs[name] = dict(config or {})
             self._kinds[name] = kind
             self._counts[name] = 0
+
+    # ------------------------------------------------------------ twin
+    def attach_twin(self, twin) -> None:
+        """Serve a TwinService's table over this REST surface — the
+        reference's 'query MongoDB for the car document' becomes a GET
+        against the connect API the operators already talk to.  Reads
+        go straight to the in-memory table (no lock: the table is
+        mutated by one pump thread and read lock-free, same discipline
+        as the broker's metric gauges)."""
+        self.twin = twin
+        self.route("GET", r"/twin", self._twin_list)
+        self.route("GET", r"/twin/([^/]+)", self._twin_get)
+        self.route("DELETE", r"/twin/([^/]+)", self._twin_delete)
+
+    def _twin_list(self, m, body):
+        return 200, {"count": self.twin.count(),
+                     "rebuilt_from_changelog": self.twin.rebuilt_records,
+                     "cars": self.twin.cars()}
+
+    def _twin_get(self, m, body):
+        doc = self.twin.get(m.group(1))
+        if doc is None:
+            raise RestError(404, f"no twin for car {m.group(1)!r}")
+        return 200, doc
+
+    def _twin_delete(self, m, body):
+        if not self.twin.retire(m.group(1)):
+            raise RestError(404, f"no twin for car {m.group(1)!r}")
+        return 204, {}
 
     # ------------------------------------------------------------- routes
     def _list(self, m, body):

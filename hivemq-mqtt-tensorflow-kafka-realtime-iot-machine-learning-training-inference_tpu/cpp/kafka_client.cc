@@ -164,6 +164,8 @@ struct Staged {
   std::vector<uint8_t> key;
   bool key_null;
   std::vector<uint8_t> value;
+  bool value_null = false;  // tombstone (compacted-topic delete marker):
+                            // wire length -1, distinct from empty
 };
 
 struct Client {
@@ -175,10 +177,13 @@ struct Client {
 };
 
 // MessageSet v1 encode: entries share one timestamp array layout from caller.
+// value_null (optional) marks tombstones: encoded as wire length -1, the
+// compacted-topic delete marker — never as an empty payload.
 void encode_message_set(Writer& w, const uint8_t* values,
                         const int64_t* val_off, const uint8_t* keys,
                         const int64_t* key_off, const uint8_t* key_null,
-                        const int64_t* timestamps, int64_t n) {
+                        const int64_t* timestamps, int64_t n,
+                        const uint8_t* value_null = nullptr) {
   for (int64_t i = 0; i < n; ++i) {
     Writer body;
     body.i8(1);  // magic 1
@@ -190,8 +195,12 @@ void encode_message_set(Writer& w, const uint8_t* values,
     } else {
       body.bytes(nullptr, -1);
     }
-    body.bytes(values + val_off[i],
-               static_cast<int32_t>(val_off[i + 1] - val_off[i]));
+    if (value_null && value_null[i]) {
+      body.bytes(nullptr, -1);
+    } else {
+      body.bytes(values + val_off[i],
+                 static_cast<int32_t>(val_off[i + 1] - val_off[i]));
+    }
     w.i64(0);  // offset (assigned broker-side on produce)
     w.i32(static_cast<int32_t>(body.buf.size() + 4));
     w.u32(crc32(body.buf.data(), body.buf.size()));
@@ -225,6 +234,7 @@ bool decode_message_set(const uint8_t* buf, size_t len, int64_t min_offset,
     s.offset = offset;
     s.timestamp = ts;
     s.key_null = kn < 0;
+    s.value_null = vn < 0;
     if (kn > 0) s.key.assign(kp, kp + kn);
     if (vn > 0) s.value.assign(vp, vp + vn);
     out.push_back(std::move(s));
@@ -519,8 +529,12 @@ int64_t iotml_kafka_metadata(void* h, const char* topic) {
   return r.fail ? K_EIO : parts;
 }
 
-int64_t iotml_kafka_create_topic(void* h, const char* topic,
-                                 int32_t partitions) {
+// CreateTopics with an optional cleanup.policy config entry (NULL/empty =
+// none): the compacted-changelog client path (CAR_TWIN) needs the policy
+// to ride topic creation like the Python wire client's.
+int64_t iotml_kafka_create_topic_cfg(void* h, const char* topic,
+                                     int32_t partitions,
+                                     const char* cleanup_policy) {
   Client* c = static_cast<Client*>(h);
   Writer body;
   body.i32(1);
@@ -528,7 +542,13 @@ int64_t iotml_kafka_create_topic(void* h, const char* topic,
   body.i32(partitions);
   body.i16(1);   // replication factor
   body.i32(0);   // replica assignments
-  body.i32(0);   // configs
+  if (cleanup_policy && *cleanup_policy) {
+    body.i32(1);  // one config entry
+    body.str("cleanup.policy");
+    body.str(cleanup_policy);
+  } else {
+    body.i32(0);  // configs
+  }
   body.i32(10000);  // timeout ms
   std::vector<uint8_t> resp;
   if (!request(c, API_CREATE_TOPICS, 0, body, resp)) return K_EIO;
@@ -544,6 +564,11 @@ int64_t iotml_kafka_create_topic(void* h, const char* topic,
   // 0 = created as requested; 1 = already existed (caller must refresh the
   // real partition count — the requested one may be wrong)
   return r.fail ? K_EIO : existed;
+}
+
+int64_t iotml_kafka_create_topic(void* h, const char* topic,
+                                 int32_t partitions) {
+  return iotml_kafka_create_topic_cfg(h, topic, partitions, nullptr);
 }
 
 // ListOffsets v1: timestamp -1 → end offset, -2 → begin offset.
@@ -580,15 +605,18 @@ int64_t iotml_kafka_list_offset(void* h, const char* topic, int32_t partition,
 // Produce v2, one (topic, partition), acks=all.  Values (and optional keys)
 // arrive as a contiguous blob + n+1 offsets — the encode_batch layout.
 // Returns the broker-assigned base offset of the batch.
-int64_t iotml_kafka_produce(void* h, const char* topic, int32_t partition,
-                            const uint8_t* values, const int64_t* val_offsets,
-                            const uint8_t* keys, const int64_t* key_offsets,
-                            const uint8_t* key_null, const int64_t* timestamps,
-                            int64_t n) {
+static int64_t kafka_produce_impl(void* h, const char* topic,
+                                  int32_t partition, const uint8_t* values,
+                                  const int64_t* val_offsets,
+                                  const uint8_t* keys,
+                                  const int64_t* key_offsets,
+                                  const uint8_t* key_null,
+                                  const int64_t* timestamps, int64_t n,
+                                  const uint8_t* value_null) {
   Client* c = static_cast<Client*>(h);
   Writer ms;
   encode_message_set(ms, values, val_offsets, keys, key_offsets, key_null,
-                     timestamps, n);
+                     timestamps, n, value_null);
   Writer body;
   body.i16(-1);     // acks = all
   body.i32(10000);  // timeout
@@ -616,6 +644,40 @@ int64_t iotml_kafka_produce(void* h, const char* topic, int32_t partition,
   }
   r.i32();  // throttle
   return r.fail ? K_EIO : base;
+}
+
+int64_t iotml_kafka_produce(void* h, const char* topic, int32_t partition,
+                            const uint8_t* values, const int64_t* val_offsets,
+                            const uint8_t* keys, const int64_t* key_offsets,
+                            const uint8_t* key_null, const int64_t* timestamps,
+                            int64_t n) {
+  return kafka_produce_impl(h, topic, partition, values, val_offsets, keys,
+                            key_offsets, key_null, timestamps, n, nullptr);
+}
+
+// Tombstone-capable produce: value_null[i] marks record i as a null-value
+// delete marker (wire length -1).  Separate symbol so older .so consumers
+// keep the exact ABI they linked against.
+int64_t iotml_kafka_produce_nulls(void* h, const char* topic,
+                                  int32_t partition, const uint8_t* values,
+                                  const int64_t* val_offsets,
+                                  const uint8_t* keys,
+                                  const int64_t* key_offsets,
+                                  const uint8_t* key_null,
+                                  const uint8_t* value_null,
+                                  const int64_t* timestamps, int64_t n) {
+  return kafka_produce_impl(h, topic, partition, values, val_offsets, keys,
+                            key_offsets, key_null, timestamps, n, value_null);
+}
+
+// Value-null flags of the staged fetch (1 byte per staged message).  Read
+// BEFORE iotml_kafka_take (take clears the staging area); returns the
+// staged count.
+int64_t iotml_kafka_staged_value_nulls(void* h, uint8_t* out) {
+  Client* c = static_cast<Client*>(h);
+  int64_t n = static_cast<int64_t>(c->staged.size());
+  for (int64_t i = 0; i < n; ++i) out[i] = c->staged[i].value_null ? 1 : 0;
+  return n;
 }
 
 // Fetch v2 into the handle's staging area.  Returns messages staged (>= 0)

@@ -93,12 +93,22 @@ class StreamScorer:
     def __init__(self, model, params, batches: SensorBatches,
                  out: OutputSequence, threshold: Optional[float] = None,
                  carhealth=None, carhealth_topic: Optional[str] = None,
-                 verdict_mask=None):
+                 verdict_mask=None, feature_store=None):
         self.model = model
         self.params = params
         self.batches = batches
         self.out = out
         self.threshold = threshold
+        #: optional twin.TwinFeatureStore: per-car HISTORICAL features
+        #: (rolling-window aggregates from the digital twin) are
+        #: concatenated onto each live row before scoring, so the model
+        #: sees [F live + K twin] inputs — its input_dim must match.
+        #: Requires batches built with keep_keys=True (the join key is
+        #: the car's message key); rows without a key — or cars with no
+        #: twin yet — join the zero vector, the cold-start null.
+        #: Batched 2-D rows only: windowed/LSTM rows have no single
+        #: per-row car identity to join on.
+        self.feature_store = feature_store
         #: optional boolean [F] mask restricting the per-row error MEAN
         #: (verdicts, quality histograms, car mean-EMA) to a feature
         #: subset.  Full-normalization deployments pass the PARITY mask:
@@ -218,7 +228,18 @@ class StreamScorer:
         return self.scored - start
 
     def _score_super_batch(self, bs, base: int) -> None:
-        xs = np.stack([b.x for b in bs])   # [S, B, ...] (F, or T×F windowed)
+        if self.feature_store is not None and bs[0].x.ndim == 2:
+            # the feature-store join: twin features ride beside the live
+            # row INTO the model, so reconstruction error covers both —
+            # a car whose live reading contradicts its own history
+            # scores anomalous even when the reading is fleet-normal
+            xs = np.stack([
+                np.concatenate(
+                    [b.x, self.feature_store.matrix(b.keys, b.x.shape[0])],
+                    axis=1).astype(b.x.dtype)
+                for b in bs])               # [S, B, F + K]
+        else:
+            xs = np.stack([b.x for b in bs])  # [S, B, ...] (F, or T×F)
         S, B = xs.shape[:2]
         row_shape = xs.shape[2:]
         # pad the batch count to a power-of-two bucket: drains vary in size
@@ -236,7 +257,14 @@ class StreamScorer:
         err_axes = tuple(range(2, preds.ndim))
         sq = np.square(preds - xs)
         if self.verdict_mask is not None and sq.ndim == 3:
-            errs = sq[:, :, self.verdict_mask].mean(axis=2)  # [S, B]
+            mask = self.verdict_mask
+            if mask.shape[0] < sq.shape[2]:
+                # feature-store join widened the rows: the verdict mask
+                # was calibrated on the LIVE features, so the joined
+                # twin columns stay out of the verdict mean
+                mask = np.concatenate(
+                    [mask, np.zeros(sq.shape[2] - mask.shape[0], bool)])
+            errs = sq[:, :, mask].mean(axis=2)  # [S, B]
         else:
             errs = np.mean(sq, axis=err_axes)  # [S, B]
         # per-FEATURE errors for the detector's feature heads (2-D rows
@@ -272,10 +300,15 @@ class StreamScorer:
                             buckets[sel], minlength=len(ERR_BUCKETS) + 1)
             if self.carhealth is not None and b.keys is not None \
                     and b.n_valid:
+                # per-feature heads see the LIVE columns only: joined
+                # twin features are model input, not car sensors
+                n_live = b.x.shape[1] if b.x.ndim == 2 else None
                 trans = self.carhealth.update(
                     b.keys[: b.n_valid], err[: b.n_valid],
-                    ferrs=sq[k][: b.n_valid] if want_ferrs else None,
-                    fvals=xs[k][: b.n_valid] if want_ferrs else None)
+                    ferrs=sq[k][: b.n_valid, : n_live]
+                    if want_ferrs else None,
+                    fvals=xs[k][: b.n_valid, : n_live]
+                    if want_ferrs else None)
                 if trans and self.carhealth_topic is not None:
                     self.carhealth.publish_transitions(
                         self.out.broker, self.carhealth_topic, trans)

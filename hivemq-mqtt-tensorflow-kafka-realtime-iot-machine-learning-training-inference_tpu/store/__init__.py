@@ -6,8 +6,11 @@ and re-serves history across process deaths.  This package is that
 retention: an append-only segmented log per partition (CRC32C-framed
 records, configurable fsync, size/age segment roll, byte+time
 retention, sparse offset + timestamp indexes), crash recovery that
-truncates torn tails, a compacted consumer-offsets file, and a replay
-API (`read_from` / `read_since`) for training backfill.
+truncates torn tails, a compacted consumer-offsets file, key-based log
+compaction for ``cleanup.policy=compact`` topics (`compact.py`: latest
+record per key, tombstone grace windows, dirty-ratio triggering,
+atomic segment swaps), and a replay API (`read_from` / `read_since`)
+for training backfill.
 
 Mounted by `stream.broker.Broker(store_dir=...)`; every knob rides the
 `store.*` config section (`IOTML_STORE_DIR`, `IOTML_STORE_FSYNC`, ...).
@@ -15,10 +18,12 @@ Lint rule R9 keeps every file write under a store directory inside this
 package (`segment.SegmentWriter` owns the bytes and the fsync ledger).
 """
 
+from .compact import CompactionStats, StoreCompactor
 from .log import SegmentedLog, StorePolicy
 from .mount import StoreMount
 from .offsets import OffsetsFile
 from .segment import SegmentWriter, atomic_write, crc32c, fsync_dir
 
 __all__ = ["SegmentedLog", "StorePolicy", "StoreMount", "OffsetsFile",
-           "SegmentWriter", "atomic_write", "crc32c", "fsync_dir"]
+           "SegmentWriter", "atomic_write", "crc32c", "fsync_dir",
+           "CompactionStats", "StoreCompactor"]

@@ -78,7 +78,10 @@ class StorePolicy:
                  retention_bytes: int = 0,
                  retention_ms: int = 0,
                  retention_messages: int = 0,
-                 index_interval_bytes: int = 4096):
+                 index_interval_bytes: int = 4096,
+                 compact_min_dirty_ratio: float = 0.5,
+                 compact_grace_ms: int = 60_000,
+                 compact_interval_s: float = 5.0):
         if fsync not in ("never", "interval", "always"):
             raise ValueError(f"fsync policy must be never|interval|always, "
                              f"got {fsync!r}")
@@ -90,6 +93,13 @@ class StorePolicy:
         self.retention_ms = int(retention_ms)
         self.retention_messages = int(retention_messages)
         self.index_interval_bytes = int(index_interval_bytes)
+        #: compaction trigger (min.cleanable.dirty.ratio) and tombstone
+        #: retention (delete.retention.ms against the newest record ts)
+        #: for cleanup.policy=compact topics
+        self.compact_min_dirty_ratio = float(compact_min_dirty_ratio)
+        self.compact_grace_ms = int(compact_grace_ms)
+        #: background StoreCompactor cadence (the platform's thread)
+        self.compact_interval_s = float(compact_interval_s)
 
     @classmethod
     def from_config(cls, store_cfg) -> "StorePolicy":
@@ -102,7 +112,13 @@ class StorePolicy:
                    retention_ms=store_cfg.retention_ms,
                    retention_messages=getattr(store_cfg,
                                               "retention_messages", 0),
-                   index_interval_bytes=store_cfg.index_interval_bytes)
+                   index_interval_bytes=store_cfg.index_interval_bytes,
+                   compact_min_dirty_ratio=getattr(
+                       store_cfg, "compact_min_dirty_ratio", 0.5),
+                   compact_grace_ms=getattr(store_cfg,
+                                            "compact_grace_ms", 60_000),
+                   compact_interval_s=getattr(store_cfg,
+                                              "compact_interval_s", 5.0))
 
 
 class _Segment:
@@ -138,10 +154,21 @@ class SegmentedLog:
         self._active_opened = time.monotonic()
         self.recovered_truncated_bytes = 0
         self._total_bytes = 0  # maintained incrementally (gauge hot path)
+        #: offset frontier of the last compaction pass (compact.py):
+        #: sealed segments wholly below it are "clean" for the dirty-
+        #: ratio trigger.  Not persisted — a remount re-compacts at
+        #: worst (idempotent), never under-compacts silently.
+        self._clean_through = 0
         self._recover()
 
     # ---------------------------------------------------------- recovery
     def _recover(self) -> None:
+        from .compact import sweep_cleaned
+
+        # a compaction pass killed before its swap leaves a `.cleaned`
+        # rewrite tmp beside the live segment; the live segment is still
+        # the truth, the tmp is dead weight
+        sweep_cleaned(self.dir)
         names = sorted(n for n in os.listdir(self.dir)
                        if n.endswith(_LOG_SUFFIX))
         for i, name in enumerate(names):
@@ -314,6 +341,24 @@ class SegmentedLog:
                 self._last_fsync = now
         self._update_size_gauge()
         return off
+
+    def append_at(self, offset: int, key: Optional[bytes], value,
+                  timestamp_ms: int, headers: Optional[tuple] = None,
+                  sync: bool = True) -> int:
+        """Append one record AT an explicit offset at/after the log end —
+        the replica's mirror path for COMPACTED topics, whose fetches
+        carry offset holes (compaction punched out shadowed records).
+        Appending them contiguously would renumber the survivors and
+        silently break the offsets-identical failover contract; jumping
+        the active segment's next_offset forward reproduces the hole."""
+        offset = int(offset)
+        end = self.end_offset
+        if offset < end:
+            raise ValueError(f"append_at({offset}) behind log end {end}: "
+                             f"offsets only move forward")
+        if offset > end:
+            self._segments[-1].next_offset = offset
+        return self.append(key, value, timestamp_ms, headers, sync=sync)
 
     def sync_batch(self) -> None:
         """The deferred half of ``append(sync=False)`` under
@@ -493,6 +538,24 @@ class SegmentedLog:
         """Replay every record with timestamp >= `timestamp_ms`."""
         return self.read_from(self.offset_for_timestamp(timestamp_ms),
                               max_records=max_records, _count_replay=True)
+
+    # -------------------------------------------------------- compaction
+    def compact(self, grace_ms: Optional[int] = None, lock=None):
+        """Key-based compaction over the sealed segments (compact.py):
+        keeps the latest record per key, drops tombstones past the grace
+        window, preserves offsets.  ``lock`` (the broker lock) is taken
+        only around each swap + segment-list update — the scan/rewrite
+        I/O runs outside it so compaction never stalls produce/fetch."""
+        from . import compact as _compact
+
+        return _compact.compact_log(self, grace_ms=grace_ms, lock=lock)
+
+    def dirty_ratio(self) -> float:
+        """Sealed bytes appended since the last compaction over total
+        sealed bytes — the ``min.cleanable.dirty.ratio`` trigger input."""
+        from . import compact as _compact
+
+        return _compact.dirty_ratio(self)
 
     # --------------------------------------------------------- retention
     def enforce_retention(self) -> int:

@@ -106,13 +106,15 @@ class StoreMount:
 
     def register_topic(self, name: str, partitions: int,
                        retention_messages=None, retention_bytes=None,
-                       retention_ms=None) -> None:
+                       retention_ms=None,
+                       cleanup_policy: str = "delete") -> None:
         doc = {
             "dir": _dirname_for(name),
             "partitions": int(partitions),
             "retention_messages": retention_messages,
             "retention_bytes": retention_bytes,
             "retention_ms": retention_ms,
+            "cleanup_policy": cleanup_policy,
         }
         if self._manifest.get(name) == doc:
             return  # mount-time re-registration: no rewrite+fsync per topic

@@ -8,7 +8,10 @@ Kafka keeps in ``__consumer_offsets``: each commit appends one framed
 record (``segment.py`` frame; key = ``group\\0topic\\0partition``, value
 = offset as decimal ASCII), and when the appended history outgrows the
 live key set by ``compact_ratio`` the whole file is rewritten with one
-record per key and atomically renamed into place.
+record per key and atomically renamed into place.  The keep/discard
+decision is ``store.compact``'s (`latest_offsets` + `keep`) — the same
+one implementation that compacts ``cleanup.policy=compact`` topic
+segments, applied here to a single-file log.
 
 Crash behavior is the segment format's: a torn tail record is dropped at
 load (the commit it carried was never acknowledged as durable under
@@ -99,11 +102,29 @@ class OffsetsFile:
             self._writer.sync()
 
     def compact(self) -> None:
-        """Rewrite one record per live key; atomic-rename publication."""
+        """Rewrite one record per live key; atomic-rename publication.
+
+        Routes the keep/discard decision through the generic compactor
+        (store.compact) so key-compaction semantics exist exactly once.
+        Survivors are re-framed from the in-memory table — it IS the
+        latest-per-key set (`_load` rebuilds it, ``get`` serves it), so
+        this commit-hot path never re-reads the file from disk.  Frames
+        are byte-identical to ``commit``'s (same key/value encoding,
+        ts 0, no headers); offsets collapse to 0 — this file is a
+        table, not an offset-addressed log, so renumbering is free.
+        Tombstones never appear here (commits are never null), so the
+        grace window is moot."""
+        from . import compact as _compact
+
+        records = [
+            (i, f"{g}\x00{t}\x00{p}".encode(), str(off).encode(), 0, None)
+            for i, ((g, t, p), off) in enumerate(self._table.items())]
+        latest = _compact.latest_offsets(records)
         blob = b"".join(
-            seg.encode_record(0, f"{g}\x00{t}\x00{p}".encode(),
-                              str(off).encode(), 0, None)
-            for (g, t, p), off in sorted(self._table.items()))
+            seg.encode_record(0, key, value, ts, headers)
+            for off, key, value, ts, headers in records
+            if _compact.keep((off, key, value, ts, headers), latest,
+                             newest_ts=-1, grace_ms=None))
         self._writer.close(sync=False)
         seg.atomic_write(self.path, blob, fsync=self.fsync != "never")
         self._writer = SegmentWriter(self.path, fsync=self.fsync)

@@ -5,6 +5,9 @@ One segment is one append-only file holding length-prefixed records:
     u32  length        bytes after this field (frame body)
     u32  crc32c        Castagnoli CRC over every byte after this field
     u8   attrs         bit 0: record carries headers
+                       bit 1: null value (a TOMBSTONE — compaction's
+                       delete marker; value_len is 0 and the decoded
+                       value is None, never b"")
     i64  offset        absolute log offset (self-describing: recovery
                        and index rebuilds never need external state)
     i64  timestamp_ms  record timestamp (the timestamp index key)
@@ -58,6 +61,9 @@ _HEAD = struct.Struct(">IBqqi")    # crc, attrs, offset, timestamp, key_len
 _U32 = struct.Struct(">I")
 _U16 = struct.Struct(">H")
 _ATTR_HEADERS = 0x01
+_ATTR_NULL_VALUE = 0x02  # tombstone: the frame body carries value_len 0,
+# decode returns value=None — distinct from an empty (b"") value so
+# compaction's delete markers survive a durable hop intact
 
 #: the smallest possible frame body: crc+attrs+offset+ts+key_len + value_len
 MIN_BODY = _HEAD.size + _U32.size
@@ -144,10 +150,14 @@ def _decode_headers(body: bytes, pos: int) -> Optional[tuple]:
     return tuple(out)
 
 
-def encode_record(offset: int, key: Optional[bytes], value: bytes,
+def encode_record(offset: int, key: Optional[bytes], value: Optional[bytes],
                   timestamp_ms: int, headers: Optional[tuple]) -> bytes:
-    """One framed record (length prefix included)."""
+    """One framed record (length prefix included).  ``value=None`` frames
+    a tombstone (attrs bit 1): byte-distinct from an empty value."""
     attrs = _ATTR_HEADERS if headers else 0
+    if value is None:
+        attrs |= _ATTR_NULL_VALUE
+        value = b""
     parts = [_HEAD.pack(0, attrs, offset, timestamp_ms,
                         -1 if key is None else len(key))]
     if key is not None:
@@ -174,7 +184,7 @@ def decode_record(body: bytes) -> Tuple[int, Optional[bytes], bytes, int,
         pos += key_len
     (vlen,) = _U32.unpack_from(body, pos)
     pos += _U32.size
-    value = body[pos:pos + vlen]
+    value = None if attrs & _ATTR_NULL_VALUE else body[pos:pos + vlen]
     pos += vlen
     headers = _decode_headers(body, pos) if attrs & _ATTR_HEADERS else None
     return offset, key, value, ts, headers

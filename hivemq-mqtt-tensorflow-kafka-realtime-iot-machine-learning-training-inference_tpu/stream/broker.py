@@ -119,6 +119,14 @@ class TopicSpec:
     retention_messages: Optional[int] = None
     retention_bytes: Optional[int] = None
     retention_ms: Optional[int] = None
+    # "delete" (default) reclaims whole segments by retention; "compact"
+    # additionally reclaims records shadowed by a newer record with the
+    # same key (Kafka's cleanup.policy) — the changelog-topic contract
+    # the digital twin's CAR_TWIN rides on.  Durable brokers compact
+    # sealed segments in place (store/compact.py); the in-memory backend
+    # keeps the policy as metadata only (its logs die with the process,
+    # so there is nothing to reclaim durably).
+    cleanup_policy: str = "delete"
 
 
 class _Partition:
@@ -136,7 +144,8 @@ class _Partition:
     # substitute — the broker's lock discipline stays identical
     def append(self, key, value, ts, headers, sync: bool = True) -> int:
         self.log.append((key, value, ts, headers))
-        self.bytes += len(value) + (len(key) if key else 0)
+        # value None = tombstone (compaction's delete marker): zero bytes
+        self.bytes += (len(value) if value else 0) + (len(key) if key else 0)
         if ts > self.max_ts:
             self.max_ts = ts
         return self.base_offset + len(self.log) - 1
@@ -162,7 +171,8 @@ class _Partition:
 
     def drop_head(self, count: int) -> None:
         for key, value, _ts, _h in self.log[:count]:
-            self.bytes -= len(value) + (len(key) if key else 0)
+            self.bytes -= (len(value) if value else 0) + \
+                (len(key) if key else 0)
         del self.log[:count]
         self.base_offset += count
 
@@ -175,7 +185,8 @@ class _Partition:
             while self.bytes - freed > spec.retention_bytes and \
                     drop < len(self.log) - 1:
                 key, value, _ts, _h = self.log[drop]
-                freed += len(value) + (len(key) if key else 0)
+                freed += (len(value) if value else 0) + \
+                    (len(key) if key else 0)
                 drop += 1
             if drop:
                 self.drop_head(drop)
@@ -190,6 +201,21 @@ class _Partition:
                 drop += 1
             if drop and self.log[drop - 1][2] < cutoff:
                 self.drop_head(drop)
+
+    def append_at(self, offset, key, value, ts, headers,
+                  sync: bool = True) -> int:
+        """Offset-explicit append — the replica's mirror path for
+        COMPACTED topics.  The in-memory list is dense (it cannot hold
+        offset holes), so only a gap-free continuation is representable;
+        a true hole must realign via reset (the durable backend handles
+        holes natively)."""
+        end = self.base_offset + len(self.log)
+        if int(offset) != end:
+            raise ValueError(
+                f"in-memory partition cannot represent an offset hole "
+                f"({offset} != end {end}); mount a durable follower for "
+                f"compacted-topic mirroring")
+        return self.append(key, value, ts, headers, sync=sync)
 
     def align_base(self, offset: int) -> None:
         if self.log:
@@ -222,6 +248,11 @@ class _DurablePartition:
 
     def append(self, key, value, ts, headers, sync: bool = True) -> int:
         return self.slog.append(key, value, ts, headers, sync=sync)
+
+    def append_at(self, offset, key, value, ts, headers,
+                  sync: bool = True) -> int:
+        return self.slog.append_at(offset, key, value, ts, headers,
+                                   sync=sync)
 
     def sync_batch(self) -> None:
         self.slog.sync_batch()
@@ -278,6 +309,9 @@ class Broker:
 
     def __init__(self, store_dir: Optional[str] = None, store_policy=None):
         self._lock = threading.Lock()
+        # serializes whole compaction PASSES (background compactor vs a
+        # forced drill pass); the data lock above covers only the swaps
+        self._compact_pass_lock = threading.Lock()
         self._topics: Dict[str, TopicSpec] = {}
         self._parts: Dict[str, List] = {}
         self._group_offsets: Dict[tuple, int] = {}  # (group, topic, part) → next offset
@@ -293,7 +327,8 @@ class Broker:
                     doc["name"], partitions=doc["partitions"],
                     retention_messages=doc.get("retention_messages"),
                     retention_bytes=doc.get("retention_bytes"),
-                    retention_ms=doc.get("retention_ms"))
+                    retention_ms=doc.get("retention_ms"),
+                    cleanup_policy=doc.get("cleanup_policy", "delete"))
             self._group_offsets.update(self.store.offsets.table())
 
     @property
@@ -359,24 +394,34 @@ class Broker:
     def create_topic(self, name: str, partitions: int = 1,
                      retention_messages: Optional[int] = None,
                      retention_bytes: Optional[int] = None,
-                     retention_ms: Optional[int] = None) -> TopicSpec:
+                     retention_ms: Optional[int] = None,
+                     cleanup_policy: str = "delete") -> TopicSpec:
         retention_messages = self._validate_retention(
             "retention_messages", retention_messages)
         retention_bytes = self._validate_retention(
             "retention_bytes", retention_bytes)
         retention_ms = self._validate_retention("retention_ms", retention_ms)
+        if cleanup_policy not in ("delete", "compact"):
+            # "compact,delete" deliberately unsupported as a single
+            # string: compaction COMPOSES with retention here (both
+            # apply when both are configured), so the combined form
+            # would be redundant, not new semantics
+            raise ValueError(f"cleanup_policy must be 'delete' or "
+                             f"'compact', got {cleanup_policy!r}")
         with self._lock:
             if name in self._topics:
                 return self._topics[name]
             spec = TopicSpec(name, partitions, retention_messages,
-                             retention_bytes, retention_ms)
+                             retention_bytes, retention_ms,
+                             cleanup_policy)
             self._topics[name] = spec
             if self.store is not None:
                 self.store.register_topic(
                     name, partitions,
                     retention_messages=retention_messages,
                     retention_bytes=retention_bytes,
-                    retention_ms=retention_ms)
+                    retention_ms=retention_ms,
+                    cleanup_policy=cleanup_policy)
             self._parts[name] = [self._make_partition(name, p)
                                  for p in range(partitions)]
             self._rr[name] = 0
@@ -398,12 +443,16 @@ class Broker:
         return zlib.crc32(key) % n
 
     # ------------------------------------------------------------ produce
-    def produce(self, topic: str, value: bytes, key: Optional[bytes] = None,
+    def produce(self, topic: str, value: Optional[bytes],
+                key: Optional[bytes] = None,
                 partition: Optional[int] = None, timestamp_ms: int = 0,
                 headers: Optional[tuple] = None) -> int:
         """Append one record; returns its offset. Auto-creates 1-partition
         topics (matching Kafka's auto.create default used by the reference's
-        local demos)."""
+        local demos).  ``value=None`` appends a TOMBSTONE (Kafka's null
+        value): on a ``cleanup.policy=compact`` topic it deletes the key
+        once compaction's grace window passes; fetches surface it as
+        ``Message.value is None``, never as an empty payload."""
         chaos.point("broker.produce")
         self._check_producer(topic)
         if topic not in self._topics:
@@ -462,6 +511,62 @@ class Broker:
                 parts[p].sync_batch()
                 parts[p].enforce_retention(spec)
         return last_off
+
+    def produce_at(self, topic: str, partition: int, offset: int,
+                   value: Optional[bytes], key: Optional[bytes] = None,
+                   timestamp_ms: int = 0,
+                   headers: Optional[tuple] = None) -> int:
+        """Append one record AT an explicit offset at/after the log end —
+        the replica's mirror path for compacted topics, whose fetched
+        batches carry offset holes.  Forward jumps reproduce the hole on
+        the durable backend; the in-memory backend accepts only gap-free
+        continuations (ValueError otherwise — the replica surfaces it as
+        a sync error instead of silently renumbering)."""
+        self._check_producer(topic)
+        if topic not in self._topics:
+            self.create_topic(topic)
+        with self._lock:
+            return self._parts[topic][partition].append_at(
+                offset, key, value, timestamp_ms, headers)
+
+    # ---------------------------------------------------------- compaction
+    def run_compaction(self, force: bool = False) -> Dict[tuple, object]:
+        """One compaction pass over every ``cleanup.policy=compact``
+        topic partition (durable broker only — the in-memory backend has
+        nothing durable to reclaim).  Applies the dirty-ratio gate
+        unless ``force``; returns {(topic, partition): CompactionStats}.
+        Driven by the background ``store.StoreCompactor`` in production
+        and called directly by tests/drills for determinism.
+
+        Concurrency: whole passes are serialized by ``_compact_pass_lock``
+        (background compactor vs a forced drill pass never interleave on
+        the same segments); the broker data lock is taken only around
+        each segment swap (`compact_log`), so produce/fetch proceed
+        through a pass.  On a ShardBroker, unowned partitions hold no
+        log and are skipped — each shard compacts only what it leads."""
+        if self.store is None:
+            return {}
+        out: Dict[tuple, object] = {}
+        pol = self.store.policy
+        with self._compact_pass_lock:
+            with self._lock:
+                compacted = [(name, spec)
+                             for name, spec in self._topics.items()
+                             if spec.cleanup_policy == "compact"]
+            for name, spec in compacted:
+                for p in range(spec.partitions):
+                    part = self._parts[name][p]
+                    slog = getattr(part, "slog", None)
+                    if slog is None:
+                        continue  # cluster: partition not led by this shard
+                    if not force and slog.dirty_ratio() < \
+                            pol.compact_min_dirty_ratio:
+                        continue
+                    stats = slog.compact(grace_ms=pol.compact_grace_ms,
+                                         lock=self._lock)
+                    if stats.segments_rewritten:
+                        out[(name, p)] = stats
+        return out
 
     # -------------------------------------------------------------- fetch
     def end_offset(self, topic: str, partition: int = 0) -> int:

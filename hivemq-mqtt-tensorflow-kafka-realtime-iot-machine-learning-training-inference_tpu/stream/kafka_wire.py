@@ -715,15 +715,18 @@ class KafkaWireBroker(ProducePartitionMixin):
     def create_topic(self, name: str, partitions: int = 1,
                      retention_messages: Optional[int] = None,
                      retention_bytes: Optional[int] = None,
-                     retention_ms: Optional[int] = None) -> TopicSpec:
+                     retention_ms: Optional[int] = None,
+                     cleanup_policy: Optional[str] = None) -> TopicSpec:
         w = _Writer()
-        # retention rides CreateTopics v0's standard config entries —
-        # retention.bytes / retention.ms are Kafka's own names;
-        # retention.messages is the emulator-family extension
+        # retention and cleanup.policy ride CreateTopics v0's standard
+        # config entries — retention.bytes / retention.ms /
+        # cleanup.policy are Kafka's own names; retention.messages is
+        # the emulator-family extension
         cfgs = [(k, str(v)) for k, v in
                 (("retention.messages", retention_messages),
                  ("retention.bytes", retention_bytes),
-                 ("retention.ms", retention_ms)) if v is not None]
+                 ("retention.ms", retention_ms),
+                 ("cleanup.policy", cleanup_policy)) if v is not None]
 
         def one(wr, _):
             wr.string(name).i32(partitions).i16(1)
@@ -844,7 +847,11 @@ class KafkaWireBroker(ProducePartitionMixin):
                     raise RuntimeError(f"fetch {topic}:{pid} failed: {err}")
                 for off, key, value, ts in decode_message_set(record_set or b""):
                     if off >= offset and len(out) < max_messages:
-                        out.append(Message(tname, pid, off, value or b"",
+                        # a null VALUE is a tombstone (compacted-topic
+                        # delete marker): surfaced as None, not coerced
+                        # to b"" — consumers of changelogs must be able
+                        # to tell "deleted" from "empty"
+                        out.append(Message(tname, pid, off, value,
                                            key, ts))
         return out
 
@@ -1369,9 +1376,12 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                         base = broker.end_offset(tname, pid)
                         # bulk append under one broker lock — the
                         # per-message produce loop was a per-record cost
-                        # in the server's hottest handler
+                        # in the server's hottest handler.  Null values
+                        # pass through intact: a produced tombstone must
+                        # land in the log as a tombstone, or compaction
+                        # could never delete a key written over the wire
                         broker.produce_many(
-                            tname, [(key, value or b"", ts)
+                            tname, [(key, value, ts)
                                     for _, key, value, ts in entries],
                             partition=pid)
                     except NotLeaderForPartitionError:
@@ -1652,6 +1662,11 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                     try:
                         ret = {}
                         for k, v in cfgs:
+                            if k == "cleanup.policy" and v is not None:
+                                # create_topic validates the value
+                                # (ValueError → INVALID_CONFIG below)
+                                ret["cleanup_policy"] = v
+                                continue
                             field = {"retention.messages":
                                      "retention_messages",
                                      "retention.bytes": "retention_bytes",

@@ -67,11 +67,18 @@ def _sig(lib) -> None:
     sigs = {
         "metadata": [c.c_void_p, c.c_char_p],
         "create_topic": [c.c_void_p, c.c_char_p, c.c_int32],
+        # + optional cleanup.policy config entry (NULL = none)
+        "create_topic_cfg": [c.c_void_p, c.c_char_p, c.c_int32, c.c_char_p],
         "list_offset": [c.c_void_p, c.c_char_p, c.c_int32, c.c_int64],
         "produce": [c.c_void_p, c.c_char_p, c.c_int32, c.c_char_p, _i64p,
                     c.c_char_p, _i64p, _u8p, _i64p, c.c_int64],
+        # tombstone-capable produce: value_null flags ride after key_null
+        "produce_nulls": [c.c_void_p, c.c_char_p, c.c_int32, c.c_char_p,
+                          _i64p, c.c_char_p, _i64p, _u8p, _u8p, _i64p,
+                          c.c_int64],
         "fetch": [c.c_void_p, c.c_char_p, c.c_int32, c.c_int64, c.c_int64],
         "staged_bytes": [c.c_void_p, _i64p, _i64p],
+        "staged_value_nulls": [c.c_void_p, _u8p],
         "high_watermark": [c.c_void_p],
         "take": [c.c_void_p, c.c_char_p, _i64p, c.c_char_p, _i64p, _u8p,
                  _i64p, _i64p],
@@ -172,10 +179,13 @@ class NativeKafkaBroker(ProducePartitionMixin):
             return n
 
     def create_topic(self, name: str, partitions: int = 1,
-                     retention_messages: Optional[int] = None) -> TopicSpec:
+                     retention_messages: Optional[int] = None,
+                     cleanup_policy: Optional[str] = None) -> TopicSpec:
         with self._lock:
-            existed = _check(self._lib.iotml_kafka_create_topic(
-                self._h, name.encode(), partitions), f"create_topic({name})")
+            existed = _check(self._lib.iotml_kafka_create_topic_cfg(
+                self._h, name.encode(), partitions,
+                cleanup_policy.encode() if cleanup_policy else None),
+                f"create_topic({name})")
             if existed:
                 # the topic's real partition count may differ from the request —
                 # refresh from metadata so the partitioner never routes out of
@@ -210,6 +220,16 @@ class NativeKafkaBroker(ProducePartitionMixin):
                 # both native produce paths
                 from .kafka_wire import columnar_kvt
 
+                # tombstones (value None): framed through the null-aware
+                # entry point so the delete marker crosses the wire as a
+                # null value, never a spoofed empty payload
+                vnull = None
+                if any(v is None for _k, v, _t in ents):
+                    vnull = np.asarray(
+                        [1 if v is None else 0 for _k, v, _t in ents],
+                        np.uint8)
+                    ents = [(k, v if v is not None else b"", t)
+                            for k, v, t in ents]
                 values, voff, keys, koff, knull, ts = columnar_kvt(ents)
                 if keys is None:
                     kargs = (None, None, None)
@@ -217,10 +237,18 @@ class NativeKafkaBroker(ProducePartitionMixin):
                     kargs = (ctypes.c_char_p(keys),
                              koff.ctypes.data_as(_i64p),
                              knull.ctypes.data_as(_u8p))
-                rc = self._lib.iotml_kafka_produce(
-                    self._h, topic.encode(), p, ctypes.c_char_p(values),
-                    voff.ctypes.data_as(_i64p), *kargs,
-                    ts.ctypes.data_as(_i64p), len(ents))
+                if vnull is not None:
+                    rc = self._lib.iotml_kafka_produce_nulls(
+                        self._h, topic.encode(), p,
+                        ctypes.c_char_p(values),
+                        voff.ctypes.data_as(_i64p), *kargs,
+                        vnull.ctypes.data_as(_u8p),
+                        ts.ctypes.data_as(_i64p), len(ents))
+                else:
+                    rc = self._lib.iotml_kafka_produce(
+                        self._h, topic.encode(), p, ctypes.c_char_p(values),
+                        voff.ctypes.data_as(_i64p), *kargs,
+                        ts.ctypes.data_as(_i64p), len(ents))
                 if rc == -1006:
                     raise NotLeaderForPartitionError(topic, p)
                 base = _check(rc, f"produce({topic}:{p})")
@@ -264,8 +292,13 @@ class NativeKafkaBroker(ProducePartitionMixin):
             voff = np.zeros((n + 1,), np.int64)
             koff = np.zeros((n + 1,), np.int64)
             knull = np.zeros((n,), np.uint8)
+            vnull = np.zeros((n,), np.uint8)
             moff = np.zeros((n,), np.int64)
             ts = np.zeros((n,), np.int64)
+            # value-null flags staged BEFORE take (take clears staging):
+            # tombstones surface as Message.value None, never b""
+            self._lib.iotml_kafka_staged_value_nulls(
+                self._h, vnull.ctypes.data_as(_u8p))
             self._lib.iotml_kafka_take(
                 self._h, values, voff.ctypes.data_as(_i64p), keys,
                 koff.ctypes.data_as(_i64p), knull.ctypes.data_as(_u8p),
@@ -275,8 +308,9 @@ class NativeKafkaBroker(ProducePartitionMixin):
             out = []
             for i in range(n):
                 key = None if knull[i] else kraw[koff[i]:koff[i + 1]]
+                value = None if vnull[i] else vraw[voff[i]:voff[i + 1]]
                 out.append(Message(topic, partition, int(moff[i]),
-                                   vraw[voff[i]:voff[i + 1]], key, int(ts[i])))
+                                   value, key, int(ts[i])))
             return out
 
     def fetch_decode(self, topic: str, partition: int, offset: int,

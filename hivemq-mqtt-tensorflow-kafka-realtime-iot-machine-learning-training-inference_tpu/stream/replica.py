@@ -77,7 +77,8 @@ class FollowerReplica:
                  sasl: Optional[tuple] = None,
                  commit_interval_s: float = 1.0,
                  store_dir: Optional[str] = None, store_policy=None,
-                 partition_filter=None, local: Optional[Broker] = None):
+                 partition_filter=None, local: Optional[Broker] = None,
+                 compacted_topics: Tuple[str, ...] = ()):
         #: local log bound per mirrored topic.  The wire protocol does
         #: not carry the leader's retention config, so a follower of a
         #: retention-bounded leader must be given its own bound here or
@@ -110,6 +111,15 @@ class FollowerReplica:
         self._leader = KafkaWireBroker(leader, client_id="iotml-replica",
                                        sasl_username=user, sasl_password=pw)
         self._topics = topics
+        #: topics mirrored with COMPACTED semantics: fetched batches may
+        #: carry offset holes (compaction punched out shadowed records),
+        #: so a gap is replayed offset-preserving via produce_at instead
+        #: of triggering the trimmed-history realignment.  Detected from
+        #: the leader's TopicSpec when it carries cleanup_policy (an
+        #: in-process leader); the wire Metadata has no config slot, so
+        #: wire followers name them here (operator knowledge, exactly
+        #: like the retention bound above).
+        self._compacted = set(compacted_topics)
         self._groups = list(groups)
         self._interval = poll_interval_s
         self._commit_interval = commit_interval_s
@@ -239,11 +249,15 @@ class FollowerReplica:
         copied = 0
         for t in names:
             spec = self._leader.topic(t)
+            compacted = t in self._compacted or \
+                getattr(spec, "cleanup_policy", "delete") == "compact"
             if t not in self._parts:
                 if t not in self.local.topics():
                     self.local.create_topic(
                         t, partitions=spec.partitions,
-                        retention_messages=self._retention)
+                        retention_messages=self._retention,
+                        cleanup_policy="compact" if compacted
+                        else "delete")
                     # late-start bootstrap: align each empty partition to
                     # the leader's earliest retained offset so copied
                     # messages land at IDENTICAL offsets
@@ -277,6 +291,26 @@ class FollowerReplica:
                         continue
                     if not msgs:
                         break
+                    if compacted:
+                        # offset holes here are COMPACTION artifacts,
+                        # not trim loss: mirror offset-preserving so the
+                        # follower's log carries identical offsets (and
+                        # identical holes).  produce_at refuses holes on
+                        # an in-memory local (its list is dense) — that
+                        # surfaces as a sync error below, never as a
+                        # silently renumbered log.
+                        try:
+                            for m in msgs:
+                                self.local.produce_at(
+                                    t, p, m.offset, m.value, key=m.key,
+                                    timestamp_ms=m.timestamp_ms,
+                                    headers=m.headers)
+                        except ValueError as e:
+                            self.sync_errors.append(
+                                f"compacted {t}:{p}: {e}")
+                            break
+                        copied += len(msgs)
+                        continue
                     if msgs[0].offset != local_end:
                         # leader trimmed past our cursor (retention
                         # outran replication): REALIGN — appending at the

@@ -1,0 +1,47 @@
+"""R12 fixture: compaction / twin-changelog write-discipline breaches,
+every way to get it wrong — a produce straight into the CAR_TWIN
+changelog by literal (1 finding) and via the imported CHANGELOG_TOPIC
+constant (1 finding), a direct compact_log() call (1 finding), and a
+SegmentWriter opened on a .cleaned rewrite path (1 finding) — plus the
+clean shapes: reading the changelog, triggering compaction through
+Broker.run_compaction, and a justified suppression (0 findings).
+"""
+
+
+def foreign_changelog_writer(broker, state):
+    # flagged: CAR_TWIN has ONE writer (TwinService).  A foreign record
+    # is replayed by every rebuild — it corrupts the twin forever.
+    broker.produce("CAR_TWIN", state, key=b"car-7")
+
+
+def foreign_writer_via_constant(broker, CHANGELOG_TOPIC, state):
+    # flagged: same breach through the named constant
+    broker.produce_many(CHANGELOG_TOPIC, [(b"car-7", state, 0)])
+
+
+def hand_rolled_compaction(slog, compact_log):
+    # flagged: the swap protocol (durable tmp, atomic replace, sweep)
+    # lives in the store; callers go through Broker.run_compaction
+    return compact_log(slog, grace_ms=0)
+
+
+def rewrite_tmp_by_hand(SegmentWriter, segment_path):
+    # flagged: a .cleaned file outside the store's swap protocol is a
+    # crash artifact recovery will sweep — or worse, trust
+    w = SegmentWriter(segment_path + ".cleaned", fsync="never")
+    w.close()
+
+
+def reading_is_fine(broker):
+    # the changelog is everyone's to READ — that is the point of it
+    return broker.fetch("CAR_TWIN", 0, 0, 100)
+
+
+def sanctioned_trigger_is_fine(broker):
+    # the one public entry point: lock discipline + dirty-ratio gate
+    return broker.run_compaction()
+
+
+def justified(broker):
+    # lint-ok: R12 test harness seeds a poisoned changelog on purpose
+    broker.produce("CAR_TWIN", b"{}", key=b"seeded")
