@@ -518,6 +518,307 @@ def bench_checkpoint():
                 records_per_round=per_round)
 
 
+# ----------------------------------------------------------- online
+def bench_online():
+    """Online-vs-micro-batch adaptation after a seeded regional drift
+    (iotml.online), plus the adversarial fleet scenario suite scored
+    with the r04 detection-quality + saturation harnesses.
+
+    The headline: after a seeded drift, how many records does each
+    training mode need before live detection AUC is back within 0.05
+    of the deployed model's pre-drift AUC?  Both modes start from the
+    SAME pre-trained model over the SAME byte-identical stream; the
+    micro-batch baseline is this repo's own ContinuousTrainer (2000-
+    record rounds through the registry — a far stronger baseline than
+    the reference's 10k-record retrain-then-redeploy cycle), so the
+    measured gap is what drift DETECTION + adaptation buys, not a
+    strawman.  Riding along: the throughput guard (incremental updates
+    >= 80% of micro-batch train rate, measured in-run on the same
+    box) and one bounded detection-quality + throughput pass per
+    adversarial scenario."""
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from iotml.data.dataset import SensorBatches
+    from iotml.gen.scenarios import AdversarialFleet, condition
+    from iotml.gen.simulator import FleetScenario
+    from iotml.mlops import ModelRegistry, RegistryWatcher
+    from iotml.mlops.checkpoint import params_to_h5_bytes
+    from iotml.models.autoencoder import CAR_AUTOENCODER
+    from iotml.online.learner import OnlineLearner
+    from iotml.serve.scorer import StreamScorer, hist_auc
+    from iotml.stream.broker import Broker
+    from iotml.stream.consumer import StreamConsumer
+    from iotml.stream.producer import OutputSequence
+    from iotml.train.live import ContinuousTrainer
+    from iotml.train.loop import Trainer
+
+    TOPIC = "SENSOR_DATA_S_AVRO"
+    CARS = 50
+    # seed 11's failure draw has 5 VISIBLE failing cars (vibration /
+    # tire modes) — battery-mode failures live in columns the PARITY
+    # normalizer zeroes, and a fleet of invisible anomalies measures
+    # label noise, not detection (the drill walks seeds for the same
+    # reason)
+    SEED = 11
+    PRE_TICKS = 120        # 6000-record pre-drift pretrain slice
+    LIVE_PRE_TICKS = 60    # 3000 live pre-drift records (baseline)
+    POST_TICKS = int(os.environ.get("IOTML_BENCH_ONLINE_POST_TICKS",
+                                    "360"))  # 18k post-drift records
+    CHUNK_TICKS = 20       # 1000-record AUC trajectory windows
+    EPS = 0.05
+
+    def fresh_fleet():
+        return AdversarialFleet(
+            FleetScenario(num_cars=CARS, failure_rate=0.12, seed=SEED),
+            condition("regional-drift",
+                      drift_tick=PRE_TICKS + LIVE_PRE_TICKS))
+
+    # ---- the deployed model: pre-trained on the pre-drift slice
+    b0 = Broker()
+    f0 = fresh_fleet()
+    f0.publish_stream(b0, TOPIC, n_ticks=PRE_TICKS)
+    pre = Trainer(CAR_AUTOENCODER)
+    pre.fit_compiled(
+        SensorBatches(StreamConsumer(b0, [f"{TOPIC}:0:0"], group="pt"),
+                      batch_size=100, only_normal=True, cache=True),
+        epochs=12)
+    params0 = jax.device_get(pre.state.params)
+    # held-out pre-drift AUC of the deployed model — the recovery
+    # target both modes chase (fixed weights, fresh pre-drift records)
+    f0.publish_stream(b0, TOPIC, n_ticks=LIVE_PRE_TICKS)
+    sc0 = StreamScorer(
+        CAR_AUTOENCODER, params0,
+        SensorBatches(StreamConsumer(b0, [f"{TOPIC}:{0}:"
+                                          f"{PRE_TICKS * CARS}"],
+                                     group="pt-auc"),
+                      batch_size=100, keep_labels=True),
+        OutputSequence(b0, "preds-pt", partition=0), threshold=5.0)
+    sc0.score_available()
+    auc_pre = hist_auc(sc0.err_hist["true"], sc0.err_hist["false"])
+
+    def trajectory(mode):
+        """Drive one mode over the byte-identical stream; return the
+        per-window AUC trajectory + records-to-recover."""
+        broker = Broker()
+        fleet = fresh_fleet()
+        fleet.publish_stream(broker, TOPIC, n_ticks=PRE_TICKS)
+        root = tempfile.mkdtemp(prefix=f"iotml_bench_online_{mode}_")
+        reg = ModelRegistry(root)
+        mark = broker.end_offset(TOPIC, 0)
+        reg.promote(reg.publish(
+            {"model.h5": params_to_h5_bytes(params0)},
+            offsets=[(TOPIC, 0, mark)]).version)
+        if mode == "online":
+            trainer = OnlineLearner(broker, TOPIC, registry=reg,
+                                    group=f"bench-{mode}", window=100,
+                                    publish_every=10)
+
+            def pump_trainer():
+                while trainer.process_available(max_updates=64):
+                    trainer.write_published()
+                    watcher.poll_once()
+        else:
+            trainer = ContinuousTrainer(
+                broker, TOPIC, None, registry=reg,
+                group=f"bench-{mode}", batch_size=100, take_batches=20)
+
+            def pump_trainer():
+                while trainer.available() >= trainer.min_available:
+                    trainer.train_round()
+                    trainer.checkpointer.write_once()
+                    watcher.poll_once()
+        cons = StreamConsumer.from_committed(
+            broker, TOPIC, [0], group=f"bench-{mode}-scorer", eof=True)
+        cons.seek(TOPIC, 0, mark)
+        scorer = StreamScorer(
+            CAR_AUTOENCODER, None,
+            SensorBatches(cons, batch_size=100, keep_labels=True),
+            OutputSequence(broker, f"preds-{mode}", partition=0),
+            threshold=5.0)
+        watcher = RegistryWatcher(reg, scorers=[scorer])
+        watcher.poll_once()
+
+        aucs = []
+        hist = {k: v.copy() for k, v in scorer.err_hist.items()}
+        post_windows = []
+        marks = {}
+
+        def run_chunks(n_ticks, collect):
+            nonlocal hist
+            for _ in range(n_ticks // CHUNK_TICKS):
+                fleet.publish_stream(broker, TOPIC,
+                                     n_ticks=CHUNK_TICKS)
+                pump_trainer()
+                scorer.score_available()
+                h2 = {k: v.copy() for k, v in scorer.err_hist.items()}
+                a = hist_auc(h2["true"] - hist["true"],
+                             h2["false"] - hist["false"])
+                hist = h2
+                collect.append(a)
+
+        run_chunks(LIVE_PRE_TICKS, aucs)       # live pre-drift
+        # capture the update counter AT drift onset (the drill's
+        # protocol): deriving it from record counts mis-states the
+        # latency because only_normal filtering makes update windows
+        # slightly sparser than raw records
+        marks["updates_at_drift"] = getattr(trainer, "updates", 0)
+        run_chunks(POST_TICKS, post_windows)   # drifted
+        shutil.rmtree(root, ignore_errors=True)
+        recover = None
+        target = (auc_pre or 0.0) - EPS
+        for i in range(len(post_windows) - 1):
+            w0, w1 = post_windows[i], post_windows[i + 1]
+            if w0 is not None and w1 is not None \
+                    and w0 >= target and w1 >= target:
+                recover = (i + 1) * CHUNK_TICKS * CARS
+                break
+        detect = None
+        if mode == "online":
+            post_adapt = [a for a in trainer.adaptations
+                          if a[0] > marks["updates_at_drift"]]
+            if post_adapt:
+                # updates are 100-record windows past the live marker
+                detect = (post_adapt[0][0]
+                          - marks["updates_at_drift"]) * 100
+        return dict(recover=recover, detect=detect,
+                    auc_first_post=post_windows[0] if post_windows
+                    else None,
+                    auc_final=post_windows[-1] if post_windows
+                    else None,
+                    windows=[None if a is None else round(a, 4)
+                             for a in post_windows])
+
+    online = trajectory("online")
+    micro = trajectory("microbatch")
+
+    # ---- throughput guard: incremental updates vs micro-batch rounds
+    # on the same prefilled stream (same box, same minute)
+    def throughput_online():
+        broker = Broker()
+        fleet = AdversarialFleet(
+            FleetScenario(num_cars=100, failure_rate=0.01, seed=SEED),
+            condition("baseline"))
+        fleet.publish_stream(broker, TOPIC, n_ticks=400)
+        lrn = OnlineLearner(broker, TOPIC, window=100,
+                            publish_every=10**9)
+        for k in (8, 4, 2, 1, 8):   # warm every fuse variant
+            lrn.process_available(max_updates=k)
+        t0 = time.perf_counter()
+        got = lrn.process_available()
+        return got * 100 / (time.perf_counter() - t0)
+
+    def throughput_micro():
+        broker = Broker()
+        fleet = AdversarialFleet(
+            FleetScenario(num_cars=100, failure_rate=0.01, seed=SEED),
+            condition("baseline"))
+        fleet.publish_stream(broker, TOPIC, n_ticks=400)
+        from iotml.train.artifacts import ArtifactStore
+
+        tmp = tempfile.mkdtemp(prefix="iotml_bench_online_tp_")
+        tr = ContinuousTrainer(broker, TOPIC, ArtifactStore(tmp),
+                               batch_size=100, take_batches=20,
+                               group="bench-tp")
+        tr.train_round()  # compile warmup
+        t0 = time.perf_counter()
+        recs = 0
+        while tr.available() >= tr.min_available:
+            recs += tr.train_round().get("records", 0)
+        dt = time.perf_counter() - t0
+        shutil.rmtree(tmp, ignore_errors=True)
+        return recs / dt
+    # interleaved passes, paired ratio (the shared-box discipline of
+    # bench_checkpoint): this 2-core host's available CPU drifts
+    rps_on, rps_mb = [], []
+    for _ in range(3):
+        rps_on.append(throughput_online())
+        rps_mb.append(throughput_micro())
+    import statistics
+
+    online_rps = statistics.median(rps_on)
+    micro_rps = statistics.median(rps_mb)
+    ratio = online_rps / micro_rps if micro_rps else 0.0
+
+    # ---- the adversarial scenario suite: one bounded pass each,
+    # detection quality + pipeline rate (the r04 + saturation
+    # harnesses applied to every condition, not just the benign fleet)
+    def scenario_pass(name, ticks=60, mqtt_path=False):
+        broker = Broker()
+        fleet = AdversarialFleet(
+            FleetScenario(num_cars=CARS, failure_rate=0.12, seed=SEED),
+            condition(name, **({"drift_tick": ticks // 2}
+                               if name == "regional-drift" else {})))
+        t0 = time.perf_counter()
+        if mqtt_path:
+            from iotml.mqtt.bridge import KafkaBridge
+            from iotml.mqtt.broker import MqttBroker
+            from iotml.streamproc.tasks import JsonToAvro
+
+            mqtt = MqttBroker()
+            KafkaBridge(mqtt, broker, partitions=1)
+            conv = JsonToAvro(broker, src="sensor-data", dst=TOPIC,
+                              partitions=1)
+            published = fleet.publish_mqtt(mqtt, n_ticks=ticks)
+            conv.process_available()
+        else:
+            published = fleet.publish_stream(broker, TOPIC,
+                                             n_ticks=ticks)
+        scorer = StreamScorer(
+            CAR_AUTOENCODER, params0,
+            SensorBatches(StreamConsumer(broker, [f"{TOPIC}:0:0"],
+                                         group=f"sc-{name}"),
+                          batch_size=100, keep_labels=True),
+            OutputSequence(broker, f"preds-{name}", partition=0),
+            threshold=5.0)
+        scorer.score_available()
+        dt = time.perf_counter() - t0
+        auc = hist_auc(scorer.err_hist["true"],
+                       scorer.err_hist["false"])
+        out = {"records_per_sec": round(published / dt, 1),
+               "auc": None if auc is None else round(auc, 4),
+               "published": published}
+        if mqtt_path:
+            out["deferred"] = fleet.deferred_total
+            out["flap_buffered"] = fleet.flap_buffered_total
+        return out
+
+    scenarios = {
+        "rush-hour": scenario_pass("rush-hour", mqtt_path=True),
+        "flapping-links": scenario_pass("flapping-links",
+                                        mqtt_path=True),
+        "regional-drift": scenario_pass("regional-drift"),
+        "schema-mix": scenario_pass("schema-mix"),
+    }
+
+    return dict(
+        value=float(online["recover"] or POST_TICKS * CARS),
+        microbatch_records_to_recover=micro["recover"],
+        online_detect_records=online["detect"],
+        speedup_x=round(micro["recover"] / online["recover"], 2)
+        if online["recover"] and micro["recover"] else None,
+        auc_pre_drift=round(auc_pre, 4) if auc_pre else None,
+        online_auc_first_post=online["auc_first_post"],
+        online_auc_final=online["auc_final"],
+        microbatch_auc_final=micro["auc_final"],
+        online_windows=online["windows"],
+        microbatch_windows=micro["windows"],
+        online_train_records_per_sec=round(online_rps, 1),
+        microbatch_train_records_per_sec=round(micro_rps, 1),
+        throughput_ratio=round(ratio, 3),
+        scenarios=scenarios,
+        n_passes=1,
+        definition="records after the seeded drift until live "
+                   "detection AUC holds within 0.05 of the deployed "
+                   "model's pre-drift AUC for 2 consecutive 1000-"
+                   "record windows; online = incremental + drift-"
+                   "triggered adaptation, microbatch = "
+                   "ContinuousTrainer rounds through the registry")
+
+
 # ------------------------------------------------------ cluster saturation
 _CLUSTER_NODE_SRC = r"""
 import sys
@@ -2423,6 +2724,14 @@ def main():
         # measured percentage (ISSUE 7: async within 10% of off)
         ("train_ckpt_async_records_per_sec", "records/s",
          TRAIN_BASELINE_RPS),
+        # true online learning (iotml.online): records to recover
+        # detection AUC after a seeded regional drift — online
+        # (incremental + drift-triggered adaptation) vs the micro-batch
+        # ContinuousTrainer baseline, same model, byte-identical
+        # stream; plus the adversarial scenario suite's quality/rate
+        # passes and the incremental-throughput guard.  No reference
+        # twin (its README disclaims online learning), vs_baseline 0
+        ("online_adapt_records", "records", None),
         # the partitioned data plane's saturation knee at 3 brokers
         # (separate processes), vs the r05 single-LEADER platform knee
         # it exists to move; on >=8-core hosts scaling_x also shows the
@@ -2469,6 +2778,7 @@ def main():
         run("store_append_mb_per_sec", bench_store_log)
         run("twin_apply_records_per_sec", bench_twin)
         run("train_ckpt_async_records_per_sec", bench_checkpoint)
+        run("online_adapt_records", bench_online)
         try:
             run("cluster_saturation_records_per_sec",
                 bench_cluster_saturation)

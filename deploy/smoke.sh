@@ -21,6 +21,8 @@ JAX_PLATFORMS=cpu python -m iotml.chaos run --scenario rebalance-under-chaos \
   --seed 7 --records 500
 JAX_PLATFORMS=cpu python -m iotml.chaos run --scenario compaction-under-crash \
   --seed 7 --records 500
+JAX_PLATFORMS=cpu python -m iotml.chaos run --scenario drift-storm \
+  --seed 7 --records 2000
 
 echo "== 2/5 supervised restart: live scorer-crash drill (the scorer"
 echo "        thread dies twice; the supervisor must heal the pipeline)"
@@ -34,6 +36,11 @@ echo "==      live twin-rebuild drill (iotml.twin): kill the twin"
 echo "        service, rebuild from the compacted changelog, state"
 echo "        equals the pre-kill snapshot"
 JAX_PLATFORMS=cpu python -m iotml.twin drill --seed 7 --records 1500
+echo "==      live drift-adapt-swap drill (iotml.online): seeded"
+echo "        regional drift detected within the SLO, adaptation"
+echo "        published + hot-swapped, wrecked adaptation rolled back"
+JAX_PLATFORMS=cpu python -m iotml.online drill --seed 7 \
+  --slo-detect-records 1500
 
 echo "== 3/5 validate manifests against the codebase"
 python deploy/validate_manifests.py

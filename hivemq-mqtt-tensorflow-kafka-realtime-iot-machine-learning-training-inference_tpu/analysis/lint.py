@@ -116,7 +116,7 @@ CHAOS_ALLOWED_MODULES = frozenset({
     ("stream", "replica.py"), ("mqtt", "broker.py"),
     ("serve", "scorer.py"), ("train", "live.py"),
     ("mlops", "checkpoint.py"), ("mlops", "registry.py"),
-    ("store", "compact.py"),
+    ("store", "compact.py"), ("online", "learner.py"),
 })
 CHAOS_SHIM_MODULE = "faults"
 # Drill-harness modules outside chaos/supervise: live-drill peers of
@@ -125,6 +125,7 @@ CHAOS_SHIM_MODULE = "faults"
 CHAOS_HARNESS_MODULES = frozenset({
     ("mlops", "drill.py"), ("mlops", "__main__.py"),
     ("twin", "drill.py"), ("twin", "__main__.py"),
+    ("online", "drill.py"), ("online", "__main__.py"),
 })
 
 # R6 (naming): metric families and span/stage names are lowercase
@@ -172,6 +173,11 @@ RULES: Dict[str, str] = {
            "one writer: TwinService), or compaction rewrite machinery "
            "(compact_log / sweep_cleaned / a write on a .cleaned path) "
            "outside iotml/store/: compact via Broker.run_compaction",
+    "R13": "in-place .set_params(...) on a serving scorer outside "
+           "iotml/mlops/ & iotml/online/: model updates go THROUGH "
+           "the registry (versioning, rollback gate, swap metrics) — "
+           "a direct weight poke is an unversioned deploy nothing can "
+           "roll back",
 }
 
 # R12: the compacted twin-changelog topics whose produce is confined to
@@ -201,7 +207,10 @@ _REGISTRY_PATH_NAME_RE = re.compile(
     r"registry_dir|registry_root|version_dir|artifact_path"
     r"|manifest\.json|model_registry", re.IGNORECASE)
 
-_SUPPRESS_RE = re.compile(r"#\s*lint-ok:\s*(R\d)\b[ \t]*(.*)")
+# R\d+ not R\d: two-digit rules exist since R10, and the single-digit
+# form silently failed to parse their suppressions (the lint-ok line
+# then neither suppressed nor flagged-as-reasonless — it just lied)
+_SUPPRESS_RE = re.compile(r"#\s*lint-ok:\s*(R\d+)\b[ \t]*(.*)")
 _RETRY_OK_RE = re.compile(r"#\s*retry-ok:[ \t]*(.*)")
 _WALLCLOCK_RE = re.compile(r"#\s*wallclock-ok:[ \t]*(.*)")
 
@@ -465,6 +474,11 @@ class _FileLinter(ast.NodeVisitor):
         self.in_mlops = "mlops" in parts
         # R12 scoping: the twin package owns the CAR_TWIN changelog
         self.in_twin = "twin" in parts
+        # R13 scoping: the registry machinery (mlops watchers/rollouts)
+        # and the online learner's adaptation path are the two places a
+        # scorer's weights may legally be set in place — everything
+        # else deploys through the registry
+        self.r13_exempt = self.in_mlops or "online" in parts
         #: Thread(...) call nodes already seen as a register_thread(...)
         #: argument — outer calls visit before inner ones, so by the
         #: time visit_Call reaches the Thread node it is marked
@@ -753,6 +767,19 @@ class _FileLinter(ast.NodeVisitor):
                                "swap protocol (durable tmp + atomic "
                                "os.replace + mount-time sweep) is the "
                                "store's alone")
+
+        # R13 — model updates go through the registry: an in-place
+        # .set_params(...) on a serving scorer outside the mlops/online
+        # machinery is an unversioned deploy — no registry id, no
+        # rollback target, no swap metric, invisible to /healthz
+        if not self.r13_exempt and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "set_params":
+            self._emit("R13", node,
+                       ".set_params(...) on a scorer outside "
+                       "iotml/mlops/ & iotml/online/: publish the "
+                       "weights as a registry version and let a "
+                       "RegistryWatcher swap it (versioned, gated, "
+                       "rollback-able)")
 
         # R10 — broker instances are the cluster package's to build:
         # constructing a ShardBroker elsewhere bypasses the controller's

@@ -70,6 +70,10 @@ KNOWN_POINTS: Dict[str, str] = {
                           "mid-pass (stale tmp left, live segment intact, "
                           "a prefix of segments already swapped); delay = "
                           "slow disk",
+    "online.update": "online learner's per-window update loop: stall "
+                     "(delay) = a slow incremental step, crash (error) "
+                     "= the learner dies mid-stream and must resume "
+                     "from its committed cursor",
 }
 
 #: runner-orchestrated pseudo-points: process-level acts (killing a wire
@@ -108,6 +112,7 @@ POINT_ACTIONS: Dict[str, frozenset] = {
     "ckpt.write": frozenset({"error", "delay"}),
     "registry.commit": frozenset({"error", "delay"}),
     "store.compact_swap": frozenset({"error", "delay"}),
+    "online.update": frozenset({"error", "delay"}),
     "runner.kill_leader": frozenset({"kill_leader"}),
     "runner.crash_broker": frozenset({"crash_broker"}),
     "runner.kill_member": frozenset({"kill_member"}),

@@ -201,7 +201,8 @@ class ChaosRunner:
         # AND store topologies carry them (the durable log round-trips
         # headers in their transport byte form); only the wire topology
         # loses them at the TCP boundary by design
-        trace_inproc = self.schedule.topology in ("inproc", "store")
+        trace_inproc = self.schedule.topology in ("inproc", "store",
+                                                  "online")
         prev = (tracing.ENABLED, tracing._SAMPLE, tracing._PATH)
         span_path = self.span_path
         if trace_inproc:
@@ -222,6 +223,8 @@ class ChaosRunner:
                 report = self._run_cluster(eng)
             elif self.schedule.topology == "mlops":
                 report = self._run_mlops(eng)
+            elif self.schedule.topology == "online":
+                report = self._run_online(eng, span_path)
             else:
                 report = self._run_inproc(eng, span_path)
         finally:
@@ -317,6 +320,137 @@ class ChaosRunner:
             dropped_accounted=eng.dropped_count,
             injected=dict(sorted(eng.injected.items())),
             invariants=invariants, span_path=span_path)
+
+    # ------------------------------------------------------------ online
+    def _run_online(self, eng: faults.ChaosEngine,
+                    span_path: str) -> ChaosReport:
+        """drift-storm: regional drift + mqtt-flap concurrently, over
+        the full MQTT → bridge → convert → online-learner + scorer
+        pipeline with a live registry between them.
+
+        The drift half is seeded topology state (an AdversarialFleet
+        whose cohorts all shift at mid-stream); the schedule injects
+        the flap half at ``mqtt.deliver``.  Invariants: the learner
+        detects the drift and its adaptation CONVERGES, the adapted
+        model reaches the scorer through the registry (hot-swap), and
+        the swap costs nothing — every surviving record is scored
+        exactly once (scored_or_accounted + contiguous predictions +
+        monotonic commits across both consumer groups)."""
+        import shutil
+        import tempfile
+
+        from ..gen.scenarios import AdversarialFleet
+        from ..gen.scenarios import condition as fleet_condition
+        from ..gen.simulator import FleetScenario
+        from ..mlops import ModelRegistry, RegistryWatcher
+        from ..mqtt.bridge import KafkaBridge
+        from ..mqtt.broker import MqttBroker
+        from ..obs import tracing
+        from ..online.learner import OnlineLearner
+        from ..stream.broker import Broker
+        from ..stream.consumer import StreamConsumer
+
+        mqtt = MqttBroker()
+        stream = Broker()
+        commit_log: List[tuple] = []
+        _record_commits(stream, commit_log, "stream")
+        KafkaBridge(mqtt, stream, partitions=2)
+        from ..streamproc.tasks import JsonToAvro
+
+        task = JsonToAvro(stream, src="sensor-data", dst=IN_TOPIC,
+                          partitions=2)
+        parts = stream.topic(IN_TOPIC).partitions
+        ticks = max(1, -(-self.schedule.records // CARS_PER_TICK))
+        fleet = AdversarialFleet(
+            FleetScenario(num_cars=CARS_PER_TICK,
+                          seed=self.schedule.seed, failure_rate=0.02),
+            fleet_condition("drift-storm", drift_tick=ticks // 2))
+        root = tempfile.mkdtemp(prefix="iotml_chaos_online_")
+        try:
+            registry = ModelRegistry(root)
+            learner = OnlineLearner(stream, IN_TOPIC,
+                                    registry=registry,
+                                    group="chaos-online",
+                                    window=CARS_PER_TICK,
+                                    publish_every=8)
+            consumer = StreamConsumer(
+                stream, [f"{IN_TOPIC}:{p}:0" for p in range(parts)],
+                group=GROUP)
+            scorer = self._make_scorer(stream, consumer)
+            watcher = RegistryWatcher(registry, scorers=[scorer])
+
+            published = rewinds = 0
+
+            def drive_once():
+                nonlocal rewinds
+                try:
+                    task.process_available()
+                except ConnectionError:
+                    task.consumer.rewind_to_committed()
+                    rewinds += 1
+                learner.process_available()
+                learner.write_published()
+                watcher.poll_once()
+                try:
+                    return scorer.score_available()
+                except ConnectionError:
+                    consumer.rewind_to_committed()
+                    rewinds += 1
+                    return -1
+
+            for _ in range(ticks):
+                published += fleet.publish_mqtt(mqtt, n_ticks=1)
+                drive_once()
+                tracing.flush()
+            for _ in range(64):  # final drain
+                n = drive_once()
+                if n == 0 and consumer.at_end() \
+                        and task.consumer.at_end() \
+                        and learner.consumer.at_end():
+                    break
+            learner.write_published()
+            watcher.poll_once()
+            tracing.flush()
+
+            mon = learner.monitor
+            detections = [a for a in learner.adaptations]
+            latest = registry.latest()
+            invariants = [
+                Invariant(
+                    "drift_detected",
+                    mon.drifts >= 1 and bool(detections),
+                    f"{mon.drifts} drift episode(s) on the error "
+                    f"signal (adaptations: {detections[:4]})"),
+                Invariant(
+                    "adaptation_converged",
+                    mon.converged >= 1,
+                    f"{mon.converged} episode(s) converged "
+                    f"(state {mon.state!r}, baseline "
+                    f"{mon.baseline and round(mon.baseline, 4)})"),
+                Invariant(
+                    "adapted_model_swapped",
+                    latest is not None and latest >= 1
+                    and scorer.model_version == latest
+                    and watcher.swaps >= 1,
+                    f"scorer serving registry v{scorer.model_version} "
+                    f"== tip v{latest} after {watcher.swaps} hot-"
+                    f"swap(s) under the storm"),
+                _check_spans_accounted(span_path, eng.dropped_traces),
+                _check_counts(published, scorer.scored,
+                              eng.dropped_count),
+                _check_commits_monotonic(commit_log),
+                _check_predictions(stream, scorer.scored),
+                _check_final_commit(stream, IN_TOPIC, parts),
+            ]
+            return ChaosReport(
+                scenario=self.schedule.name, seed=self.schedule.seed,
+                records=self.schedule.records, topology="online",
+                published=published, scored=scorer.scored,
+                rewinds=rewinds, dropped_accounted=eng.dropped_count,
+                injected=dict(sorted(eng.injected.items())),
+                invariants=invariants, span_path=span_path)
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
 
     # ------------------------------------------------------------- store
     def _run_store(self, eng: faults.ChaosEngine,

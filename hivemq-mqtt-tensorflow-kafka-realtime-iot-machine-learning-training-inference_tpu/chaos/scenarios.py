@@ -47,6 +47,11 @@ Built-ins:
 - ``rollout-regression-rollback`` (mlops): a deliberately degraded
   candidate model is deployed to serving; the A/B quality gate must
   detect the live regression and roll serving back to the baseline.
+- ``drift-storm`` (online): seeded regional drift and flapping device
+  links CONCURRENTLY — the online learner must detect the drift on
+  its error signal, adapt and converge, the adapted model must
+  hot-swap the scorer fleet through the registry, and no record may
+  be lost or double-scored across the swap.
 """
 
 from __future__ import annotations
@@ -255,6 +260,27 @@ def _rollout_regression_rollback(rng: random.Random, records: int) -> list:
     return events
 
 
+def _drift_storm(rng: random.Random, records: int) -> list:
+    # seeded regional drift AND flapping device links CONCURRENTLY:
+    # the drift itself is runner-topology state (an AdversarialFleet
+    # with every cohort shifting at mid-stream, seeded by the schedule
+    # seed); the schedule carries the mqtt-flap half — delivery drops
+    # (accounted as intentional loss) plus short delay bursts landing
+    # while the online learner is mid-adaptation.  The runner proves
+    # the learner still detects, adapts, converges and publishes, the
+    # scorer fleet hot-swaps, and no record is lost or double-scored
+    # across the swap.
+    n_drops = max(2, records // 100)
+    hits = sorted(rng.sample(range(1, records + 1),
+                             min(n_drops, records)))
+    events = [FaultEvent(h, "mqtt.deliver", "drop") for h in hits]
+    for _ in range(2):
+        events.append(FaultEvent(rng.randint(1, max(2, records - 10)),
+                                 "mqtt.deliver", "delay",
+                                 params=(("seconds", 0.001),), repeat=5))
+    return events
+
+
 def _loss_bug_fixture(rng: random.Random, records: int) -> list:
     # the seeded bug: one delivery silently lost — NOT ledgered, so the
     # scored-or-accounted invariant must fail (the checker's own test)
@@ -318,6 +344,12 @@ SCENARIOS: Dict[str, Tuple[Callable, str, str]] = {
         "a degraded candidate model is deployed to serving; the A/B "
         "quality gate must detect the regression live and roll serving "
         "back to the baseline within the drill budget"),
+    "drift-storm": (
+        _drift_storm, "online",
+        "seeded regional drift + flapping links concurrently: the "
+        "online learner must detect and adapt, the adapted model must "
+        "hot-swap the scorer fleet, and no record is lost or double-"
+        "scored across the swap"),
 }
 
 

@@ -142,6 +142,30 @@ class MlopsConfig:
 
 
 @dataclasses.dataclass
+class OnlineConfig:
+    """True online learning (iotml.online): per-window incremental
+    updates with drift-triggered adaptation.
+
+    The learner itself is constructed explicitly (``python -m
+    iotml.online run`` or the drill); these knobs set its detector
+    thresholds and adaptation policy.  Detector deltas are unit-free
+    (the monitor normalizes the error signal by its own stable
+    baseline)."""
+
+    window: int = 100            # records per incremental SGD update
+    detector: str = "both"       # ph | adwin | both
+    ph_delta: float = 0.15       # Page-Hinkley drift allowance
+    ph_threshold: float = 2.5    # Page-Hinkley trip level (lambda)
+    adwin_delta: float = 0.002   # ADWIN cut confidence
+    adapt: str = "auto"          # boost | refit | reset | auto
+    lr_boost: float = 5.0        # LR multiplier while adapting
+    boost_updates: int = 80      # windows the boost stays active
+    refit_epochs: int = 2        # replay-buffer passes on "refit"
+    publish_every: int = 20      # windows between steady-state publishes
+    buffer_batches: int = 32     # replay-buffer depth (windows)
+
+
+@dataclasses.dataclass
 class Config:
     broker: BrokerConfig = dataclasses.field(default_factory=BrokerConfig)
     stream: StreamConfig = dataclasses.field(default_factory=StreamConfig)
@@ -152,6 +176,7 @@ class Config:
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     store: StoreConfig = dataclasses.field(default_factory=StoreConfig)
     mlops: MlopsConfig = dataclasses.field(default_factory=MlopsConfig)
+    online: OnlineConfig = dataclasses.field(default_factory=OnlineConfig)
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)

@@ -76,6 +76,10 @@ class RecordSchema:
     namespace: str
     fields: tuple  # tuple[Field, ...]
     label_field: Optional[str] = None  # name of the anomaly-label field, if any
+    #: non-sensor payload fields (e.g. the v2 writer's REGION cohort
+    #: tag): carried on the wire, excluded from the model's input
+    #: matrix exactly like the label
+    meta_fields: tuple = ()
 
     def avro_json(self) -> str:
         return json.dumps(
@@ -94,8 +98,11 @@ class RecordSchema:
 
     @property
     def sensor_fields(self):
-        """Fields that feed the model (everything except the label)."""
-        return tuple(f for f in self.fields if f.name != self.label_field)
+        """Fields that feed the model (everything except the label
+        and any meta fields)."""
+        return tuple(f for f in self.fields
+                     if f.name != self.label_field
+                     and f.name not in self.meta_fields)
 
     @property
     def num_sensors(self) -> int:
@@ -178,6 +185,48 @@ KSQL_CAR_SCHEMA = RecordSchema(
     ),
     label_field="FAILURE_OCCURRED",
 )
+
+# Writer-schema v2: the schema-evolution case a live fleet actually
+# produces — a new optional field (REGION, the regional-cohort tag)
+# added with a null default.  KSQL-style schema regeneration emits the
+# new column BEFORE the label it appends last, so a v1 reader that
+# decoded v2 bytes positionally would read REGION's union branch as
+# the label — exactly the mixed-version failure `ops.avro
+# .ResolvingCodec` resolves by name instead (Avro resolution rules:
+# reader fields match writer fields by NAME; reader-missing writer
+# fields are skipped, writer-missing reader fields take their
+# default).  v2 is a WRITER schema: the ML layer always reads through
+# the v1 reader projection, so REGION never reaches the model input.
+KSQL_CAR_SCHEMA_V2 = RecordSchema(
+    name="KsqlDataSourceSchema",
+    namespace="io.confluent.ksql.avro_schemas",
+    fields=tuple(
+        list(KSQL_CAR_SCHEMA.fields[:-1])
+        + [Field("REGION", "string", nullable=True,
+                 doc="fleet cohort region (added in writer schema v2)"),
+           Field("FAILURE_OCCURRED", "string", nullable=True)]
+    ),
+    label_field="FAILURE_OCCURRED",
+    meta_fields=("REGION",),
+)
+
+#: the evolved writer's frame id.  NOT 2: Confluent frame ids are
+#: registry-scoped, and the in-process SchemaRegistry allocates small
+#: ints from 1 — a CSAS output legitimately registered at id 2 must
+#: not be mistaken for (and mis-decoded as) car-schema v2.  The
+#: framework's evolved car schemas live in a reserved band the
+#: registry never allocates into (`stream.registry.RESERVED_ID_BASE`).
+CAR_SCHEMA_V2_ID = 1002
+
+#: Confluent-frame schema id → writer schema, for readers resolving a
+#: mixed-version topic (`ops.framing` carries the id on every message).
+WRITER_SCHEMAS = {1: KSQL_CAR_SCHEMA, CAR_SCHEMA_V2_ID: KSQL_CAR_SCHEMA_V2}
+
+#: human-facing writer version → (schema, frame id) — what
+#: ``JsonToAvro(schema_version=2)`` and the fleet's schema-mix
+#: condition write with
+WRITER_VERSIONS = {1: (KSQL_CAR_SCHEMA, 1),
+                   2: (KSQL_CAR_SCHEMA_V2, CAR_SCHEMA_V2_ID)}
 
 # Offline CSV fixture layout (reference testdata/car-sensor-data.csv):
 # header `time,car,<18 sensor columns in producer order>`.

@@ -30,7 +30,7 @@ from ..core.normalize import Normalizer, CAR_NORMALIZER
 from ..core.schema import KSQL_CAR_SCHEMA, RecordSchema
 from ..obs import metrics as obs_metrics
 from ..obs import tracing
-from ..ops.avro import AvroCodec
+from ..ops.avro import AvroCodec, needs_resolution
 from ..ops.framing import strip_frame
 from ..stream.consumer import StreamConsumer
 
@@ -143,6 +143,9 @@ class SensorBatches:
             maxlen=65536)
         self._seen_traces: set = set()
         self._seen_traces_cap = 65536
+        # Mixed-schema (evolution) decode path, built lazily on the
+        # first chunk that actually carries a non-v1 writer id
+        self._resolving = None
         # Native (C++) columnar decode when the engine is built; the pure
         # codec is the fallback and the test oracle.
         self._native = None
@@ -188,7 +191,14 @@ class SensorBatches:
                 getattr(self.consumer.broker, fused_attr, None) is not None:
             # Fully-native path: broker-side fetch + framing strip + Avro
             # decode in one C++ call (NativeKafkaBroker.fetch_decode) — no
-            # per-message Python objects.
+            # per-message Python objects.  LIMITATION: the C++ decoder
+            # blind-strips the Confluent frame (reference substr(5)
+            # parity), so this path pins writer-schema v1 — a topic
+            # carrying evolved (v2) frames must be consumed through a
+            # python-broker consumer, whose chunk-level needs_resolution
+            # routing below handles the mix.  Deployments enabling a v2
+            # writer do so topic-wide by configuration, so the two never
+            # meet by accident.
             while True:
                 res = self.consumer.poll_decoded(
                     self._native, strip=5, max_messages=self._poll_limit(),
@@ -231,7 +241,24 @@ class SensorBatches:
                 # [:63]: match the native path's stride-1 truncation
                 keys = np.asarray([(m.key or b"")[:63] for m in msgs],
                                   dtype="S64")
-            if self._native is not None:
+            if any(needs_resolution(m.value) for m in msgs):
+                # schema evolution on a live topic: at least one record
+                # in this chunk was written under a newer schema — the
+                # positional v1 decode (python AND native) would mis-
+                # read it, so the whole chunk takes the name-resolving
+                # path projected onto the reader schema.  Rare by
+                # construction (only during a fleet's rolling upgrade),
+                # so the fast paths stay untouched for v1-only chunks.
+                if self._resolving is None:
+                    from ..ops.avro import ResolvingCodec
+
+                    self._resolving = ResolvingCodec(self.schema)
+                cols = self._resolving.decode_batch_framed(
+                    [m.value for m in msgs])
+                num = self.codec.sensor_matrix(cols)
+                labels = cols[label_f] if label_f \
+                    else np.full((n,), "", object)
+            elif self._native is not None:
                 num, lab = self._native.decode_batch(
                     [m.value for m in msgs], strip=5)
                 labels = self._native_labels(lab, n)

@@ -1,1 +1,3 @@
 from .simulator import FleetScenario, FleetGenerator  # noqa: F401
+from .scenarios import (AdversarialFleet, FleetCondition,  # noqa: F401
+                        FLEET_CONDITIONS, condition)

@@ -72,7 +72,8 @@ class MqttBroker:
 
     def __init__(self, name: str = "iotml-mqtt",
                  offline_queue_limit: int = 1000,
-                 offline_session_expiry_s: float = 3600.0):
+                 offline_session_expiry_s: float = 3600.0,
+                 backpressure_hwm: Optional[int] = None):
         self.name = name
         self._sessions: Dict[str, Session] = {}
         self._tree = TopicTree()
@@ -89,6 +90,17 @@ class MqttBroker:
         self.offline_queue_limit = offline_queue_limit
         self.offline_session_expiry_s = offline_session_expiry_s
         self._next_offline_sweep = 0.0
+        # Backpressure: once any receiver queue (a reconnecting
+        # session's pending backlog or an offline session's queue)
+        # crosses the high-water mark, the broker raises a "slow down"
+        # signal cooperative publishers poll via `saturated()` —
+        # deferral at the SOURCE instead of drop-oldest at the limit.
+        # A publish that lands on a saturated queue counts into
+        # iotml_mqtt_backpressure_total either way, so non-cooperative
+        # fleets still show up on the dashboard before data is lost.
+        self.backpressure_hwm = backpressure_hwm if backpressure_hwm \
+            is not None else max(1, (offline_queue_limit * 4) // 5)
+        self._bp_sessions: set = set()
         # ONE consolidated timer for all pending delayed wills, armed for
         # the earliest due time (a timer thread per will would mean
         # thousands of stacks during a fleet-scale disconnect wave —
@@ -114,6 +126,36 @@ class MqttBroker:
             "QoS>=1 publishes buffered for offline persistent sessions")
         self._g_sessions = reg.gauge(
             "mqtt_sessions_overall_current", "live MQTT sessions")
+        self._m_backpressure = reg.counter(
+            "iotml_mqtt_backpressure_total",
+            "publishes that landed on a receiver queue at/over the "
+            "backpressure high-water mark (cooperative publishers "
+            "poll saturated() and defer instead)")
+
+    # ------------------------------------------------------ backpressure
+    def _note_queue_depth(self, cid: str, depth: int,
+                          count: bool = True) -> None:
+        """Track a receiver queue against the high-water mark (caller
+        holds _lock).  Crossing raises the saturation signal; draining
+        below it clears the signal.  ``count`` marks publish-path
+        updates (the ones the backpressure counter measures) —
+        connect/disconnect bookkeeping only moves the signal."""
+        if depth >= self.backpressure_hwm:
+            self._bp_sessions.add(cid)
+            if count:
+                self._m_backpressure.inc()
+        else:
+            self._bp_sessions.discard(cid)
+
+    def saturated(self) -> bool:
+        """The bounded-queue "slow down" signal: True while any
+        receiver queue sits at/over the backpressure high-water mark.
+        Cooperative publishers (`iotml.gen.scenarios` fleet agents, the
+        rush-hour burst drill) poll this between publishes and defer
+        into their own bounded buffer instead of pushing the broker's
+        queues into drop-oldest — deferral at the source is recoverable,
+        a dropped-oldest message is not."""
+        return bool(self._bp_sessions)
 
     # ---------------------------------------------------------- sessions
     def connect(self, client_id: str, deliver: DeliveryFn,
@@ -191,6 +233,7 @@ class MqttBroker:
             # backlog AND live publishes racing the CONNECT handshake (a
             # PUBLISH before CONNACK is a protocol violation)
             s.pending = pending
+            self._note_queue_depth(client_id, len(pending), count=False)
             self._sessions[client_id] = s
             self._g_sessions.set(len(self._sessions))
         # outside the lock: will fan-out must not stall the broker
@@ -219,6 +262,7 @@ class MqttBroker:
                 chunk = list(session.pending or [])
                 if not chunk:
                     session.pending = None  # live from here on
+                    self._bp_sessions.discard(session.client_id)
                     return n
             for topic, payload, qos, retain in chunk:
                 session.deliver(topic, payload, qos, retain)
@@ -236,6 +280,11 @@ class MqttBroker:
                     if session.pending[0] is chunk[ci]:
                         session.pending.pop(0)
                     ci += 1
+                # draining below the high-water mark releases the
+                # backpressure signal (deferred publishers resume)
+                self._note_queue_depth(session.client_id,
+                                       len(session.pending or ()),
+                                       count=False)
 
     def discard_will(self, session: Session) -> None:
         """Clean DISCONNECT received: the will must never be published
@@ -278,6 +327,10 @@ class MqttBroker:
                     will = None
                 if cur.clean_start:
                     self._tree.unsubscribe_all(client_id)
+                    # the session's queue dies with it: a saturated
+                    # clean-start client must not wedge the broker-wide
+                    # backpressure signal forever after leaving
+                    self._bp_sessions.discard(client_id)
                 else:
                     # persistent session goes offline: queue QoS≥1
                     # deliveries until it reconnects (bounded, drop-oldest)
@@ -287,6 +340,9 @@ class MqttBroker:
                     self._offline[client_id] = [
                         q, time.monotonic() + self.offline_session_expiry_s,
                         cur.qos2_inbound, delayed]
+                    # the deque bound may have clipped the carried-over
+                    # backlog; re-judge the signal on the real depth
+                    self._note_queue_depth(client_id, len(q), count=False)
                     if delayed is not None:
                         self._arm_will_timer(delayed[1])
                 self._g_sessions.set(len(self._sessions))
@@ -314,6 +370,7 @@ class MqttBroker:
         for cid in dead:
             del self._offline[cid]
             self._tree.unsubscribe_all(cid)
+            self._bp_sessions.discard(cid)
         return due_wills
 
     def _arm_will_timer(self, due_time: float) -> None:
@@ -461,6 +518,7 @@ class MqttBroker:
                     entry = self._offline.get(cid)
                     if entry is not None and eff >= 1:
                         entry[0].append((topic, payload, eff, False))
+                        self._note_queue_depth(cid, len(entry[0]))
                         queued += 1
                     continue
                 if sess.pending is not None:
@@ -468,6 +526,7 @@ class MqttBroker:
                     # backlog instead of jumping ahead of it (same bound as
                     # the offline queue: drop-oldest)
                     sess.pending.append((topic, payload, eff, False))
+                    self._note_queue_depth(cid, len(sess.pending))
                     if len(sess.pending) > self.offline_queue_limit:
                         del sess.pending[0]
                     else:

@@ -331,6 +331,43 @@ model_swaps = default_registry.counter(
 rollouts = default_registry.counter(
     "iotml_rollouts_total",
     "A/B rollout gate decisions, by outcome (promoted | rolled_back)")
+# true online learning (iotml.online): the per-window incremental
+# learner's own telemetry — update cadence, what the drift detectors
+# saw, which adaptation the policy chose, and whether adaptation
+# actually converged (the state machine's STABLE re-entry).  The LR
+# gauge makes a boost visible while it is active; the drift-stat gauge
+# is the Page-Hinkley statistic an operator alarms on BEFORE the
+# threshold trips.
+online_updates = default_registry.counter(
+    "iotml_online_updates_total",
+    "incremental (per-window) SGD updates applied by the online learner")
+online_drifts = default_registry.counter(
+    "iotml_online_drifts_total",
+    "drift episodes detected on the reconstruction-error signal, by "
+    "detector (ph | adwin | level)")
+online_adaptations = default_registry.counter(
+    "iotml_online_adaptations_total",
+    "drift-triggered adaptations applied, by action "
+    "(boost | refit | reset)")
+online_converged = default_registry.counter(
+    "iotml_online_converged_total",
+    "adaptation episodes that converged (smoothed error back inside "
+    "the stable band; the monitor re-anchored its baseline)")
+online_lr = default_registry.gauge(
+    "iotml_online_learning_rate",
+    "the online learner's current effective learning rate (boosted "
+    "while a drift adaptation is active)")
+online_drift_stat = default_registry.gauge(
+    "iotml_online_drift_stat",
+    "current Page-Hinkley statistic over the normalized smoothed "
+    "error (drift fires when it crosses the configured threshold)")
+# adversarial fleet conditions (iotml.gen.scenarios): agents that
+# respected an MQTT backpressure signal defer records into their own
+# bounded buffer instead of letting the broker drop-oldest
+fleet_deferred = default_registry.counter(
+    "iotml_fleet_deferred_total",
+    "fleet-agent publishes deferred under MQTT backpressure (drained "
+    "on later ticks — deferred, never dropped)")
 # dead-letter queue (streamproc.dlq): poisoned frames routed, by source
 dlq_total = default_registry.counter(
     "iotml_dlq_total",

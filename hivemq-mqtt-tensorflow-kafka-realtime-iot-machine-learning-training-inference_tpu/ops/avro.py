@@ -160,3 +160,113 @@ class AvroCodec:
         in-graph (cardata-v3.py:150-168)."""
         names = [f.name for f in self.schema.sensor_fields]
         return np.stack([cols[n].astype(dtype) for n in names], axis=1)
+
+
+# ------------------------------------------------------ schema evolution
+#: the Confluent frame header every pre-evolution payload carries
+#: (magic 0 + schema id 1, `ops.framing`)
+_V1_HEADER = b"\x00\x00\x00\x00\x01"
+
+
+def needs_resolution(value: bytes) -> bool:
+    """True for a well-formed Confluent frame whose writer id is a
+    KNOWN non-default schema (schema evolution on a live topic) —
+    the cheap prefix test readers use to route a chunk through the
+    resolving decode path.  Unknown ids and non-Confluent payloads
+    return False: their legacy failure mode (DLQ downstream) must not
+    change."""
+    if len(value) < 5 or value[:5] == _V1_HEADER or value[0] != 0:
+        return False
+    return int.from_bytes(value[1:5], "big") in _writer_schemas()
+
+
+def _writer_schemas():
+    """Module-cached WRITER_SCHEMAS (needs_resolution runs per message
+    in the hot decode loop — an import statement there would pay a
+    sys.modules lookup per record)."""
+    global _WRITER_SCHEMAS
+    if _WRITER_SCHEMAS is None:
+        from ..core.schema import WRITER_SCHEMAS
+
+        _WRITER_SCHEMAS = WRITER_SCHEMAS
+    return _WRITER_SCHEMAS
+
+
+_WRITER_SCHEMAS = None
+
+
+def resolve_record(rec: dict, reader: RecordSchema) -> dict:
+    """Avro schema-resolution projection, name-based (spec §"Schema
+    Resolution"): reader fields take the writer's value when the writer
+    had the field; writer-only fields are dropped; reader fields the
+    writer lacks take their default (null for the nullable unions this
+    framework uses — a required reader field missing from the writer is
+    an incompatible evolution and raises)."""
+    out = {}
+    for f in reader.fields:
+        if f.name in rec:
+            out[f.name] = rec[f.name]
+        elif f.nullable:
+            out[f.name] = None
+        else:
+            raise ValueError(
+                f"incompatible schema evolution: required reader field "
+                f"{f.name!r} missing from writer record")
+    return out
+
+
+class ResolvingCodec:
+    """Schema-id-dispatching decoder for mixed-version topics.
+
+    A live topic under rolling fleet upgrades holds v1 AND v2 framed
+    payloads side by side; each message's Confluent frame names its
+    WRITER schema.  This codec decodes every message with its writer's
+    codec and projects the record onto the fixed READER schema (the
+    ML layer's v1 view), implementing the subset of Avro schema
+    resolution the nullable-union car schemas need.  Positional v1
+    decode of v2 bytes — the failure mode this replaces — previously
+    dead-lettered (or worse, silently mis-read) every v2 chunk.
+    """
+
+    def __init__(self, reader: RecordSchema, writers=None):
+        from ..core.schema import WRITER_SCHEMAS
+
+        self.reader = reader
+        self.writers = {}
+        for sid, schema in (writers or WRITER_SCHEMAS).items():
+            self.writers[sid] = (schema, AvroCodec(schema))
+
+    def decode_framed(self, message: bytes) -> dict:
+        """One framed message → a record in the READER's fields."""
+        from .framing import unframe
+
+        sid, payload = unframe(message)
+        entry = self.writers.get(sid)
+        if entry is None:
+            raise ValueError(f"unknown writer schema id {sid}")
+        schema, codec = entry
+        rec = codec.decode(payload)
+        if schema is self.reader:
+            return rec
+        return resolve_record(rec, self.reader)
+
+    def decode_batch_framed(self, messages: List[bytes],
+                            null_fill=0.0) -> dict:
+        """Mixed-version batch → reader-schema columns (the
+        ``AvroCodec.decode_batch`` contract, resolution included)."""
+        n = len(messages)
+        cols = {}
+        for f in self.reader.fields:
+            if f.avro_type in ("string", "bytes"):
+                cols[f.name] = np.empty((n,), object)
+            else:
+                cols[f.name] = np.zeros((n,), f.np_dtype)
+        for i, msg in enumerate(messages):
+            rec = self.decode_framed(msg)
+            for f in self.reader.fields:
+                v = rec[f.name]
+                if v is None:
+                    v = "" if f.avro_type in ("string", "bytes") \
+                        else null_fill
+                cols[f.name][i] = v
+        return cols

@@ -116,6 +116,8 @@ class LiveScorer:
             local = os.path.join(tmp, "model.h5")
             self.store.download(artifact, local)
             params = autoencoder_params_from_h5(local)
+        # lint-ok: R13 legacy artifact-store pointer flow (pre-registry
+        # deployments); registry-backed LiveScorers swap via _watcher
         self.scorer.set_params(params)
         self._current_artifact = artifact
         self.model_updates += 1

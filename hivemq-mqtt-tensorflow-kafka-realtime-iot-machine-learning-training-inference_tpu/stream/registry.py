@@ -81,6 +81,14 @@ class RegisteredSchema:
         return parse_avsc(self.avsc)
 
 
+#: ids at/above this are reserved for framework-pinned writer schemas
+#: (the schema-evolution band, `core.schema.WRITER_SCHEMAS` — e.g.
+#: car-schema v2 at 1002): the registry never allocates into it, so an
+#: evolved-schema frame id can never collide with a subject this
+#: registry assigned
+RESERVED_ID_BASE = 1000
+
+
 class SchemaRegistry:
     """Subjects → versioned schemas with global ids (thread-safe)."""
 
@@ -109,6 +117,11 @@ class SchemaRegistry:
                         return sid
             else:
                 sid = self._next_id
+                if sid >= RESERVED_ID_BASE:
+                    raise RuntimeError(
+                        f"schema id space exhausted at the reserved "
+                        f"band ({RESERVED_ID_BASE}): this registry "
+                        f"allocated {sid - 1} distinct schemas")
                 self._next_id += 1
                 self._fp_to_id[fp] = sid
             rs = RegisteredSchema(schema_id=sid, subject=subject,

@@ -33,8 +33,9 @@ from collections import Counter
 from struct import error as struct_error
 from typing import Callable, Dict, List, Optional, Tuple
 
-from ..core.schema import Field, RecordSchema
-from ..ops.avro import AvroCodec, zigzag_encode
+from ..core.schema import WRITER_SCHEMAS, Field, RecordSchema
+from ..ops.avro import (AvroCodec, needs_resolution, resolve_record,
+                        zigzag_encode)
 from ..ops.framing import frame, unframe
 from ..stream.broker import Broker, Message, OffsetOutOfRangeError
 from ..stream.registry import SchemaRegistry, subject_for_topic
@@ -518,11 +519,47 @@ def _decode_batch(meta: SourceMeta, codec: Optional[AvroCodec],
                   native: Optional[_NativeAvroSource],
                   messages) -> list:
     """→ list[Optional[dict]] aligned with messages (None = poisoned)."""
-    if native is not None:
+    if native is not None and \
+            not any(needs_resolution(m.value) for m in messages):
+        # a newer-writer record in the batch forces the python path:
+        # the native decoder is positional against ONE schema and would
+        # silently mis-read an evolved payload, not error on it
         recs = native.decode(messages)
         if recs is not None:
             return recs
     return [_decode_record(meta, codec, m) for m in messages]
+
+
+#: writer codecs for the resolving AVRO decode, built on first use
+_WRITER_CODECS: Dict[int, AvroCodec] = {}
+
+
+def _resolving_decode(sid: int, payload: bytes,
+                      codec: AvroCodec) -> Optional[dict]:
+    """Schema-evolution decode: when the frame names a KNOWN newer
+    writer whose field space covers this source's reader columns,
+    decode with the WRITER's layout and project by name onto the
+    reader (Avro schema resolution).  Returns None when not applicable
+    — an id collision from an unrelated registry subject, or a reader
+    the writer cannot satisfy — so the caller keeps the legacy
+    positional decode (and its DLQ failure mode) for those."""
+    # id 1 is the DEFAULT frame id — every in-process registry subject
+    # (arbitrary SQL-declared schemas included) starts there, so it
+    # identifies nothing; only the non-default KNOWN writer ids mark an
+    # evolved car-schema payload
+    if sid == 1:
+        return None
+    ws = WRITER_SCHEMAS.get(sid)
+    if ws is None or ws.fields == codec.schema.fields:
+        return None
+    writer_names = {f.name for f in ws.fields}
+    if any(f.name not in writer_names and not f.nullable
+           for f in codec.schema.fields):
+        return None
+    wcodec = _WRITER_CODECS.get(sid)
+    if wcodec is None:
+        wcodec = _WRITER_CODECS[sid] = AvroCodec(ws)
+    return resolve_record(wcodec.decode(payload), codec.schema)
 
 
 def _decode_record(meta: SourceMeta, codec: Optional[AvroCodec],
@@ -539,8 +576,14 @@ def _decode_record(meta: SourceMeta, codec: Optional[AvroCodec],
         rec = {k.upper(): v for k, v in obj.items()}
     elif meta.value_format == "AVRO":
         try:
-            _, payload = unframe(m.value)
-            rec = codec.decode(payload)
+            sid, payload = unframe(m.value)
+            # mixed-version topic: a record written under a newer known
+            # schema resolves against this source's reader instead of
+            # mis-decoding positionally (and failing the chunk into the
+            # DLQ — or worse, silently reading the wrong field)
+            rec = _resolving_decode(sid, payload, codec)
+            if rec is None:
+                rec = codec.decode(payload)
         except (ValueError, IndexError, struct_error):
             return None
     elif meta.value_format == "DELIMITED":

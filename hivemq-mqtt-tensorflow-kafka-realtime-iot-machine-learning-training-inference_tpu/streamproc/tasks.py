@@ -127,18 +127,35 @@ class JsonToAvro(StreamTask):
     Field matching is case-insensitive and accepts both producer names
     (`tire_pressure_1_1`) and KSQL names (`TIRE_PRESSURE11`), mirroring
     KSQL's case-insensitive column resolution.
+
+    ``schema_version=2`` writes the evolved schema (REGION cohort tag,
+    `core.schema.KSQL_CAR_SCHEMA_V2`) framed under its own id — the
+    rolling-upgrade shape where SOME converter instances emit the new
+    schema onto the live topic while v1 readers keep consuming it
+    through Avro schema resolution (`ops.avro.ResolvingCodec`).
     """
 
     def __init__(self, broker: Broker, src: str = "sensor-data",
-                 dst: str = "SENSOR_DATA_S_AVRO", **kw):
+                 dst: str = "SENSOR_DATA_S_AVRO",
+                 schema_version: int = 1, **kw):
         super().__init__(broker, src, dst, **kw)
-        self.codec = AvroCodec(KSQL_CAR_SCHEMA)
+        from ..core.schema import WRITER_VERSIONS
+
+        if schema_version not in WRITER_VERSIONS:
+            raise ValueError(f"unknown writer schema version "
+                             f"{schema_version} "
+                             f"(have: {sorted(WRITER_VERSIONS)})")
+        self.schema, self.schema_id = WRITER_VERSIONS[schema_version]
+        self.codec = AvroCodec(self.schema)
         # lookup: lowercase alias → KSQL field name
         self._alias: Dict[str, str] = {}
-        for f_prod, f_ksql in zip(CAR_SCHEMA.fields, KSQL_CAR_SCHEMA.sensor_fields):
+        for f_prod, f_ksql in zip(CAR_SCHEMA.fields,
+                                  self.schema.sensor_fields):
             self._alias[f_prod.name.lower()] = f_ksql.name
             self._alias[f_ksql.name.lower()] = f_ksql.name
         self._alias["failure_occurred"] = "FAILURE_OCCURRED"
+        for name in self.schema.meta_fields:  # v2: region → REGION
+            self._alias[name.lower()] = name
 
     def process(self, messages):
         out = []
@@ -153,7 +170,7 @@ class JsonToAvro(StreamTask):
                     name = self._alias.get(k.lower())
                     if name is None:
                         continue
-                    f = KSQL_CAR_SCHEMA.field(name)
+                    f = self.schema.field(name)
                     if v is None:
                         rec[name] = None
                     elif f.avro_type in ("int", "long"):
@@ -162,7 +179,7 @@ class JsonToAvro(StreamTask):
                         rec[name] = str(v)
                     else:
                         rec[name] = float(v)
-                val = frame(self.codec.encode(rec))
+                val = frame(self.codec.encode(rec), self.schema_id)
             except (ValueError, TypeError, KeyError) as e:
                 # poisoned sensor JSON used to HALT the whole chunk
                 # (json.loads raised out of process_available); now it

@@ -66,8 +66,12 @@ class DrillReport:
                f"records={self.records} published={self.published} "
                f"scored={self.scored}"]
         for k, v in sorted(self.slos.items()):
+            # keys ending in _s are wall-clock seconds; others are
+            # record counts / quality numbers (the online drill's
+            # record-based SLOs) and carry no unit suffix
+            unit = "s" if k.endswith("_s") else ""
             out.append(f"  slo {k}: "
-                       + ("n/a" if v is None else f"{v:.3f}s"))
+                       + ("n/a" if v is None else f"{v:.3f}{unit}"))
         for k, v in sorted(self.restarts.items()):
             out.append(f"  restarts {k}: {v}")
         for k, v in sorted(self.injected.items()):

@@ -32,6 +32,28 @@ from .artifacts import ArtifactStore
 from .loop import Trainer
 
 
+def commit_manifest_offsets(broker, group: str, manifest) -> None:
+    """Commit a durable manifest's stamped offsets for ``group``,
+    FORWARD-ONLY and commit_many-batched — the shared post-durability
+    half of offsets-as-checkpoint (``committed <= newest-durable-
+    manifest`` at every instant).  Runs on the checkpoint-writer
+    thread for both the micro-batch ``ContinuousTrainer`` and the
+    per-window ``iotml.online`` learner, so the two training modes
+    keep ONE crash-consistency story."""
+    by_topic: dict = {}
+    for t, p, off in manifest.offsets:
+        cur = broker.committed(group, t, p)
+        if cur is None or off > cur:
+            by_topic.setdefault(t, []).append((p, off))
+    commit_many = getattr(broker, "commit_many", None)
+    for t, entries in by_topic.items():
+        if commit_many is not None:
+            commit_many(group, t, entries)
+        else:
+            for p, off in entries:
+                broker.commit(group, t, p, off)
+
+
 class ContinuousTrainer:
     """Round-based continuous training → versioned artifacts + pointer.
 
@@ -236,23 +258,10 @@ class ContinuousTrainer:
 
     def _commit_checkpointed(self, manifest) -> None:
         """The writer's post-durability hook: commit the manifest's
-        stamped offsets for this group, FORWARD-ONLY.  Runs on the
-        checkpoint-writer thread after publication, so committed <=
-        newest-durable-manifest offsets at every instant — the crash-
-        consistency edge the warm start relies on.  A skipped (dropped)
-        snapshot just means the next one commits further ahead."""
-        by_topic: dict = {}
-        for t, p, off in manifest.offsets:
-            cur = self.broker.committed(self.group, t, p)
-            if cur is None or off > cur:
-                by_topic.setdefault(t, []).append((p, off))
-        commit_many = getattr(self.broker, "commit_many", None)
-        for t, entries in by_topic.items():
-            if commit_many is not None:
-                commit_many(self.group, t, entries)
-            else:
-                for p, off in entries:
-                    self.broker.commit(self.group, t, p, off)
+        stamped offsets for this group, FORWARD-ONLY (see
+        ``commit_manifest_offsets``).  A skipped (dropped) snapshot
+        just means the next one commits further ahead."""
+        commit_manifest_offsets(self.broker, self.group, manifest)
 
     def close(self, timeout_s: float = 30.0) -> None:
         """Flush pending checkpoints and stop an owned writer thread."""

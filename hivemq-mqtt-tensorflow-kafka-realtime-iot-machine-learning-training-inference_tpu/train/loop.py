@@ -176,6 +176,21 @@ def adam_cached(learning_rate: float) -> optax.GradientTransformation:
                     lambda: optax.adam(learning_rate))
 
 
+def adam_injectable_cached(learning_rate: float
+                           ) -> optax.GradientTransformation:
+    """Adam with RUNTIME-mutable hyperparameters (optax
+    inject_hyperparams): the learning rate lives in ``opt_state
+    .hyperparams`` as a traced array, so the online learner's
+    drift-triggered LR boost is an opt_state edit — no retrace, no
+    recompile, same jitted step.  Cached per initial rate for the same
+    compile-cache reason as ``adam_cached`` (the tx object's identity
+    keys the jit caches)."""
+    return _lru_get(
+        _INIT_CACHE, ("adam-inject-tx", learning_rate),
+        lambda: optax.inject_hyperparams(optax.adam)(
+            learning_rate=learning_rate))
+
+
 def jitted_state_init(model, tx, tx_key=None):
     """jit-compiled (params, opt_state) init, cached per (model, tx)."""
     key = (model, tx_key if tx_key is not None else id(tx))
@@ -195,6 +210,35 @@ def scanned_fit_cached(model, tx, supervised: bool, tx_key=None):
     key = (model, tx_key if tx_key is not None else id(tx), supervised)
     return _lru_get(_SCANNED_CACHE, key,
                     lambda: make_scanned_fit(model, tx, supervised))
+
+
+def make_scanned_window_steps(model, tx, supervised: bool = False):
+    """K sequential SGD updates as ONE device program (lax.scan),
+    returning the per-window pre-update losses — the online learner's
+    catch-up path.  Numerically identical to K single steps; what
+    changes is dispatch: one jit call + one host→device transfer per
+    GROUP instead of per window, which is the difference between the
+    incremental mode meeting its throughput SLO and not (measured:
+    0.62× → >1× of micro-batch train rate at K=8).  The per-window
+    loss vector keeps drift detection at window granularity even
+    through a fused group."""
+    raw = make_raw_train_step(model, tx, supervised)
+
+    def run(state: TrainState, xs, masks):
+        def step(st, inp):
+            x, m = inp
+            st, metrics = raw(st, x, x, m)
+            return st, metrics["loss"]
+
+        return jax.lax.scan(step, state, (xs, masks))
+
+    return jax.jit(run, donate_argnums=(0,))
+
+
+def scanned_window_steps_cached(model, tx, tx_key=None):
+    key = (model, tx_key if tx_key is not None else id(tx), "winscan")
+    return _lru_get(_SCANNED_CACHE, key,
+                    lambda: make_scanned_window_steps(model, tx))
 
 
 def make_eval_step(model, supervised: bool = False):

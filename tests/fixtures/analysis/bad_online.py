@@ -1,0 +1,36 @@
+"""R13 fixture: in-place model updates bypassing the registry — a
+direct ``set_params`` poke on a serving scorer (1 finding) and the
+same poke buried in a helper (1 finding) — plus the clean shapes: a
+registry publish + watcher swap (the sanctioned path), an unrelated
+``set_params``-free call, and a justified suppression (0 findings)."""
+
+
+def hot_patch_scorer(scorer, params):
+    # flagged: an unversioned deploy — no registry id, no rollback
+    # target, no swap metric; /healthz keeps reporting the old version
+    scorer.set_params(params)
+
+
+def sneaky_patch(fleet, params):
+    # flagged: same breach, fanned across a fleet by hand
+    for member in fleet.members:
+        member.scorer.set_params(params, version=None)
+
+
+def sanctioned_deploy(registry, params_to_h5_bytes, params):
+    # the one path: publish a version; attached watchers swap it with
+    # version identity, gate protection and metrics
+    m = registry.publish({"model.h5": params_to_h5_bytes(params)})
+    registry.promote(m.version)
+    return m.version
+
+
+def unrelated_call_is_fine(estimator, grid):
+    # a set_params-free API on some other object: no finding
+    return estimator.configure(grid)
+
+
+def justified(scorer, params):
+    # lint-ok: R13 test harness pins swap mechanics against a scorer
+    # it owns; nothing is serving
+    scorer.set_params(params)

@@ -84,3 +84,76 @@ def test_decode_batch_columnar():
     assert mat.shape == (3, 18)
     # column order is schema order
     assert mat[0, 0] == cols["COOLANT_TEMP"][0]
+
+
+# ---------------------------------------------------- schema evolution
+def test_v2_writer_resolves_against_v1_reader():
+    """Writer-schema v2 (REGION added BEFORE the label — the KSQL
+    regeneration shape) must resolve by NAME against the v1 reader;
+    the positional decode this replaces reads REGION's bytes as the
+    label."""
+    from iotml.core.schema import (CAR_SCHEMA_V2_ID,
+                                  KSQL_CAR_SCHEMA_V2, WRITER_SCHEMAS)
+    from iotml.ops.avro import (ResolvingCodec, needs_resolution,
+                                resolve_record)
+
+    assert WRITER_SCHEMAS[1] is KSQL_CAR_SCHEMA
+    assert WRITER_SCHEMAS[CAR_SCHEMA_V2_ID] is KSQL_CAR_SCHEMA_V2
+    # v2 = v1 + REGION, label still last, REGION excluded from sensors
+    assert KSQL_CAR_SCHEMA_V2.num_sensors == KSQL_CAR_SCHEMA.num_sensors
+    assert KSQL_CAR_SCHEMA_V2.field_names[-2:] == ("REGION",
+                                                   "FAILURE_OCCURRED")
+
+    rec = _sample_record(KSQL_CAR_SCHEMA_V2, label="true")
+    rec["REGION"] = "region-3"
+    v2 = AvroCodec(KSQL_CAR_SCHEMA_V2)
+    framed = frame(v2.encode(rec), CAR_SCHEMA_V2_ID)
+    assert needs_resolution(framed)
+    assert not needs_resolution(frame(b"x", 1))
+    assert not needs_resolution(frame(b"x", 99))   # unknown id: legacy
+    assert not needs_resolution(b"\x01\x00\x00\x00\x02rest")  # bad magic
+
+    # positional v1 decode mis-reads: the label comes back as REGION
+    positional = AvroCodec(KSQL_CAR_SCHEMA).decode(framed[5:])
+    assert positional["FAILURE_OCCURRED"] == "region-3"
+    # the resolving decode projects by name: label correct, REGION gone
+    resolved = ResolvingCodec(KSQL_CAR_SCHEMA).decode_framed(framed)
+    assert resolved["FAILURE_OCCURRED"] == "true"
+    assert "REGION" not in resolved
+    assert resolved["SPEED"] == rec["SPEED"]
+
+    # a v1 record read through a v2 reader takes the null default
+    v1_framed = frame(AvroCodec(KSQL_CAR_SCHEMA).encode(
+        _sample_record(KSQL_CAR_SCHEMA)), 1)
+    up = ResolvingCodec(KSQL_CAR_SCHEMA_V2).decode_framed(v1_framed)
+    assert up["REGION"] is None
+
+    # incompatible evolution fails loudly: required reader field the
+    # writer never had
+    from iotml.core.schema import Field, RecordSchema
+
+    strict = RecordSchema("R", "ns", (Field("MISSING", "double"),))
+    with pytest.raises(ValueError):
+        resolve_record({"SPEED": 1.0}, strict)
+
+
+def test_resolving_codec_batch_and_unknown_id():
+    from iotml.core.schema import CAR_SCHEMA_V2_ID, KSQL_CAR_SCHEMA_V2
+    from iotml.ops.avro import ResolvingCodec
+
+    v1 = AvroCodec(KSQL_CAR_SCHEMA)
+    v2 = AvroCodec(KSQL_CAR_SCHEMA_V2)
+    msgs = []
+    for i in range(6):
+        rec = _sample_record(KSQL_CAR_SCHEMA, label="false")
+        if i % 2:
+            rec = dict(rec, REGION=f"region-{i}")
+            msgs.append(frame(v2.encode(rec), CAR_SCHEMA_V2_ID))
+        else:
+            msgs.append(frame(v1.encode(rec), 1))
+    rc = ResolvingCodec(KSQL_CAR_SCHEMA)
+    cols = rc.decode_batch_framed(msgs)
+    assert cols["SPEED"].shape == (6,)
+    assert set(cols["FAILURE_OCCURRED"].tolist()) == {"false"}
+    with pytest.raises(ValueError):
+        rc.decode_framed(frame(b"junk", 42))

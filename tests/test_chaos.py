@@ -374,6 +374,35 @@ def test_rebalance_under_chaos_scenario_holds_the_invariants():
     assert by_name["shard_failover_one_shard_only"].ok
 
 
+def test_drift_storm_registry_and_topology():
+    """The online topology's schedule: flap events riding beside the
+    runner-seeded regional drift, deterministic and online-routed (the
+    full run is CI's online.yml drill + the slow marker below)."""
+    sched = build("drift-storm", seed=7, records=2000)
+    assert sched.topology == "online"
+    drops = [e for e in sched.events
+             if e.point == "mqtt.deliver" and e.action == "drop"]
+    assert len(drops) >= 2
+    assert build("drift-storm", seed=7, records=2000).text() \
+        == sched.text()
+
+
+@pytest.mark.slow
+def test_drift_storm_scenario_holds_the_invariants(tmp_path):
+    """The online topology end to end: regional drift + mqtt-flap
+    concurrently; the learner detects/adapts/converges, the adapted
+    model hot-swaps the scorer, drops are accounted, nothing is lost
+    or double-scored across the swap."""
+    report = _run("drift-storm", records=2000, tmp_path=tmp_path)
+    assert report.ok, _failed(report)
+    assert report.topology == "online"
+    assert report.dropped_accounted > 0
+    by_name = {i.name: i for i in report.invariants}
+    assert by_name["drift_detected"].ok
+    assert by_name["adaptation_converged"].ok
+    assert by_name["adapted_model_swapped"].ok
+
+
 def test_loss_bug_fixture_fails_the_checker(tmp_path):
     """The checker checked: a committed-then-silently-dropped record
     (the seeded unledgered drop) must FAIL, naming the lost trace."""
