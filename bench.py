@@ -296,6 +296,107 @@ def bench_store_log():
                 n_passes=len(walls))
 
 
+def bench_pipeline():
+    """Zero-copy columnar data plane (ISSUE 10): the consume path's
+    decode rate through its three legs over the SAME durable topic —
+
+      python:   pure-codec decode of fetched Message lists (the oracle
+                path; per-record Python objects everywhere),
+      fused:    native batch Avro decode of fetched Message lists (the
+                pre-ISSUE-10 fast path: per-record Message objects, one
+                C decode call per chunk),
+      columnar: raw frame batches (Broker.fetch_raw) decoded by the ONE
+                FrameDecoder straight into ring buffers (zero
+                per-record Python objects end to end),
+
+    plus the wire leg (RAW_FETCH through a KafkaWireServer) — the
+    host-pipeline ceiling the e2e saturation knee inherits.  Reported:
+    records/s and decode MB/s per leg, and the columnar/python speedup
+    the acceptance gate reads (target >= 2x)."""
+    import shutil
+    import tempfile
+
+    from iotml.data.dataset import SensorBatches
+    from iotml.stream.broker import Broker
+    from iotml.stream.consumer import StreamConsumer
+
+    n_records = int(os.environ.get("IOTML_BENCH_PIPELINE_RECORDS",
+                                   "20000"))
+    d = tempfile.mkdtemp(prefix="iotml_bench_pipeline_")
+    try:
+        broker = Broker(store_dir=d)
+        _fill_broker(broker, n_records, num_cars=100)
+        total = broker.end_offset("SENSOR_DATA_S_AVRO", 0)
+        sample = broker.fetch("SENSOR_DATA_S_AVRO", 0, 0, 4096)
+        payload_mb = (sum(len(m.value) for m in sample)
+                      / max(len(sample), 1)) * total / 1e6
+
+        def drain(mode: str) -> float:
+            consumer = StreamConsumer(broker,
+                                      ["SENSOR_DATA_S_AVRO:0:0"],
+                                      group=f"bench-{mode}")
+            sb = SensorBatches(consumer, batch_size=100,
+                               keep_labels=True, poll_chunk=4096)
+            if mode == "python":
+                sb._native = None
+                sb._ring = False
+            elif mode == "fused":
+                sb._ring = False  # native decode over Message lists
+            t0 = time.perf_counter()
+            rows = sum(b.n_valid for b in sb)
+            wall = time.perf_counter() - t0
+            assert rows == total, (mode, rows, total)
+            if mode == "columnar":
+                assert sb._ring not in (None, False), \
+                    "columnar path did not engage"
+            return wall
+
+        def drain_wire() -> float:
+            from iotml.stream.kafka_wire import (KafkaWireBroker,
+                                                 KafkaWireServer)
+
+            with KafkaWireServer(broker) as srv:
+                wb = KafkaWireBroker(f"127.0.0.1:{srv.port}")
+                consumer = StreamConsumer(wb, ["SENSOR_DATA_S_AVRO:0:0"],
+                                          group="bench-wire")
+                sb = SensorBatches(consumer, batch_size=100,
+                                   keep_labels=True, poll_chunk=4096)
+                t0 = time.perf_counter()
+                rows = sum(b.n_valid for b in sb)
+                wall = time.perf_counter() - t0
+                assert rows == total
+                assert sb._ring not in (None, False)
+                wb.close()
+                return wall
+
+        legs = {}
+        for mode in ("python", "fused", "columnar"):
+            drain(mode)  # warm (page cache, ring alloc, codec builds)
+            walls = [drain(mode) for _ in range(max(3, PASSES // 2))]
+            legs[mode], _ = _percentiles(walls)
+        drain_wire()
+        wire_walls = [drain_wire() for _ in range(3)]
+        legs["wire_columnar"], _ = _percentiles(wire_walls)
+        broker.close()
+        rps = {m: total / w for m, w in legs.items()}
+        return dict(
+            value=rps["columnar"],
+            python_records_per_sec=round(rps["python"], 1),
+            fused_records_per_sec=round(rps["fused"], 1),
+            wire_columnar_records_per_sec=round(rps["wire_columnar"], 1),
+            speedup_vs_python=round(rps["columnar"] / rps["python"], 2),
+            speedup_vs_fused=round(rps["columnar"] / rps["fused"], 2),
+            decode_mb_per_sec_python=round(payload_mb / legs["python"], 2),
+            decode_mb_per_sec_columnar=round(
+                payload_mb / legs["columnar"], 2),
+            host_pipeline_s_python=round(legs["python"], 3),
+            host_pipeline_s_fused=round(legs["fused"], 3),
+            host_pipeline_s_columnar=round(legs["columnar"], 3),
+            n_records=total)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def bench_twin():
     """Digital-twin + compaction costs (iotml.twin / store.compact):
     twin apply rate (sensor records folded into per-car state per
@@ -2713,6 +2814,12 @@ def main():
         # recovery wall time; no reference twin (its retention lived in
         # managed Kafka), so vs_baseline deliberately 0
         ("store_append_mb_per_sec", "MB/s", None),
+        # zero-copy columnar consume path (ISSUE 10): python vs fused vs
+        # columnar decode rate over one durable topic + the RAW_FETCH
+        # wire leg — the host-pipeline ceiling behind the e2e knee.
+        # Baseline: the reference's measured train-consume rate
+        ("pipeline_columnar_records_per_sec", "records/s",
+         TRAIN_BASELINE_RPS),
         # digital-twin materialisation (iotml.twin): fold rate into the
         # per-car feature store, changelog-compaction MB/s reclaimed,
         # and GET /twin/<id> REST latency; the reference's twin lived
@@ -2776,6 +2883,7 @@ def main():
         run("serve_rows_per_sec", bench_serve)
         run("ksql_pipeline_records_per_sec", bench_ksql_pipeline)
         run("store_append_mb_per_sec", bench_store_log)
+        run("pipeline_columnar_records_per_sec", bench_pipeline)
         run("twin_apply_records_per_sec", bench_twin)
         run("train_ckpt_async_records_per_sec", bench_checkpoint)
         run("online_adapt_records", bench_online)

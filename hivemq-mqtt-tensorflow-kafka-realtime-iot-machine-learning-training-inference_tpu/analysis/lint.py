@@ -87,7 +87,7 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 # any of these is invisible (reads) or a no-op (liveness signal).  Kept
 # in sync with kafka_wire.IDEMPOTENT_APIS by tests/test_analysis.py.
 IDEMPOTENT_API_NAMES = frozenset({
-    "FETCH", "METADATA", "LIST_OFFSETS", "OFFSET_FETCH",
+    "FETCH", "RAW_FETCH", "METADATA", "LIST_OFFSETS", "OFFSET_FETCH",
     "API_VERSIONS", "SASL_HANDSHAKE", "HEARTBEAT", "FIND_COORDINATOR",
 })
 
@@ -178,7 +178,24 @@ RULES: Dict[str, str] = {
            "the registry (versioning, rollback gate, swap metrics) — "
            "a direct weight poke is an unversioned deploy nothing can "
            "roll back",
+    "R14": "frame parsing (the [len|crc|attrs|offset|ts|key|value|"
+           "headers] layout: scan_records / iter_frames / "
+           "decode_record / encode_record, or the >IBqqi head struct) "
+           "outside iotml/store/ + iotml/ops/framing.py: the segmented "
+           "log's frame is the ONE wire→disk→host contract with ONE "
+           "parser — consume raw batches via Broker.fetch_raw + "
+           "FrameDecoder / ops.framing helpers",
 }
+
+# R14: the segment frame codec's entry points, and the frame-head
+# struct format that marks a hand-rolled parser.  Same conservative
+# name-matching as R9/R11 (a false positive justifies itself with a
+# suppression).
+_FRAME_PARSER_CALLS = frozenset({"scan_records", "iter_frames",
+                                 "decode_record", "encode_record"})
+_FRAME_HEAD_RE = re.compile(r"IBqqi")
+_STRUCT_CALLS = frozenset({"Struct", "pack", "unpack", "unpack_from",
+                           "pack_into"})
 
 # R12: the compacted twin-changelog topics whose produce is confined to
 # iotml/twin/, the store-internal compaction entry points, and the
@@ -470,6 +487,12 @@ class _FileLinter(ast.NodeVisitor):
         # R9 scoping: the store package OWNS the bytes (SegmentWriter,
         # atomic_write) and is the one place fsync may appear
         self.in_store = "store" in parts
+        # R14 scoping: the store package plus ops/framing.py (the frame
+        # contract's stream-layer half, whose helpers delegate to the
+        # store codec) are the only frame parsers
+        self.r14_exempt = self.in_store or (
+            len(parts) >= 2 and (parts[-2], parts[-1])
+            == ("ops", "framing.py"))
         # R11 scoping: the mlops package owns registry bytes
         self.in_mlops = "mlops" in parts
         # R12 scoping: the twin package owns the CAR_TWIN changelog
@@ -767,6 +790,31 @@ class _FileLinter(ast.NodeVisitor):
                                "swap protocol (durable tmp + atomic "
                                "os.replace + mount-time sweep) is the "
                                "store's alone")
+
+        # R14 — ONE frame parser: the segment frame codec's entry
+        # points (and any hand-rolled >IBqqi head struct) are confined
+        # to iotml/store/ + iotml/ops/framing.py; everyone else
+        # consumes raw batches through Broker.fetch_raw + FrameDecoder
+        # or the ops.framing helpers, so the wire→disk→host contract
+        # cannot fork
+        if not self.r14_exempt:
+            if name in _FRAME_PARSER_CALLS:
+                self._emit("R14", node,
+                           f"{name}() outside iotml/store/ + iotml/ops/"
+                           "framing.py: the store frame has ONE parser "
+                           "— go through Broker.fetch_raw + "
+                           "FrameDecoder (or ops.framing helpers)")
+            if name in _STRUCT_CALLS:
+                arg_src = " ".join(
+                    ast.unparse(a) for a in list(node.args)
+                    + [kw.value for kw in node.keywords])
+                if _FRAME_HEAD_RE.search(arg_src):
+                    self._emit("R14", node,
+                               "hand-rolled frame-head struct "
+                               "(>IBqqi) outside iotml/store/ + "
+                               "iotml/ops/framing.py: the frame "
+                               "layout is one contract with one "
+                               "parser")
 
         # R13 — model updates go through the registry: an in-place
         # .set_params(...) on a serving scorer outside the mlops/online

@@ -130,8 +130,25 @@ def main(argv=None) -> int:
                        help="exit after this long (0 = until stdin closes)")
         p.add_argument("--wait-topic-seconds", type=float, default=60.0,
                        help="wait this long for the input topic to appear")
+        p.add_argument("--prefetch-depth", type=int, default=None,
+                       help="host→device prefetch queue depth (sets "
+                            "IOTML_PREFETCH_DEPTH; default 2)")
+        p.add_argument("--decode-ring-buffers", type=int, default=None,
+                       help="reusable columnar decode buffers (sets "
+                            "IOTML_DECODE_RING_BUFFERS; default 4)")
+        p.add_argument("--raw-batch-bytes", type=int, default=None,
+                       help="max bytes per raw frame fetch (sets "
+                            "IOTML_RAW_BATCH_BYTES; default 1 MiB)")
 
     args = ap.parse_args(argv)
+    from ..data.pipeline import set_knobs
+
+    try:
+        set_knobs(prefetch_depth=args.prefetch_depth,
+                  decode_ring_buffers=args.decode_ring_buffers,
+                  raw_batch_bytes=args.raw_batch_bytes)
+    except ValueError as e:
+        ap.error(str(e))
     broker = _wire_broker(args.servers, args.sasl)
     stop = _stopper(args.max_seconds)
 

@@ -461,8 +461,31 @@ def main(argv=None) -> int:
                          "non-loopback host (default: exposed platforms "
                          "fully re-validate pass-through batches; "
                          "loopback trusts with 1-in-32 sampling)")
+    ap.add_argument("--prefetch-depth", type=int, default=None,
+                    metavar="N",
+                    help="host→device prefetch queue depth for every "
+                         "in-process consumer pipeline (sets "
+                         "IOTML_PREFETCH_DEPTH; default 2)")
+    ap.add_argument("--decode-ring-buffers", type=int, default=None,
+                    metavar="N",
+                    help="reusable columnar decode buffers per pipeline "
+                         "(sets IOTML_DECODE_RING_BUFFERS; default 4, "
+                         "min 2)")
+    ap.add_argument("--raw-batch-bytes", type=int, default=None,
+                    metavar="BYTES",
+                    help="max bytes per raw frame fetch on the "
+                         "zero-copy consume path (sets "
+                         "IOTML_RAW_BATCH_BYTES; default 1 MiB)")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
+    from ..data.pipeline import set_knobs
+
+    try:
+        set_knobs(prefetch_depth=args.prefetch_depth,
+                  decode_ring_buffers=args.decode_ring_buffers,
+                  raw_batch_bytes=args.raw_batch_bytes)
+    except ValueError as e:
+        ap.error(str(e))
 
     sasl = tuple(args.sasl.split(":", 1)) if args.sasl else None
     # the store.* config section (file < IOTML_STORE_* env) supplies the

@@ -102,6 +102,15 @@ def main(argv=None) -> int:
     up.add_argument("--mirror-groups", default="iotml",
                     help="comma list of groups whose offsets followers "
                          "mirror")
+    up.add_argument("--prefetch-depth", type=int, default=None,
+                    help="host→device prefetch depth for fleet "
+                         "pipelines (sets IOTML_PREFETCH_DEPTH)")
+    up.add_argument("--decode-ring-buffers", type=int, default=None,
+                    help="columnar decode buffers per pipeline (sets "
+                         "IOTML_DECODE_RING_BUFFERS)")
+    up.add_argument("--raw-batch-bytes", type=int, default=None,
+                    help="max bytes per raw frame fetch (sets "
+                         "IOTML_RAW_BATCH_BYTES)")
     up.add_argument("--quiet", action="store_true")
     up.set_defaults(fn=cmd_up)
 
@@ -112,6 +121,17 @@ def main(argv=None) -> int:
     drill.set_defaults(fn=cmd_drill)
 
     args = ap.parse_args(argv)
+    if getattr(args, "prefetch_depth", None) is not None or \
+            getattr(args, "decode_ring_buffers", None) is not None or \
+            getattr(args, "raw_batch_bytes", None) is not None:
+        from ..data.pipeline import set_knobs
+
+        try:
+            set_knobs(prefetch_depth=args.prefetch_depth,
+                      decode_ring_buffers=args.decode_ring_buffers,
+                      raw_batch_bytes=args.raw_batch_bytes)
+        except ValueError as e:
+            ap.error(str(e))
     return args.fn(args)
 
 

@@ -259,6 +259,21 @@ class ClusterClient:
             lambda c: c.fetch(topic, partition, offset, max_messages),
             retry_connection=True)
 
+    def fetch_raw(self, topic: str, partition: int, offset: int,
+                  max_bytes: int = 1 << 20):
+        """Raw-batch fetch routed to the owning shard (see
+        Broker.fetch_raw / KafkaWireBroker.fetch_raw).  Raises
+        NotImplementedError when the owning connection has no raw-batch
+        support, so consumers fall back to the legacy paths."""
+        def op(c):
+            fr = getattr(c, "fetch_raw", None)
+            if fr is None:
+                raise NotImplementedError(
+                    "owning broker lacks raw-batch fetch")
+            return fr(topic, partition, offset, max_bytes=max_bytes)
+
+        return self._routed(topic, partition, op, retry_connection=True)
+
     def end_offset(self, topic: str, partition: int = 0) -> int:
         return self._routed(topic, partition,
                             lambda c: c.end_offset(topic, partition),

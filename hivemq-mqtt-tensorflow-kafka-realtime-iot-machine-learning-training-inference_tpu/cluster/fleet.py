@@ -132,6 +132,14 @@ class ScorerFleet(_Fleet):
       in_topic/group: the scored stream and the fleet's group id.
       out_topic: predictions topic (created with >= n_members
         partitions by the caller).
+
+    Data plane: each member's `SensorBatches` takes the zero-copy
+    columnar path automatically when the owning shards are durable (or
+    reached over the wire) — raw frame batches routed by the
+    ClusterClient, decoded by the one FrameDecoder into ring buffers.
+    The process knobs IOTML_PREFETCH_DEPTH / IOTML_DECODE_RING_BUFFERS /
+    IOTML_RAW_BATCH_BYTES (data/pipeline.py; `cluster up` flags) tune
+    every member's pipeline at once.
     """
 
     def __init__(self, client_factory, model, params, n_members: int,

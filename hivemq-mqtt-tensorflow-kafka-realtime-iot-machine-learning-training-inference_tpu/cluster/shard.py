@@ -39,7 +39,7 @@ class _UnownedPartition:
 
     # the full _Partition touch-point surface, all refusing
     append = append_at = sync_batch = note_replay = _refuse
-    end = base = read = drop_head = enforce_retention = _refuse
+    end = base = read = read_raw = drop_head = enforce_retention = _refuse
     align_base = reset = offset_for_timestamp = _refuse
 
 

@@ -284,7 +284,13 @@ def load_config(argv: Optional[Sequence[str]] = None,
                   "IOTML_CHAOS_SCENARIO", "IOTML_CHAOS_RECORDS",
                   "IOTML_DEVSIM_DIR",
                   "IOTML_SUPERVISE", "IOTML_SUPERVISE_POLL_S",
-                  "IOTML_SUPERVISE_MAX_RESTARTS"}
+                  "IOTML_SUPERVISE_MAX_RESTARTS",
+                  # zero-copy pipeline knobs (data/pipeline.py): they
+                  # tune the process's decode/prefetch machinery, not
+                  # the pipeline's logical config — and their names
+                  # predate the SECTION_FIELD convention
+                  "IOTML_PREFETCH_DEPTH", "IOTML_DECODE_RING_BUFFERS",
+                  "IOTML_RAW_BATCH_BYTES"}
     for key, value in env.items():
         if not key.startswith("IOTML_") or key in non_config:
             continue

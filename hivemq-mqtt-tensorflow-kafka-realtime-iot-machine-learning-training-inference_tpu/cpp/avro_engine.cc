@@ -343,7 +343,11 @@ int64_t iotml_encode_batch(const double* numeric, const char* labels,
 // 3 = + iotml_decode_batch_nulls (null-bitmap decode);
 // 4 = + iotml_json_decode_batch (batch JSON → columnar, json_engine.cc)
 //     + iotml_encode_batch_nulls (null-bitmap encode);
-// 5 = + iotml_format_rows_f32/f64 (batch np.array2string, fmt_engine.cc)
-int64_t iotml_engine_version() { return 6; }
+// 5 = + iotml_format_rows_f32/f64 (batch np.array2string, fmt_engine.cc);
+// 6 = + tombstone round-trip (produce_nulls / staged_value_nulls);
+// 7 = + iotml_frames_decode_columnar (store-frame columnar decoder,
+//       frame_engine.cc) + iotml_kafka_set_pinned_id_limit (pinned
+//       writer-id guard on the fused fetch_decode paths)
+int64_t iotml_engine_version() { return 7; }
 
 }  // extern "C"

@@ -1,0 +1,323 @@
+// iotml native stream engine: columnar STORE-FRAME batch decoder.
+//
+// The zero-copy data plane's device-side half: one call walks a raw
+// batch of segmented-log frames (store/segment.py layout, the ONE
+// wire→disk→host contract)
+//
+//     u32 length | u32 crc32c | u8 attrs | i64 offset | i64 ts |
+//     i32 key_len | key | u32 value_len | value | [headers]
+//
+// verifies each frame's CRC32C, checks the value's Confluent header
+// (magic 0 + big-endian writer-schema id) against the reader's pinned
+// id, and Avro-decodes the payload straight into CALLER-OWNED
+// preallocated float32 / fixed-stride label / fixed-stride key column
+// buffers — zero per-record allocations on either side of the ABI.
+// Live consume and timestamp-replay backfill both enter through this
+// one function (via stream.native.FrameDecoder), so the two paths
+// cannot drift.
+//
+// Stop conditions (decoding always stops BEFORE the offending frame so
+// the caller's cursor lands exactly on it):
+//   - torn/corrupt frame (short buffer, bad CRC): flag bit 0 — the
+//     recovery contract, same as store.segment.scan_records;
+//   - Confluent schema-id mismatch (an evolved writer on a supposedly
+//     pinned topic): flag bit 1 — the caller falls back to the
+//     name-resolving Python path for that chunk instead of mis-reading
+//     v2 bytes positionally;
+//   - caller buffers full (cap_rows).
+// Tombstones (attrs bit 1, compaction delete markers) carry no Avro
+// payload: they are skipped and counted, never decoded.
+//
+// Build: part of libiotml_stream.so (see Makefile).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+enum FieldType : int8_t {
+  FR_FLOAT = 0,
+  FR_DOUBLE = 1,
+  FR_INT = 2,
+  FR_LONG = 3,
+  FR_STRING = 4,
+  FR_BOOLEAN = 5,
+};
+
+// frame geometry (store/segment.py): length prefix + fixed head
+constexpr int64_t kLenSize = 4;
+constexpr int64_t kHeadSize = 4 + 1 + 8 + 8 + 4;  // crc, attrs, offset, ts, key_len
+constexpr int64_t kMinBody = kHeadSize + 4;       // + value_len
+constexpr uint8_t kAttrHeaders = 0x01;
+constexpr uint8_t kAttrNullValue = 0x02;
+
+// ---------------------------------------------------------------- crc32c
+// Castagnoli (reflected 0x82F63B78), table built on first use — the
+// byte-parity oracle is store/segment.py's _crc32c_py.
+const uint32_t* crc32c_table() {
+  static uint32_t table[256];
+  static bool ready = false;
+  if (!ready) {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t crc = i;
+      for (int k = 0; k < 8; ++k)
+        crc = (crc & 1) ? (crc >> 1) ^ 0x82F63B78u : crc >> 1;
+      table[i] = crc;
+    }
+    ready = true;
+  }
+  return table;
+}
+
+inline uint32_t crc32c(const uint8_t* data, int64_t n) {
+  const uint32_t* table = crc32c_table();
+  uint32_t crc = 0xFFFFFFFFu;
+  for (int64_t i = 0; i < n; ++i)
+    crc = table[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+inline uint32_t be32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+inline int64_t be64(const uint8_t* p) {
+  return (int64_t(be32(p)) << 32) | int64_t(be32(p + 4));
+}
+
+// Avro zigzag varint (same contract as avro_engine.cc's reader).
+inline int64_t frame_read_varint(const uint8_t* buf, int64_t pos,
+                                 int64_t end, int64_t* out) {
+  uint64_t acc = 0;
+  int shift = 0;
+  while (pos < end) {
+    uint8_t b = buf[pos++];
+    if (shift == 63 && (b & 0x7E)) return -1;
+    acc |= static_cast<uint64_t>(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *out = static_cast<int64_t>(acc >> 1) ^ -static_cast<int64_t>(acc & 1);
+      return pos;
+    }
+    shift += 7;
+    if (shift > 63) return -1;
+  }
+  return -1;
+}
+
+// Avro-decode one record body into a float32 numeric row + fixed-stride
+// label slots.  Returns true on success.  float32 by contract: the
+// device batch is float32, and a single double→float rounding here is
+// bit-identical to numpy's astype on the Python oracle path.
+bool decode_avro_row(const uint8_t* buf, int64_t pos, int64_t end,
+                     const int8_t* types, const uint8_t* nullable,
+                     int64_t n_fields, float* num_row, char* lab_row,
+                     int64_t label_stride) {
+  int64_t ncol = 0, scol = 0;
+  for (int64_t f = 0; f < n_fields; ++f) {
+    bool is_null = false;
+    if (nullable[f]) {
+      int64_t branch;
+      pos = frame_read_varint(buf, pos, end, &branch);
+      if (pos < 0) return false;
+      is_null = (branch == 0);
+    }
+    switch (types[f]) {
+      case FR_FLOAT: {
+        float v = 0.0f;
+        if (!is_null) {
+          if (pos + 4 > end) return false;
+          std::memcpy(&v, buf + pos, 4);
+          pos += 4;
+        }
+        num_row[ncol++] = v;
+        break;
+      }
+      case FR_DOUBLE: {
+        double v = 0.0;
+        if (!is_null) {
+          if (pos + 8 > end) return false;
+          std::memcpy(&v, buf + pos, 8);
+          pos += 8;
+        }
+        num_row[ncol++] = static_cast<float>(v);
+        break;
+      }
+      case FR_INT:
+      case FR_LONG: {
+        int64_t v = 0;
+        if (!is_null) {
+          pos = frame_read_varint(buf, pos, end, &v);
+          if (pos < 0) return false;
+        }
+        num_row[ncol++] = static_cast<float>(static_cast<double>(v));
+        break;
+      }
+      case FR_BOOLEAN: {
+        float v = 0.0f;
+        if (!is_null) {
+          if (pos + 1 > end) return false;
+          v = buf[pos++] ? 1.0f : 0.0f;
+        }
+        num_row[ncol++] = v;
+        break;
+      }
+      case FR_STRING: {
+        char* slot = lab_row + scol * label_stride;
+        ++scol;
+        if (is_null) {
+          slot[0] = '\0';
+          break;
+        }
+        int64_t len;
+        pos = frame_read_varint(buf, pos, end, &len);
+        if (pos < 0 || len < 0 || pos + len > end) return false;
+        int64_t copy = len < label_stride - 1 ? len : label_stride - 1;
+        std::memcpy(slot, buf + pos, copy);
+        slot[copy] = '\0';
+        pos += len;
+        break;
+      }
+      default:
+        return false;
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// flag bits reported through *out_flags
+enum FrameFlags : int64_t {
+  FRAMES_STOP_TORN = 1,      // torn/corrupt frame parked the scan
+  FRAMES_STOP_SCHEMA = 2,    // Confluent schema id != expect_schema_id
+};
+
+// Decode a raw batch of store frames into columnar buffers.
+//
+//   buf/buf_len: contiguous frame bytes (a segment byte range, a wire
+//       RAW_FETCH payload, or the emulator's re-framed batch).  May
+//       begin with frames below start_offset (sparse-index alignment:
+//       skipped after CRC verification) and end mid-frame (the torn
+//       tail ends the batch, exactly like crash recovery).
+//   start_offset: frames with offset < start_offset are skipped.
+//   types/nullable/n_fields: the reader schema's compiled descriptors.
+//   pinned_id_limit: the EXCLUSIVE upper bound on positionally-safe
+//       Confluent writer ids (>= 0).  Registry-allocated v1-compatible
+//       schemas get small ids; EVOLVED writer schemas live in the
+//       reserved band at/above stream.registry.RESERVED_ID_BASE — a
+//       value that is not magic-0 framed, or whose id is >= this limit,
+//       stops the scan with FRAMES_STOP_SCHEMA (the caller resolves by
+//       name in Python; nothing is blind-stripped).  < 0 decodes the
+//       value as BARE Avro (no header, no strip) — the store-native
+//       form.
+//   out_numeric: [cap_rows x n_numeric] float32, row-major.
+//   out_labels/label_stride: string fields, NUL-terminated slots.
+//   out_keys/key_stride: optional (NULL) per-row message key copies,
+//       zero-padded, truncated at stride-1 (the routing identity).
+//   out_next_offset: cursor after the last CONSUMED frame (decoded or
+//       skipped-tombstone); unchanged when nothing was consumed.
+//   out_flags / out_skipped: stop reason bits; tombstones skipped.
+//
+// Returns rows decoded (>= 0), or -1 on invalid arguments.
+int64_t iotml_frames_decode_columnar(
+    const uint8_t* buf, int64_t buf_len, int64_t start_offset,
+    const int8_t* types, const uint8_t* nullable, int64_t n_fields,
+    int64_t pinned_id_limit, float* out_numeric, char* out_labels,
+    int64_t label_stride, char* out_keys, int64_t key_stride,
+    int64_t cap_rows, int64_t* out_next_offset, int64_t* out_flags,
+    int64_t* out_skipped) {
+  if (!buf || !types || !nullable || !out_numeric || !out_labels ||
+      label_stride < 1 || cap_rows < 0 || (out_keys && key_stride < 1))
+    return -1;
+  int64_t n_numeric = 0, n_strings = 0;
+  for (int64_t f = 0; f < n_fields; ++f) {
+    if (types[f] == FR_STRING) ++n_strings; else ++n_numeric;
+  }
+  int64_t rows = 0, skipped = 0, flags = 0;
+  int64_t pos = 0;
+  int64_t next_offset = start_offset;
+  while (rows < cap_rows) {
+    if (pos + kLenSize > buf_len) break;  // clean end of buffer
+    int64_t length = static_cast<int64_t>(be32(buf + pos));
+    int64_t body = pos + kLenSize;
+    int64_t end = body + length;
+    if (length < kMinBody || end > buf_len) {
+      flags |= FRAMES_STOP_TORN;  // torn tail / corrupt length prefix
+      break;
+    }
+    uint32_t crc = be32(buf + body);
+    if (crc32c(buf + body + 4, length - 4) != crc) {
+      flags |= FRAMES_STOP_TORN;  // corrupt frame: recovery's contract
+      break;
+    }
+    uint8_t attrs = buf[body + 4];
+    int64_t offset = be64(buf + body + 5);
+    int32_t key_len = static_cast<int32_t>(be32(buf + body + 21));
+    int64_t p = body + kHeadSize;
+    const uint8_t* key = nullptr;
+    int64_t kn = 0;
+    if (key_len >= 0) {
+      key = buf + p;
+      kn = key_len;
+      p += key_len;
+    }
+    if (p + 4 > end) {
+      flags |= FRAMES_STOP_TORN;
+      break;
+    }
+    int64_t value_len = static_cast<int64_t>(be32(buf + p));
+    p += 4;
+    if (p + value_len > end) {
+      flags |= FRAMES_STOP_TORN;
+      break;
+    }
+    if (offset < start_offset) {
+      pos = end;  // sparse-index alignment: before the requested cursor
+      continue;
+    }
+    if (attrs & kAttrNullValue) {
+      // tombstone: no Avro payload to decode; consumed, counted
+      ++skipped;
+      next_offset = offset + 1;
+      pos = end;
+      continue;
+    }
+    int64_t vpos = p;
+    int64_t vend = p + value_len;
+    if (pinned_id_limit >= 0) {
+      if (value_len < 5 || buf[vpos] != 0 ||
+          static_cast<int64_t>(be32(buf + vpos + 1)) >= pinned_id_limit) {
+        flags |= FRAMES_STOP_SCHEMA;  // evolved writer: resolve in Python
+        break;
+      }
+      vpos += 5;  // Confluent header verified, not blind-stripped
+    }
+    float* num_row = out_numeric + rows * n_numeric;
+    char* lab_row = out_labels + rows * n_strings * label_stride;
+    if (!decode_avro_row(buf, vpos, vend, types, nullable, n_fields,
+                         num_row, lab_row, label_stride)) {
+      flags |= FRAMES_STOP_TORN;  // malformed Avro inside a valid frame
+      break;
+    }
+    if (out_keys) {
+      char* krow = out_keys + rows * key_stride;
+      std::memset(krow, 0, key_stride);
+      if (key && kn > 0) {
+        int64_t copy = kn < key_stride - 1 ? kn : key_stride - 1;
+        std::memcpy(krow, key, copy);
+      }
+    }
+    ++rows;
+    next_offset = offset + 1;
+    pos = end;
+  }
+  if (out_next_offset) *out_next_offset = next_offset;
+  if (out_flags) *out_flags = flags;
+  if (out_skipped) *out_skipped = skipped;
+  return rows;
+}
+
+}  // extern "C"

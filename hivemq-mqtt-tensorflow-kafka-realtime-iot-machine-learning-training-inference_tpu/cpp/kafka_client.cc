@@ -51,6 +51,13 @@ constexpr int16_t API_PRODUCE = 0, API_FETCH = 1, API_LIST_OFFSETS = 2,
                   API_CREATE_TOPICS = 19;
 constexpr int16_t ERR_NONE = 0, ERR_TOPIC_EXISTS = 36;
 constexpr int64_t K_EIO = -2;  // -1 would collide with OffsetFetch's "no committed offset"
+// The fused decode found a Confluent schema id outside the pinned band
+// at the CURRENT cursor (nothing decoded): the caller re-reads the
+// chunk through the name-resolving Python path (native_kafka maps this
+// to SchemaIdMismatchError).  -1999 sits between the protocol-error
+// band (-1000 - code) and the decode-error band (-(row + 1) - 2000),
+// colliding with neither.
+constexpr int64_t K_ESCHEMA = -1999;
 
 inline int64_t proto_err(int16_t code) { return -(1000 + (int64_t)code); }
 
@@ -174,6 +181,15 @@ struct Client {
   std::string client_id;
   std::vector<Staged> staged;
   int64_t staged_high_watermark = -1;
+  // Exclusive upper bound on positionally-safe Confluent writer ids
+  // for the fused fetch_decode paths (< 0 = no check, the legacy
+  // blind-strip behavior).  Evolved writer schemas live in the
+  // reserved id band (stream.registry.RESERVED_ID_BASE and up): a
+  // staged value that is not magic-0 framed or whose id is >= this
+  // limit stops the decode BEFORE that message — an evolved (v2)
+  // writer on a supposedly-v1 topic surfaces as K_ESCHEMA instead of
+  // being positionally mis-read.
+  int64_t pinned_id_limit = -1;
 };
 
 // MessageSet v1 encode: entries share one timestamp array layout from caller.
@@ -491,6 +507,14 @@ void iotml_kafka_close(void* h) {
   delete c;
 }
 
+// Pin the exclusive upper bound on positionally-safe writer ids that
+// the fused fetch_decode paths verify before their strip=5 decode
+// (< 0 disables the check — the legacy blind-strip behavior).  Per
+// handle, not per call, so the existing fetch_decode ABI is untouched.
+void iotml_kafka_set_pinned_id_limit(void* h, int64_t limit) {
+  static_cast<Client*>(h)->pinned_id_limit = limit;
+}
+
 // Partition count for one topic (Metadata v1); 0 = unknown topic.
 int64_t iotml_kafka_metadata(void* h, const char* topic) {
   Client* c = static_cast<Client*>(h);
@@ -802,6 +826,27 @@ int64_t iotml_kafka_fetch_decode_keys(
   if (n <= 0) {
     *next_offset = offset;
     return n;
+  }
+  // Runtime guard for the blind Confluent strip: with a pinned writer
+  // id (set_expect_schema_id), decode only the prefix of staged
+  // messages whose 5-byte header matches — the first evolved (v2)
+  // frame ends the batch so the caller's cursor lands exactly on it
+  // and the resolving Python path takes over for that chunk.
+  if (strip == 5 && c->pinned_id_limit >= 0) {
+    int64_t ok = 0;
+    for (; ok < n; ++ok) {
+      const std::vector<uint8_t>& v = c->staged[ok].value;
+      if (c->staged[ok].value_null || v.size() < 5 || v[0] != 0) break;
+      int64_t sid = (int64_t(v[1]) << 24) | (int64_t(v[2]) << 16) |
+                    (int64_t(v[3]) << 8) | int64_t(v[4]);
+      if (sid >= c->pinned_id_limit) break;
+    }
+    if (ok == 0) {
+      *next_offset = offset;
+      c->staged.clear();
+      return K_ESCHEMA;
+    }
+    n = ok;  // decode the verified prefix; cursor stops before the rest
   }
   // Flatten staged values into one blob for the batch decoder.
   int64_t total = 0;

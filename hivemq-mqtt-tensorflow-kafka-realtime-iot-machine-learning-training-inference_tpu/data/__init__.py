@@ -1,2 +1,3 @@
 from .dataset import SensorBatches, Batch  # noqa: F401
 from .prefetch import DevicePrefetcher  # noqa: F401
+from .pipeline import DecodeRing  # noqa: F401

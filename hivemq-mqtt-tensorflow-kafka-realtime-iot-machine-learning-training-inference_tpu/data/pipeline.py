@@ -1,0 +1,142 @@
+"""Columnar decode ring + the pipeline's process-level knobs.
+
+The tf.data-shaped half of the zero-copy data plane (PAPERS: *tf.data*,
+*Optimizing High-Throughput Distributed Data Pipelines*): raw frame
+batches (`Broker.fetch_raw` / wire RAW_FETCH) are decoded by the native
+`FrameDecoder` straight into a SMALL RING of reusable preallocated
+column buffers — float32 numeric, fixed-stride labels and keys — so the
+steady state allocates nothing per record and nothing per chunk beyond
+the normalized output block.  Decode runs on whatever thread drains the
+batch iterator (under `DevicePrefetcher` that is the staging thread),
+`jax.device_put` stays on the consumer thread, and the device step
+overlaps both — the same overlap discipline `data/prefetch.py`
+documents.
+
+Knobs (process-level env toggles, in ``config.non_config`` like
+``IOTML_TRACE``; a malformed value fails loudly, the config system's
+contract):
+
+  IOTML_PREFETCH_DEPTH       DevicePrefetcher queue depth (default 2 —
+                             classic double buffering)
+  IOTML_DECODE_RING_BUFFERS  slots in the decode ring (default 4; min 2
+                             so decode N+1 never overwrites a chunk the
+                             batcher still views)
+  IOTML_RAW_BATCH_BYTES      max bytes per raw frame fetch (default
+                             1 MiB — one disk/wire read per decode call)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+_DEFAULTS = {
+    "IOTML_PREFETCH_DEPTH": (2, 1),
+    "IOTML_DECODE_RING_BUFFERS": (4, 2),
+    "IOTML_RAW_BATCH_BYTES": (1 << 20, 4096),
+}
+
+
+def _env_int(name: str) -> int:
+    default, lo = _DEFAULTS[name]
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        value = int(raw)
+    except ValueError as e:
+        raise ValueError(f"env {name}={raw!r}: expected an integer "
+                         f"(>= {lo})") from e
+    if value < lo:
+        raise ValueError(f"env {name}={value}: must be >= {lo} "
+                         f"({'prefetch depth 0 would be UNBOUNDED, ' if 'PREFETCH' in name else ''}"
+                         f"see data/pipeline.py)")
+    return value
+
+
+def prefetch_depth() -> int:
+    """DevicePrefetcher queue depth (IOTML_PREFETCH_DEPTH, default 2)."""
+    return _env_int("IOTML_PREFETCH_DEPTH")
+
+
+def decode_ring_buffers() -> int:
+    """Decode-ring slot count (IOTML_DECODE_RING_BUFFERS, default 4)."""
+    return _env_int("IOTML_DECODE_RING_BUFFERS")
+
+
+def raw_batch_bytes() -> int:
+    """Max bytes per raw frame fetch (IOTML_RAW_BATCH_BYTES, 1 MiB)."""
+    return _env_int("IOTML_RAW_BATCH_BYTES")
+
+
+def set_knobs(prefetch_depth: Optional[int] = None,
+              decode_ring_buffers: Optional[int] = None,
+              raw_batch_bytes: Optional[int] = None) -> None:
+    """CLI → env bridge: publish the given knobs into this process's
+    environment (validated; None = leave as-is) so every pipeline built
+    afterwards — and every supervised component thread — reads them.
+    Used by ``cli.up`` / ``cli.live`` flags and the cluster CLI."""
+    for name, value in (("IOTML_PREFETCH_DEPTH", prefetch_depth),
+                        ("IOTML_DECODE_RING_BUFFERS", decode_ring_buffers),
+                        ("IOTML_RAW_BATCH_BYTES", raw_batch_bytes)):
+        if value is None:
+            continue
+        _default, lo = _DEFAULTS[name]
+        value = int(value)
+        if value < lo:
+            # validate BEFORE publishing: a caught error must not leave
+            # an invalid value active process-wide
+            raise ValueError(f"{name}={value}: must be >= {lo}")
+        os.environ[name] = str(value)
+
+
+class _Slot:
+    """One reusable column-buffer set (a decode target)."""
+
+    __slots__ = ("x", "labels", "keys")
+
+    def __init__(self, rows: int, n_numeric: int, n_strings: int,
+                 label_stride: int, key_stride: int, with_keys: bool):
+        self.x = np.zeros((rows, n_numeric), np.float32)
+        self.labels = np.zeros((rows, max(n_strings, 1)),
+                               f"S{label_stride}")
+        self.keys = np.zeros((rows,), f"S{key_stride}") if with_keys \
+            else None
+
+
+class DecodeRing:
+    """Round-robin ring of preallocated columnar decode buffers.
+
+    The decoder fills slot *i* while the batcher may still hold VIEWS of
+    the previous slots (the tail-carry between chunks in
+    `SensorBatches.__iter__` keeps at most the last partial chunk
+    alive, and the normalized output is always a fresh block) — with
+    >= 2 slots a decode can never overwrite bytes a held view still
+    reads.  Buffers are allocated once for the pipeline's lifetime:
+    steady-state chunk decode costs zero numpy allocations.
+    """
+
+    def __init__(self, rows: int, n_numeric: int, n_strings: int,
+                 label_stride: int = 16, key_stride: int = 64,
+                 with_keys: bool = False,
+                 n_buffers: Optional[int] = None):
+        n = decode_ring_buffers() if n_buffers is None else int(n_buffers)
+        if n < 2:
+            raise ValueError(f"decode ring needs >= 2 buffers, got {n}")
+        self.rows = int(rows)
+        self._slots: List[_Slot] = [
+            _Slot(self.rows, n_numeric, n_strings, label_stride,
+                  key_stride, with_keys)
+            for _ in range(n)]
+        self._i = 0
+
+    def __len__(self) -> int:
+        return len(self._slots)
+
+    def next_slot(self) -> _Slot:
+        """The next decode target (round-robin reuse)."""
+        slot = self._slots[self._i]
+        self._i = (self._i + 1) % len(self._slots)
+        return slot

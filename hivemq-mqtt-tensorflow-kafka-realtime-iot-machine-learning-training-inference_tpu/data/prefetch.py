@@ -33,14 +33,19 @@ class DevicePrefetcher:
       to_device: maps a host batch to device arrays; defaults to
         `jax.device_put` of `batch.x` (and `batch.y` when present), returning
         (arrays, batch) so callers keep metadata (n_valid, first_index).
-      depth: queue depth; 2 = classic double buffering.
+      depth: queue depth; 2 = classic double buffering.  None reads the
+        process knob IOTML_PREFETCH_DEPTH (data/pipeline.py, default 2).
       sharding: optional `jax.sharding.Sharding` for direct sharded puts.
     """
 
     _END = object()
 
     def __init__(self, batches: Iterable, to_device: Optional[Callable] = None,
-                 depth: int = 2, sharding=None):
+                 depth: Optional[int] = None, sharding=None):
+        if depth is None:
+            from .pipeline import prefetch_depth
+
+            depth = prefetch_depth()
         if depth < 1:
             # queue.Queue(maxsize=0) means UNBOUNDED — a depth of 0 would
             # silently stage the entire stream onto the device with no

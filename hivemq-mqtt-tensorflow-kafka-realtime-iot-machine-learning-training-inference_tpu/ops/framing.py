@@ -1,4 +1,4 @@
-"""Confluent Schema-Registry wire framing.
+"""Confluent Schema-Registry wire framing + the raw frame-batch contract.
 
 Records on the Kafka topic the ML layer consumes are not bare Avro: the
 Schema Registry serializer prepends a 5-byte header — magic byte ``0`` plus
@@ -6,11 +6,22 @@ a big-endian uint32 schema id.  The reference strips it in-graph with
 ``tf.strings.substr(e, 5, -1)`` (cardata-v3.py:50).  We keep the format
 byte-compatible so our stream engine interoperates with real Confluent
 payloads.
+
+This module is also the stream layer's half of the ONE frame contract
+(lint R14): the segmented log's CRC32C frame layout
+``[len|crc|attrs|offset|ts|key|value|headers]`` (store/segment.py) is
+the wire→disk→host batch format — ``Broker.fetch_raw``, the wire's
+RAW_FETCH and the replay API all hand back `RawFrameBatch` views of it,
+and the only parsers are ``store.segment`` and the helpers here (which
+delegate to it).  The C++ twin is ``cpp/frame_engine.cc``; the pure
+functions below are its byte-parity oracle and the no-toolchain
+fallback.
 """
 
 from __future__ import annotations
 
 import struct
+from typing import List, NamedTuple, Optional, Tuple
 
 MAGIC = 0
 SCHEMA_ID_DEFAULT = 1
@@ -37,3 +48,126 @@ def unframe(message: bytes) -> tuple:
 def strip_frame(message: bytes) -> bytes:
     """Reference-equivalent framing strip: drop the first 5 bytes blindly."""
     return message[5:]
+
+
+# ------------------------------------------------------ raw frame batches
+class RawFrameBatch(NamedTuple):
+    """A batch of records as CONTIGUOUS store-format frame bytes.
+
+    The zero-copy carrier between fetch and decode: no per-record Python
+    objects, just one buffer + the cursor it was read at.  ``data`` may
+    begin with frames below ``start_offset`` (sparse-index alignment —
+    the decoder skips them) and may end mid-frame (a torn tail ends the
+    batch, exactly like crash recovery); the decoder reports the true
+    row count and next cursor."""
+
+    topic: str
+    partition: int
+    start_offset: int   # the requested cursor; frames below are skipped
+    data: bytes         # store-format frames (segment.py layout)
+
+
+#: stop-flag bits shared with the native decoder (frame_engine.cc)
+FRAMES_STOP_TORN = 1
+FRAMES_STOP_SCHEMA = 2
+
+
+def encode_frame_batch(entries) -> bytes:
+    """[(offset, key, value, timestamp_ms, headers)] → contiguous frame
+    bytes — how the IN-MEMORY broker (and the chaos fixtures) express a
+    batch in the store's frame format.  Delegates to the store's frame
+    codec: one encoder, one layout (lint R14)."""
+    from ..store import segment as seg
+
+    return b"".join(
+        seg.encode_record(off, key, value, ts, headers)
+        for off, key, value, ts, headers in entries)
+
+
+def decode_frames_columnar_py(
+        buf: bytes, start_offset: int, schema,
+        pinned_id_limit: Optional[int] = None,
+        cap_rows: int = 1 << 62, label_stride: int = 16,
+        key_stride: int = 64, with_keys: bool = False
+) -> Tuple["np.ndarray", "np.ndarray", Optional["np.ndarray"],
+           int, int, int]:
+    """Pure-Python twin of ``cpp/frame_engine.cc``'s columnar decoder —
+    the byte-parity ORACLE (tests) and the no-toolchain fallback.
+
+    Walks store frames in ``buf`` via ``store.segment.scan_records`` (the
+    one parser), applies the same stop conditions (torn/corrupt frame,
+    Confluent schema-id mismatch, cap) and fills float32 numeric /
+    fixed-stride label / key columns.  Returns
+    ``(numeric [n,F] float32, labels [n,S] S-stride, keys|None,
+    next_offset, flags, skipped_tombstones)``.
+    """
+    import numpy as np
+
+    from ..ops.avro import AvroCodec
+    from ..store import segment as seg
+
+    if pinned_id_limit is None:
+        from ..stream.registry import RESERVED_ID_BASE
+
+        pinned_id_limit = RESERVED_ID_BASE
+    codec = AvroCodec(schema)
+    strings = [f.name for f in schema.fields if f.avro_type == "string"]
+    numerics = [f.name for f in schema.fields if f.avro_type != "string"]
+    rows_num: List[list] = []
+    rows_lab: List[list] = []
+    rows_key: List[bytes] = []
+    flags = 0
+    skipped = 0
+    next_offset = start_offset
+    consumed = 0
+    stopped = False
+    for _pos, end, off, key, value, _ts, _hdrs in seg.scan_records(buf):
+        if len(rows_num) >= cap_rows:
+            stopped = True
+            break
+        if off >= start_offset and value is None:
+            # tombstone: no payload to decode, consumed + counted
+            skipped += 1
+            next_offset = off + 1
+            consumed = end
+            continue
+        if off < start_offset:
+            consumed = end  # sparse-index alignment: skip, still consumed
+            continue
+        payload = value
+        if pinned_id_limit >= 0:
+            if len(value) < 5 or value[0] != MAGIC or \
+                    int.from_bytes(value[1:5], "big") >= pinned_id_limit:
+                flags |= FRAMES_STOP_SCHEMA
+                stopped = True
+                break
+            payload = value[5:]
+        try:
+            rec = codec.decode(payload)
+        except Exception:
+            flags |= FRAMES_STOP_TORN  # malformed Avro in a valid frame
+            stopped = True
+            break
+        rows_num.append([
+            np.float32(0.0 if rec[n] is None else rec[n])
+            for n in numerics])
+        rows_lab.append(["" if rec[s] is None else rec[s]
+                         for s in strings])
+        if with_keys:
+            rows_key.append((key or b"")[:key_stride - 1])
+        next_offset = off + 1
+        consumed = end
+    if not stopped and consumed < len(buf):
+        flags |= FRAMES_STOP_TORN  # scan parked on a torn/corrupt frame
+    n = len(rows_num)
+    numeric = np.zeros((n, len(numerics)), np.float32)
+    labels = np.zeros((n, len(strings)), f"S{label_stride}")
+    for i in range(n):
+        numeric[i] = rows_num[i]
+        labels[i] = [s.encode()[:label_stride - 1]
+                     for s in rows_lab[i]]
+    keys = None
+    if with_keys:
+        keys = np.asarray(rows_key, f"S{key_stride}") if rows_key \
+            else np.zeros((0,), f"S{key_stride}")
+    return numeric, labels, keys, next_offset, flags, skipped

@@ -167,7 +167,8 @@ class ShardedTrainer:
         self.state, metrics = self._step(self.state, xd, yd, md)
         return metrics
 
-    def fit(self, batches, epochs: int = 1, prefetch_depth: int = 2) -> dict:
+    def fit(self, batches, epochs: int = 1,
+            prefetch_depth: Optional[int] = None) -> dict:
         """Epochs × steps with host↔device overlap.
 
         Two things keep the chips fed (SURVEY §7 hard part (b) — host decode

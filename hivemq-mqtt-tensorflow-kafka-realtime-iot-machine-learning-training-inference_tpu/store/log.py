@@ -502,6 +502,58 @@ class SegmentedLog:
             store_replay_records.inc(len(out))
         return out
 
+    def read_raw(self, offset: int, max_bytes: int = 1 << 20
+                 ) -> Optional[Tuple[bytes, int]]:
+        """RAW frame bytes from `offset` — the zero-copy read: one
+        bounded pread of the owning segment, NO per-record parsing (the
+        caller's columnar decoder walks the frames).  Returns
+        ``(frame_bytes, aligned_start_offset)`` or None at/after the log
+        end; raises LookupError below the retained base (broker fetch
+        maps it to its out-of-range signal).
+
+        The returned range starts at the sparse-index position at/before
+        `offset` (leading frames are skipped by the decoder via their
+        self-describing offsets) and may end mid-frame (the decoder
+        treats the torn tail exactly like crash recovery: batch ends
+        there, the next poll resumes).  Safe without the broker lock for
+        the same reasons as ``read_from``: the segment list is
+        snapshotted, appends only grow files, and a concurrent trim
+        surfaces as FileNotFoundError → trimmed history."""
+        if offset < self.base_offset:
+            raise LookupError(
+                f"offset {offset} below retained base {self.base_offset}")
+        self.flush(sync=False)  # raw reads see every append too
+        segments = list(self._segments)
+        end = segments[-1].next_offset
+        if offset >= end:
+            return None
+        s = self._segment_for(segments, offset)
+        if s is None or offset >= s.next_offset:
+            # recovery-truncated hole before the next segment: serve the
+            # successor from its base (same monotone-recovery promise as
+            # read_from's hole jump)
+            nxt = [x for x in segments if x.base_offset > offset]
+            if not nxt:
+                return None
+            s = nxt[0]
+            offset = s.base_offset
+        start_pos = 0
+        for o, pos in reversed(s.index):
+            if o <= offset:
+                start_pos = pos
+                break
+        want = min(max(int(max_bytes), seg.MIN_BODY + 8),
+                   s.size - start_pos)
+        if want <= 0:
+            return None
+        try:
+            with open(s.path, "rb") as fh:
+                fh.seek(start_pos)
+                data = fh.read(want)
+        except FileNotFoundError:
+            return None  # retention deleted it mid-read: trimmed history
+        return data, offset
+
     def offset_for_timestamp(self, timestamp_ms: int) -> int:
         """Earliest offset whose record timestamp is >= `timestamp_ms`
         (end_offset when no such record) — the `retention.ms`-era replay

@@ -50,6 +50,27 @@ class TopicOwnershipError(PermissionError):
     server maps this to Kafka's TOPIC_AUTHORIZATION_FAILED."""
 
 
+class SchemaIdMismatchError(ValueError):
+    """A fused/columnar decode found a Confluent writer-schema id other
+    than the reader's pinned id at the current cursor.
+
+    The runtime guard behind the v1-only fast paths: instead of blind-
+    stripping 5 bytes and positionally mis-reading an evolved (v2)
+    writer's record, the native decoders STOP at the foreign frame and
+    raise this — the consumer re-reads that chunk through the name-
+    resolving Python path (`ops.avro.ResolvingCodec`) and then resumes
+    the fast path.  Nothing is consumed past the mismatch."""
+
+    def __init__(self, topic: str, partition: int, offset: int):
+        super().__init__(
+            f"non-pinned Confluent schema id at {topic}:{partition}"
+            f"@{offset}: evolved writer on a pinned topic — resolve by "
+            f"name in Python (chunk fallback), never strip blindly")
+        self.topic = topic
+        self.partition = partition
+        self.offset = offset
+
+
 class OffsetOutOfRangeError(LookupError):
     """Fetch below the partition's retained base offset.
 
@@ -169,6 +190,29 @@ class _Partition:
                 for i, (key, value, ts, hdrs)
                 in enumerate(self.log[idx:idx + max_messages])]
 
+    def read_raw(self, offset: int, max_bytes: int) -> Optional[tuple]:
+        """Store-format frame bytes from `offset` (the raw-batch duck-
+        type shared with `_DurablePartition`).  The in-memory emulator
+        has no on-disk frames, so it RE-FRAMES the slice through the one
+        frame codec (`ops.framing.encode_frame_batch`) — the
+        compatibility path; the durable backend serves disk bytes
+        directly.  Returns (frame_bytes, start_offset) or None."""
+        from ..ops.framing import encode_frame_batch
+
+        idx = offset - self.base_offset
+        if idx >= len(self.log):
+            return None
+        out = []
+        size = 0
+        i = idx
+        while i < len(self.log) and size < max_bytes:
+            key, value, ts, hdrs = self.log[i]
+            out.append((offset + (i - idx), key, value, ts, hdrs))
+            size += (len(value) if value else 0) + \
+                (len(key) if key else 0) + 64
+            i += 1
+        return encode_frame_batch(out), offset
+
     def drop_head(self, count: int) -> None:
         for key, value, _ts, _h in self.log[:count]:
             self.bytes -= (len(value) if value else 0) + \
@@ -270,6 +314,9 @@ class _DurablePartition:
 
     def read(self, offset: int, max_messages: int) -> List[tuple]:
         return self.slog.read_from(offset, max_messages)
+
+    def read_raw(self, offset: int, max_bytes: int) -> Optional[tuple]:
+        return self.slog.read_raw(offset, max_bytes)
 
     def enforce_retention(self, spec: TopicSpec) -> None:
         pol = self.slog.policy
@@ -628,6 +675,40 @@ class Broker:
                                             part.base()) from None
         return [Message(topic, partition, off, value, key, ts, hdrs)
                 for off, key, value, ts, hdrs in chunk]
+
+    def fetch_raw(self, topic: str, partition: int, offset: int,
+                  max_bytes: int = 1 << 20):
+        """Raw-batch fetch: up to ~max_bytes of CONTIGUOUS store-format
+        frames from `offset`, as a `RawFrameBatch` — no materialised
+        `Message` list, no per-record Python objects.  The durable
+        backend serves the segment's own disk bytes (outside the broker
+        lock, like `fetch`); the in-memory emulator re-frames its list
+        slice through the one frame codec.  Returns None at/after the
+        log end; raises OffsetOutOfRangeError below the retained base
+        (same contract as `fetch`)."""
+        from ..ops.framing import RawFrameBatch
+
+        chaos.point("broker.fetch")  # the same faultpoint as fetch: a
+        # raw batch is still one fetch to the chaos schedule
+        part = self._parts[topic][partition]
+        with self._lock:
+            base = part.base()
+            if offset < base:
+                raise OffsetOutOfRangeError(topic, partition, offset, base)
+            if isinstance(part, _Partition):
+                res = part.read_raw(offset, max_bytes)
+            else:
+                res = False  # durable: disk I/O outside the lock (below)
+        if res is False:
+            try:
+                res = part.read_raw(offset, max_bytes)
+            except LookupError:
+                raise OffsetOutOfRangeError(topic, partition, offset,
+                                            part.base()) from None
+        if res is None:
+            return None
+        data, start = res
+        return RawFrameBatch(topic, partition, start, data)
 
     # ------------------------------------------------------------- replay
     def offset_for_timestamp(self, topic: str, partition: int,

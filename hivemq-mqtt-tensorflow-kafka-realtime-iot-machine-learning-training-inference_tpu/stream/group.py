@@ -429,6 +429,19 @@ class GroupConsumer:
                                      max_messages=max_messages,
                                      with_keys=with_keys)
 
+    def poll_into(self, decoder, out_numeric, out_labels, out_keys=None,
+                  max_rows: int = 4096, max_bytes: int = 1 << 20):
+        """StreamConsumer-compatible columnar raw-batch poll over the
+        *assigned* partitions (see consumer.StreamConsumer.poll_into) —
+        the zero-copy pipeline runs group-elastic without code
+        changes."""
+        if getattr(self.broker, "fetch_raw", None) is None:
+            return None
+        self._ensure_membership()
+        return self._sc.poll_into(decoder, out_numeric, out_labels,
+                                  out_keys=out_keys, max_rows=max_rows,
+                                  max_bytes=max_bytes)
+
     def at_end(self) -> bool:
         return self._sc.at_end()
 

@@ -45,6 +45,15 @@ OFFSET_COMMIT, OFFSET_FETCH = 8, 9
 FIND_COORDINATOR, JOIN_GROUP, HEARTBEAT, LEAVE_GROUP, SYNC_GROUP = \
     10, 11, 12, 13, 14
 SASL_HANDSHAKE, API_VERSIONS, CREATE_TOPICS = 17, 18, 19
+# Emulator-family protocol extension (key far outside Kafka's range,
+# like the retention.messages config entry): a fetch whose response is
+# the broker's RAW store-frame bytes — [len|crc|attrs|offset|ts|key|
+# value|headers] frames verbatim (ops.framing.RawFrameBatch) — so the
+# consumer's columnar decoder runs over ONE buffer with zero
+# per-record work on either side of the socket.  Standard Kafka
+# clients never send it; standard servers answer UNSUPPORTED_VERSION
+# and the client falls back to classic FETCH.
+RAW_FETCH = 64
 
 # error codes
 ERR_NONE = 0
@@ -67,7 +76,7 @@ _SUPPORTED = {PRODUCE: (2, 2), FETCH: (2, 2), LIST_OFFSETS: (1, 1),
               FIND_COORDINATOR: (0, 0), JOIN_GROUP: (0, 0),
               HEARTBEAT: (0, 0), LEAVE_GROUP: (0, 0), SYNC_GROUP: (0, 0),
               SASL_HANDSHAKE: (0, 0), API_VERSIONS: (0, 0),
-              CREATE_TOPICS: (0, 0)}
+              CREATE_TOPICS: (0, 0), RAW_FETCH: (0, 0)}
 
 # APIs the client may auto-retry after a reconnect (see _request): a
 # duplicate of any of these is invisible (pure reads) or a no-op
@@ -76,9 +85,9 @@ _SUPPORTED = {PRODUCE: (2, 2), FETCH: (2, 2), LIST_OFFSETS: (1, 1),
 # server before it died, so a blind retry double-applies; those surface
 # ConnectionError and the caller owns redelivery.  The R2 lint
 # (iotml.analysis) holds every _request call site to this list.
-IDEMPOTENT_APIS = frozenset({FETCH, METADATA, LIST_OFFSETS, OFFSET_FETCH,
-                             API_VERSIONS, SASL_HANDSHAKE, HEARTBEAT,
-                             FIND_COORDINATOR})
+IDEMPOTENT_APIS = frozenset({FETCH, RAW_FETCH, METADATA, LIST_OFFSETS,
+                             OFFSET_FETCH, API_VERSIONS, SASL_HANDSHAKE,
+                             HEARTBEAT, FIND_COORDINATOR})
 
 
 class SaslAuthError(ConnectionError):
@@ -855,6 +864,44 @@ class KafkaWireBroker(ProducePartitionMixin):
                                            key, ts))
         return out
 
+    def fetch_raw(self, topic: str, partition: int, offset: int,
+                  max_bytes: int = 1 << 20):
+        """Raw-batch fetch over the wire: the broker's store-format
+        frame bytes, verbatim, as one `RawFrameBatch` — the consumer's
+        columnar decoder does ALL record work on one buffer (zero
+        per-record objects client-side, zero MessageSet re-encode
+        server-side for durable brokers).  Returns None at/after the
+        log end or against a server without the RAW_FETCH extension
+        (callers fall back to classic fetch)."""
+        from ..ops.framing import RawFrameBatch
+
+        w = _Writer()
+        w.string(topic).i32(partition).i64(offset).i32(max_bytes)
+        r = self._request(RAW_FETCH, 0, bytes(w.buf))
+        err = r.i16()
+        if err == ERR_UNSUPPORTED_VERSION:
+            # pre-extension (or relay) server: the response carries no
+            # further fields.  Raised — not None — so consumers DISABLE
+            # the columnar path instead of mistaking it for log end.
+            raise NotImplementedError(
+                "server lacks the RAW_FETCH extension")
+        aux = r.i64()  # start offset; earliest-retained for error 1
+        blob = r.bytes_()
+        if not blob and err == ERR_NONE:
+            return None  # log end
+        if err == ERR_OFFSET_OUT_OF_RANGE:
+            raise OffsetOutOfRangeError(topic, partition, offset,
+                                        max(aux, 0))
+        if err == ERR_UNKNOWN_TOPIC:
+            raise KeyError(topic)
+        if err == ERR_NOT_LEADER_FOR_PARTITION:
+            raise NotLeaderForPartitionError(topic, partition)
+        if err != ERR_NONE:
+            raise RuntimeError(f"raw fetch {topic}:{partition}: {err}")
+        if blob is None:
+            return None
+        return RawFrameBatch(topic, partition, int(aux), blob)
+
     # ------------------------------------------------------------- offsets
     def _list_offset(self, topic: str, partition: int, timestamp: int) -> int:
         w = _Writer()
@@ -1442,6 +1489,42 @@ class _KafkaConn(socketserver.BaseRequestHandler):
             w.array(resp, lambda wr, t: (wr.string(t[0]), wr.array(
                 t[1], lambda pw, p: pw.i32(p[0]).i16(p[1]).i64(p[2])
                 .bytes_(p[3]))))
+        elif api_key == RAW_FETCH:
+            # emulator-family extension: one partition, the broker's raw
+            # store-frame bytes verbatim — no MessageSet re-encode, no
+            # per-record server work (durable brokers serve the
+            # segment's own disk bytes)
+            tname = r.string()
+            pid = r.i32()
+            offset = r.i64()
+            max_bytes = r.i32()
+            fetch_raw = getattr(broker, "fetch_raw", None)
+            if not self._valid_part(broker, tname, pid):
+                w.i16(ERR_UNKNOWN_TOPIC).i64(-1).bytes_(None)
+            elif fetch_raw is None:  # relay broker without raw reads
+                w.i16(ERR_UNSUPPORTED_VERSION)
+            else:
+                try:
+                    raw = fetch_raw(tname, pid, offset,
+                                    max_bytes=max(max_bytes, 4096))
+                except NotImplementedError:
+                    # a RELAY broker (wire client / cluster route) whose
+                    # upstream lacks the extension: same downgrade
+                    # answer as a pre-extension server, so the client
+                    # pins back to classic FETCH instead of dying on a
+                    # severed connection
+                    w.i16(ERR_UNSUPPORTED_VERSION)
+                except NotLeaderForPartitionError:
+                    w.i16(ERR_NOT_LEADER_FOR_PARTITION).i64(-1).bytes_(None)
+                except OffsetOutOfRangeError as e:
+                    w.i16(ERR_OFFSET_OUT_OF_RANGE).i64(e.earliest)
+                    w.bytes_(None)
+                else:
+                    if raw is None:
+                        w.i16(ERR_NONE).i64(offset).bytes_(b"")
+                    else:
+                        w.i16(ERR_NONE).i64(raw.start_offset)
+                        w.bytes_(raw.data)
         elif api_key == LIST_OFFSETS:
             r.i32()  # replica
 

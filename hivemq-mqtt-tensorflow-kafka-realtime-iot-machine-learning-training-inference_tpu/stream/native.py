@@ -42,7 +42,7 @@ def _build() -> bool:
         return False
 
 
-ENGINE_VERSION = 6  # must match iotml_engine_version() in avro_engine.cc
+ENGINE_VERSION = 7  # must match iotml_engine_version() in avro_engine.cc
 
 
 def _stale() -> bool:
@@ -86,6 +86,7 @@ def load() -> Optional[ctypes.CDLL]:
         lib.iotml_encode_batch_nulls.restype = ctypes.c_int64
         lib.iotml_format_rows_f32.restype = ctypes.c_int64
         lib.iotml_format_rows_f64.restype = ctypes.c_int64
+        lib.iotml_frames_decode_columnar.restype = ctypes.c_int64
         _lib = lib
     except (OSError, AttributeError):
         _lib = None
@@ -236,6 +237,13 @@ class NativeCodec:
             raise ValueError("json batch decode rejected arguments")
         return numeric, labels[:, : self.n_strings], nulls, fallback
 
+    # ------------------------------------------------------------- frames
+    def frame_decoder(self, pinned_id_limit: Optional[int] = None
+                      ) -> "FrameDecoder":
+        """The store-frame columnar decoder compiled for this schema —
+        the zero-copy pipeline's single decode entry point."""
+        return FrameDecoder(self, pinned_id_limit=pinned_id_limit)
+
     # ------------------------------------------------------------- encode
     def encode_batch(self, numeric: np.ndarray, labels: Optional[np.ndarray],
                      schema_id: int = -1, stride: int = LABEL_STRIDE,
@@ -277,3 +285,103 @@ class NativeCodec:
             raise ValueError("encode rejected (overflow or impossible null)")
         raw = out.tobytes()
         return [raw[offsets[i]:offsets[i + 1]] for i in range(n)]
+
+
+#: flag bits reported by the frame decoder (frame_engine.cc FrameFlags)
+FRAMES_STOP_TORN = 1     # torn/corrupt frame parked the scan (recovery)
+FRAMES_STOP_SCHEMA = 2   # Confluent writer id != the pinned reader id
+
+#: default bytes per row for message keys in columnar decode (matches
+#: NativeKafkaBroker.KEY_STRIDE: MQTT-topic car keys fit with room)
+KEY_STRIDE = 64
+
+
+class FrameDecoder:
+    """Columnar decoder over raw store-frame batches (frame_engine.cc).
+
+    ONE decode entry point for the zero-copy data plane: live consume
+    (`StreamConsumer.poll_into`) and timestamp-replay backfill both land
+    here, over the same `[len|crc|attrs|offset|ts|key|value|headers]`
+    frame bytes the segmented log persists and the wire's RAW_FETCH
+    ships — so the two paths cannot drift.  Decodes into CALLER-OWNED
+    preallocated float32/label/key buffers (`data.pipeline.DecodeRing`
+    slots): zero per-record Python objects, zero per-chunk buffer churn.
+
+    `pinned_id_limit` is the exclusive upper bound on positionally-safe
+    Confluent writer ids (default: `stream.registry.RESERVED_ID_BASE`,
+    the band where evolved writer schemas live): an evolved writer's
+    frame — or a non-Confluent payload — stops the scan with
+    `FRAMES_STOP_SCHEMA` and the caller resolves that chunk by name in
+    Python instead of mis-reading it positionally.
+    """
+
+    def __init__(self, codec: NativeCodec,
+                 pinned_id_limit: Optional[int] = None):
+        from .registry import RESERVED_ID_BASE
+
+        self.codec = codec
+        self.pinned_id_limit = RESERVED_ID_BASE \
+            if pinned_id_limit is None else int(pinned_id_limit)
+        self._lib = codec._lib
+
+    @property
+    def n_numeric(self) -> int:
+        return self.codec.n_numeric
+
+    @property
+    def n_strings(self) -> int:
+        return self.codec.n_strings
+
+    def decode_into(self, buf, start_offset: int, out_numeric: np.ndarray,
+                    out_labels: np.ndarray,
+                    out_keys: Optional[np.ndarray] = None,
+                    cap_rows: Optional[int] = None
+                    ) -> Tuple[int, int, int, int]:
+        """Decode raw frame bytes into the caller's column buffers.
+
+        Args:
+          buf: contiguous frame bytes (bytes/memoryview/bytearray) — a
+            segment byte range, a RAW_FETCH payload, or the emulator's
+            re-framed batch; may start below `start_offset` (skipped)
+            and end mid-frame (ends the batch).
+          start_offset: frames below this log offset are skipped.
+          out_numeric: [cap, n_numeric] float32 C-contiguous.
+          out_labels: [cap, n_strings] S-stride C-contiguous.
+          out_keys: optional [cap] S-stride (message keys, truncated at
+            stride-1 like the fused native path).
+        Returns (rows, next_offset, flags, skipped_tombstones).
+        """
+        codec = self.codec
+        cap = out_numeric.shape[0] if cap_rows is None \
+            else min(int(cap_rows), out_numeric.shape[0])
+        if out_labels.shape[0] < cap or \
+                (out_keys is not None and out_keys.shape[0] < cap):
+            raise ValueError("label/key buffers shorter than cap_rows")
+        if isinstance(buf, (bytearray, memoryview)):
+            buf = bytes(buf)  # borderline callers; the hot paths hand bytes
+        c_buf = ctypes.cast(ctypes.c_char_p(buf),
+                            ctypes.POINTER(ctypes.c_uint8))  # zero-copy
+        next_off = ctypes.c_int64(start_offset)
+        flags = ctypes.c_int64(0)
+        skipped = ctypes.c_int64(0)
+        label_stride = out_labels.dtype.itemsize
+        rows = self._lib.iotml_frames_decode_columnar(
+            c_buf,
+            ctypes.c_int64(len(buf)), ctypes.c_int64(int(start_offset)),
+            codec.types.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+            codec.nullable.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.c_int64(codec.n_fields),
+            ctypes.c_int64(self.pinned_id_limit),
+            out_numeric.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            out_labels.ctypes.data_as(ctypes.c_char_p),
+            ctypes.c_int64(label_stride),
+            out_keys.ctypes.data_as(ctypes.c_char_p)
+            if out_keys is not None else None,
+            ctypes.c_int64(out_keys.dtype.itemsize
+                           if out_keys is not None else 0),
+            ctypes.c_int64(cap), ctypes.byref(next_off),
+            ctypes.byref(flags), ctypes.byref(skipped))
+        if rows < 0:
+            raise ValueError("frame decoder rejected arguments")
+        return int(rows), int(next_off.value), int(flags.value), \
+            int(skipped.value)

@@ -25,7 +25,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .broker import Message, OffsetOutOfRangeError, TopicSpec
+from .broker import (Message, OffsetOutOfRangeError, SchemaIdMismatchError,
+                     TopicSpec)
 from .kafka_wire import NotLeaderForPartitionError, ProducePartitionMixin
 from .native import LABEL_STRIDE, NativeCodec, load
 
@@ -100,6 +101,8 @@ def _sig(lib) -> None:
         fn = getattr(lib, f"iotml_kafka_{name}")
         fn.restype = ctypes.c_int64
         fn.argtypes = argtypes
+    lib.iotml_kafka_set_pinned_id_limit.restype = None
+    lib.iotml_kafka_set_pinned_id_limit.argtypes = [c.c_void_p, c.c_int64]
 
 
 class NativeKafkaBroker(ProducePartitionMixin):
@@ -109,7 +112,8 @@ class NativeKafkaBroker(ProducePartitionMixin):
                  sasl_username: Optional[str] = None,
                  sasl_password: Optional[str] = None,
                  timeout_s: float = 30.0,
-                 key_stride: Optional[int] = None):
+                 key_stride: Optional[int] = None,
+                 pinned_id_limit: Optional[int] = None):
         #: bytes per row reserved for message keys in fetch_decode_keys;
         #: raise it where per-entity consumers join on keys longer than
         #: the MQTT-topic defaults (a truncated key aliases two cars).
@@ -141,6 +145,19 @@ class NativeKafkaBroker(ProducePartitionMixin):
             raise ConnectionError(
                 f"native kafka connect to {servers} failed"
                 + (" (SASL)" if sasl_username else ""))
+        # Runtime guard on the fused strip=5 decode (ON by default):
+        # writer-schema ids at/above the reserved band
+        # (stream.registry.RESERVED_ID_BASE) mark EVOLVED schemas a
+        # positional v1 decode would silently mis-read — fetch_decode
+        # stops before such a frame and raises SchemaIdMismatchError so
+        # the consumer resolves that chunk by name in Python.  Pass
+        # pinned_id_limit=-1 to restore the legacy blind strip.
+        if pinned_id_limit is None:
+            from .registry import RESERVED_ID_BASE
+
+            pinned_id_limit = RESERVED_ID_BASE
+        self.pinned_id_limit = int(pinned_id_limit)
+        lib.iotml_kafka_set_pinned_id_limit(self._h, self.pinned_id_limit)
         self._meta: Dict[str, int] = {}
         self._rr: Dict[str, int] = {}
         # One socket + one C-side staged buffer per handle: serialize every
@@ -333,6 +350,8 @@ class NativeKafkaBroker(ProducePartitionMixin):
                 labels.ctypes.data_as(ctypes.c_char_p),
                 ctypes.c_int64(LABEL_STRIDE), ctypes.c_int64(max_rows),
                 ctypes.byref(next_off))
+            if rc == -1999:
+                raise SchemaIdMismatchError(topic, partition, offset)
             if rc <= -2000:
                 raise ValueError(f"malformed Avro message at row {-(rc + 2000) - 1}")
             if rc == -1003:
@@ -374,6 +393,8 @@ class NativeKafkaBroker(ProducePartitionMixin):
                 keys.ctypes.data_as(ctypes.c_char_p),
                 ctypes.c_int64(self.KEY_STRIDE),
                 ctypes.c_int64(max_rows), ctypes.byref(next_off))
+            if rc == -1999:
+                raise SchemaIdMismatchError(topic, partition, offset)
             if rc <= -2000:
                 raise ValueError(
                     f"malformed Avro message at row {-(rc + 2000) - 1}")

@@ -1,0 +1,19 @@
+"""R14 fixture: hand-rolled frame parsing outside iotml/store/ +
+iotml/ops/framing.py — the [len|crc|attrs|offset|ts|key|value|headers]
+layout has ONE parser."""
+
+import struct
+
+from iotml.store import segment as seg
+
+_MY_HEAD = struct.Struct(">IBqqi")  # BAD: hand-rolled frame head
+
+
+def sniff(buf: bytes):
+    for rec in seg.scan_records(buf):  # BAD: store codec outside store/
+        yield rec
+
+
+def rewrite(offset, key, value):
+    # BAD: frame encoding outside the store / framing helpers
+    return seg.encode_record(offset, key, value, 0, None)

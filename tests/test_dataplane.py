@@ -1,0 +1,595 @@
+"""Zero-copy columnar data plane (ISSUE 10): raw frame batches, the ONE
+frame decoder, byte-parity against the python codec oracle, the v1
+runtime guard, replay==live decoder sharing, and the zero-per-record
+allocation contract."""
+
+import gc
+import tracemalloc
+
+import numpy as np
+import pytest
+
+from iotml.core.schema import (CAR_SCHEMA_V2_ID, KSQL_CAR_SCHEMA,
+                               KSQL_CAR_SCHEMA_V2)
+from iotml.data.dataset import SensorBatches
+from iotml.gen.simulator import FleetGenerator, FleetScenario
+from iotml.ops import framing
+from iotml.ops.avro import AvroCodec
+from iotml.store import segment as seg
+from iotml.stream.broker import Broker, SchemaIdMismatchError
+from iotml.stream.consumer import StreamConsumer
+from iotml.stream import native as native_mod
+
+NATIVE = native_mod.available()
+needs_native = pytest.mark.skipif(not NATIVE,
+                                  reason="C++ engine not built")
+
+CODEC = AvroCodec(KSQL_CAR_SCHEMA)
+V2_CODEC = AvroCodec(KSQL_CAR_SCHEMA_V2)
+
+
+def _record(rng, label="false", with_nulls=False, nan_field=None):
+    rec = {}
+    for f in KSQL_CAR_SCHEMA.fields:
+        if f.name == "FAILURE_OCCURRED":
+            rec[f.name] = label
+        elif f.avro_type in ("int", "long"):
+            rec[f.name] = int(rng.integers(0, 40))
+        else:
+            rec[f.name] = float(rng.normal())
+    if with_nulls:
+        rec["SPEED"] = None
+        rec["COOLANT_TEMP"] = None
+    if nan_field:
+        rec[nan_field] = float("nan")
+    return rec
+
+
+def _v2_record(rng, region="eu-west", label="true"):
+    rec = _record(rng, label=label)
+    rec["REGION"] = region
+    return rec
+
+
+def _seeded_frames(rng, n=64, base_offset=0, schema_id=1,
+                   tombstone_at=(), v2_at=(), keyfn=None):
+    """Seeded store frames: v1 payloads with nulls/NaN sprinkled in,
+    optional tombstones and v2 (evolved-writer) frames."""
+    frames, truth = [], []
+    off = base_offset
+    for i in range(n):
+        key = (keyfn(i) if keyfn else f"car-{i % 7}".encode())
+        if i in tombstone_at:
+            frames.append(seg.encode_record(off, key, None, 1000 + i,
+                                            None))
+            truth.append(("tombstone", None))
+        elif i in v2_at:
+            rec = _v2_record(rng)
+            payload = framing.frame(V2_CODEC.encode(rec),
+                                    CAR_SCHEMA_V2_ID)
+            frames.append(seg.encode_record(off, key, payload, 1000 + i,
+                                            None))
+            truth.append(("v2", rec))
+        else:
+            rec = _record(rng, label=("true" if i % 9 == 0 else "false"),
+                          with_nulls=(i % 11 == 0),
+                          nan_field="THROTTLE_POS" if i % 13 == 0
+                          else None)
+            payload = framing.frame(CODEC.encode(rec), schema_id)
+            frames.append(seg.encode_record(off, key, payload, 1000 + i,
+                                            None))
+            truth.append(("v1", rec))
+        off += 1
+    return b"".join(frames), truth
+
+
+# --------------------------------------------------------- parity oracle
+@needs_native
+def test_frame_decoder_matches_python_oracle_bit_exact():
+    """Native columnar decode == the pure-python oracle, bit for bit —
+    values (incl. NaN and nulls), labels, keys, cursor, stop flags and
+    tombstone skips, over seeded chunks with a v1/v2 mix."""
+    rng = np.random.default_rng(7)
+    buf, _ = _seeded_frames(rng, n=96, base_offset=5,
+                            tombstone_at={10, 40}, v2_at={77})
+    nc = native_mod.NativeCodec(KSQL_CAR_SCHEMA)
+    dec = nc.frame_decoder()
+    for start in (5, 9, 30):
+        x = np.zeros((256, nc.n_numeric), np.float32)
+        lab = np.zeros((256, nc.n_strings), f"S{native_mod.LABEL_STRIDE}")
+        keys = np.zeros((256,), f"S{native_mod.KEY_STRIDE}")
+        rows, nxt, flags, skipped = dec.decode_into(buf, start, x, lab,
+                                                    keys)
+        onum, olab, okeys, onext, oflags, oskip = \
+            framing.decode_frames_columnar_py(
+                buf, start, KSQL_CAR_SCHEMA, with_keys=True,
+                label_stride=native_mod.LABEL_STRIDE,
+                key_stride=native_mod.KEY_STRIDE)
+        assert (rows, nxt, flags, skipped) == \
+            (onum.shape[0], onext, oflags, oskip)
+        assert flags & framing.FRAMES_STOP_SCHEMA  # parked at the v2 frame
+        assert np.array_equal(x[:rows], onum, equal_nan=True)
+        assert np.array_equal(lab[:rows], olab)
+        assert np.array_equal(keys[:rows], okeys)
+
+
+@needs_native
+def test_frame_decoder_matches_full_python_codec():
+    """Ground truth: the columnar float32 output equals the v1 python
+    codec's float64 decode cast to float32 (single rounding both ways)."""
+    rng = np.random.default_rng(11)
+    buf, truth = _seeded_frames(rng, n=50)
+    nc = native_mod.NativeCodec(KSQL_CAR_SCHEMA)
+    dec = nc.frame_decoder()
+    x = np.zeros((64, nc.n_numeric), np.float32)
+    lab = np.zeros((64, nc.n_strings), f"S{native_mod.LABEL_STRIDE}")
+    rows, _, _, _ = dec.decode_into(buf, 0, x, lab)
+    assert rows == 50
+    payloads = []
+    for _pos, _end, _off, _key, value, _ts, _h in seg.scan_records(buf):
+        payloads.append(framing.strip_frame(value))
+    cols = CODEC.decode_batch(payloads)
+    want = CODEC.sensor_matrix(cols).astype(np.float32)
+    assert np.array_equal(x[:rows], want, equal_nan=True)
+    labels = [("" if r["FAILURE_OCCURRED"] is None
+               else r["FAILURE_OCCURRED"]) for _k, r in truth]
+    col = [f.name for f in KSQL_CAR_SCHEMA.fields
+           if f.avro_type == "string"].index("FAILURE_OCCURRED")
+    got = [s.decode() for s in lab[:rows, col]]
+    assert got == labels
+
+
+@needs_native
+def test_torn_tail_ends_batch_like_recovery():
+    rng = np.random.default_rng(3)
+    buf, _ = _seeded_frames(rng, n=20)
+    nc = native_mod.NativeCodec(KSQL_CAR_SCHEMA)
+    dec = nc.frame_decoder()
+    x = np.zeros((32, nc.n_numeric), np.float32)
+    lab = np.zeros((32, nc.n_strings), f"S{native_mod.LABEL_STRIDE}")
+    cut = buf[: int(len(buf) * 0.6)]
+    rows, nxt, flags, _ = dec.decode_into(cut, 0, x, lab)
+    o = framing.decode_frames_columnar_py(cut, 0, KSQL_CAR_SCHEMA)
+    assert (rows, nxt, flags) == (o[0].shape[0], o[3], o[4])
+    assert flags & framing.FRAMES_STOP_TORN
+    assert 0 < rows < 20
+
+
+# ------------------------------------------------ end-to-end batch parity
+def _fill(broker, n_ticks=40, num_cars=25, failure_rate=0.08):
+    gen = FleetGenerator(FleetScenario(num_cars=num_cars,
+                                      failure_rate=failure_rate))
+    return gen.publish(broker, "SENSOR_DATA_S_AVRO", n_ticks=n_ticks)
+
+
+def _batches(broker, force_python=False, **kw):
+    consumer = StreamConsumer(broker, ["SENSOR_DATA_S_AVRO:0:0"],
+                              group=kw.pop("group", "g"))
+    sb = SensorBatches(consumer, batch_size=100, keep_labels=True,
+                       keep_keys=True, **kw)
+    if force_python:
+        sb._native = None  # the pure codec is the oracle
+        sb._ring = False
+    return list(sb), sb
+
+
+@needs_native
+def test_columnar_batches_equal_python_codec_batches(tmp_path):
+    """The acceptance oracle: columnar-native over a durable broker ==
+    the pure-python codec path over the same records — values, labels,
+    keys, batch boundaries."""
+    broker = Broker(store_dir=str(tmp_path / "store"))
+    _fill(broker)
+    cols, sb = _batches(broker, group="columnar")
+    assert isinstance(sb._ring, object) and sb._ring not in (None, False)
+    pys, _ = _batches(broker, force_python=True, group="python")
+    assert len(cols) == len(pys) and len(cols) > 5
+    for a, b in zip(cols, pys):
+        assert a.n_valid == b.n_valid
+        assert np.array_equal(a.x, b.x, equal_nan=True)
+        assert list(a.labels) == list(b.labels)
+        assert np.array_equal(a.keys, b.keys)
+    broker.close()
+
+
+@needs_native
+def test_columnar_skips_tombstones(tmp_path):
+    broker = Broker(store_dir=str(tmp_path / "store"))
+    rng = np.random.default_rng(5)
+    for i in range(30):
+        broker.produce("SENSOR_DATA_S_AVRO",
+                       framing.frame(CODEC.encode(_record(rng)), 1),
+                       key=b"car-1", timestamp_ms=i)
+    broker.produce("SENSOR_DATA_S_AVRO", None, key=b"car-1",
+                   timestamp_ms=31)  # tombstone mid-stream
+    for i in range(10):
+        broker.produce("SENSOR_DATA_S_AVRO",
+                       framing.frame(CODEC.encode(_record(rng)), 1),
+                       key=b"car-2", timestamp_ms=40 + i)
+    batches, sb = _batches(broker, pad_tail=True)
+    assert sb._ring not in (None, False)
+    assert sum(b.n_valid for b in batches) == 40  # tombstone skipped
+    broker.close()
+
+
+# --------------------------------------------------------- the v1 guard
+@needs_native
+def test_v2_writer_never_misread_on_columnar_path(tmp_path):
+    """A v2 (evolved) writer's frames on the topic: the columnar path
+    must detour those chunks through name resolution — labels stay
+    labels (REGION never read positionally as FAILURE_OCCURRED)."""
+    broker = Broker(store_dir=str(tmp_path / "store"))
+    rng = np.random.default_rng(9)
+    labels = []
+    for i in range(260):
+        if 100 <= i < 140:  # a rolling-upgrade window of v2 frames
+            rec = _v2_record(rng, label="true" if i % 2 else "false")
+            payload = framing.frame(V2_CODEC.encode(rec),
+                                    CAR_SCHEMA_V2_ID)
+            labels.append(rec["FAILURE_OCCURRED"])
+        else:
+            rec = _record(rng, label="true" if i % 5 == 0 else "false")
+            payload = framing.frame(CODEC.encode(rec), 1)
+            labels.append(rec["FAILURE_OCCURRED"])
+        broker.produce("SENSOR_DATA_S_AVRO", payload, key=b"car",
+                       timestamp_ms=i)
+    batches, sb = _batches(broker)
+    assert sb._ring not in (None, False)
+    got = [lab for b in batches for lab in b.labels[: b.n_valid]]
+    assert got == labels  # the v1 read would have seen "eu-west" here
+    assert sum(b.n_valid for b in batches) == 260
+    broker.close()
+
+
+@needs_native
+def test_v2_guard_fused_wire_path(tmp_path):
+    """The fused NativeKafkaBroker.fetch_decode path raises
+    SchemaIdMismatchError at an evolved frame instead of blind-stripping
+    it, and SensorBatches decodes the mixed topic correctly anyway."""
+    from iotml.stream.kafka_wire import KafkaWireServer
+    from iotml.stream.native_kafka import NativeKafkaBroker
+
+    broker = Broker()
+    rng = np.random.default_rng(13)
+    labels = []
+    for i in range(60):
+        if 20 <= i < 30:
+            rec = _v2_record(rng, label="true")
+            payload = framing.frame(V2_CODEC.encode(rec),
+                                    CAR_SCHEMA_V2_ID)
+        else:
+            rec = _record(rng, label="false")
+            payload = framing.frame(CODEC.encode(rec), 1)
+        labels.append(rec["FAILURE_OCCURRED"])
+        broker.produce("SENSOR_DATA_S_AVRO", payload, timestamp_ms=i)
+    with KafkaWireServer(broker) as srv:
+        nb = NativeKafkaBroker(f"127.0.0.1:{srv.port}")
+        nc = native_mod.NativeCodec(KSQL_CAR_SCHEMA)
+        with pytest.raises(SchemaIdMismatchError):
+            # from offset 20 the first frame is evolved: the guard trips
+            nb.fetch_decode("SENSOR_DATA_S_AVRO", 0, 20, nc, strip=5)
+        # a fetch below decodes only the verified prefix
+        num, _lab, nxt = nb.fetch_decode("SENSOR_DATA_S_AVRO", 0, 0, nc,
+                                         strip=5)
+        assert len(num) == 20 and nxt == 20
+        consumer = StreamConsumer(nb, ["SENSOR_DATA_S_AVRO:0:0"],
+                                  group="wire")
+        sb = SensorBatches(consumer, batch_size=10, keep_labels=True)
+        got = [lab for b in sb for lab in b.labels[: b.n_valid]]
+        assert got == labels
+        nb.close()
+
+
+# ------------------------------------------- replay == live, ONE decoder
+@needs_native
+def test_replay_and_live_share_one_decoder(tmp_path, monkeypatch):
+    """Timestamp-replay backfill and live consume produce identical
+    batches AND both enter through FrameDecoder.decode_into — the one
+    decode entry point (counted via monkeypatch)."""
+    broker = Broker(store_dir=str(tmp_path / "store"))
+    rng = np.random.default_rng(17)
+    for i in range(300):
+        broker.produce("SENSOR_DATA_S_AVRO",
+                       framing.frame(CODEC.encode(_record(rng)), 1),
+                       key=b"car", timestamp_ms=1_000 + i)
+    calls = []
+    orig = native_mod.FrameDecoder.decode_into
+
+    def counted(self, *a, **kw):
+        calls.append(1)
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(native_mod.FrameDecoder, "decode_into", counted)
+
+    live_consumer = StreamConsumer(broker, ["SENSOR_DATA_S_AVRO:0:0"],
+                                   group="live")
+    live = list(SensorBatches(live_consumer, batch_size=50))
+    live_calls = len(calls)
+    assert live_calls > 0
+
+    replay_consumer = StreamConsumer(broker, ["SENSOR_DATA_S_AVRO:0:0"],
+                                     group="replay")
+    replay_consumer.seek_to_timestamp(1_100)  # backfill from mid-stream
+    replay = list(SensorBatches(replay_consumer, batch_size=50))
+    assert len(calls) > live_calls  # replay used the SAME entry point
+
+    # replay batches == the live batches past the timestamp cut
+    live_rows = np.concatenate([b.x[: b.n_valid] for b in live])
+    replay_rows = np.concatenate([b.x[: b.n_valid] for b in replay])
+    assert np.array_equal(replay_rows, live_rows[100:], equal_nan=True)
+    broker.close()
+
+
+# ------------------------------------------ zero per-record allocations
+@needs_native
+def test_zero_per_record_python_objects_on_fast_path(tmp_path):
+    """Allocation counting: decoding 16x more records through the
+    columnar fast path must NOT allocate ~16x more Python objects —
+    the per-chunk cost is O(1) buffers, never per-record objects."""
+    broker = Broker(store_dir=str(tmp_path / "store"))
+    rng = np.random.default_rng(23)
+    for i in range(2048):
+        broker.produce("SENSOR_DATA_S_AVRO",
+                       framing.frame(CODEC.encode(_record(rng)), 1),
+                       key=b"car", timestamp_ms=i)
+    nc = native_mod.NativeCodec(KSQL_CAR_SCHEMA)
+    dec = nc.frame_decoder()
+    x = np.zeros((2048, nc.n_numeric), np.float32)
+    lab = np.zeros((2048, nc.n_strings), f"S{native_mod.LABEL_STRIDE}")
+
+    def count_allocs(rows):
+        consumer = StreamConsumer(broker, ["SENSOR_DATA_S_AVRO:0:0"],
+                                  group=f"alloc-{rows}")
+        consumer.poll_into(dec, x, lab, max_rows=8)  # warm caches
+        gc.collect()
+        tracemalloc.start()
+        got, _ = consumer.poll_into(dec, x, lab, max_rows=rows)
+        snap = tracemalloc.take_snapshot()
+        tracemalloc.stop()
+        assert got == rows
+        return sum(s.count for s in snap.statistics("filename"))
+
+    small = count_allocs(128)
+    big = count_allocs(2040)
+    # 16x the records must stay within ~2x the allocations (noise), not
+    # scale linearly: the fast path holds zero per-record objects
+    assert big < small * 2 + 64, (small, big)
+    broker.close()
+
+
+@needs_native
+def test_traced_sessions_keep_the_header_path_in_process(tmp_path,
+                                                         monkeypatch):
+    """Record headers (the trace carrier) only exist on the in-process
+    broker and the columnar path never materialises them: with tracing
+    ON, a durable in-process consumer must stay on the message path so
+    the span-log invariants (chaos/obs) keep their 'consume' spans."""
+    from iotml.obs import tracing
+
+    broker = Broker(store_dir=str(tmp_path / "store"))
+    _fill(broker, n_ticks=4)
+    monkeypatch.setattr(tracing, "ENABLED", True)
+    _, sb = _batches(broker, group="traced")
+    assert sb._ring in (None, False)  # columnar declined, headers flow
+    monkeypatch.setattr(tracing, "ENABLED", False)
+    _, sb2 = _batches(broker, group="untraced")
+    assert sb2._ring not in (None, False)
+    broker.close()
+
+
+# ------------------------------------------------- raw fetch + the wire
+def test_fetch_raw_contract_in_memory_and_durable(tmp_path):
+    rng = np.random.default_rng(29)
+    for durable in (False, True):
+        broker = Broker(store_dir=str(tmp_path / "s2") if durable
+                        else None)
+        broker.create_topic("T", retention_messages=None)
+        for i in range(20):
+            broker.produce(
+                "T", framing.frame(CODEC.encode(_record(rng)), 1),
+                key=b"k", timestamp_ms=i)
+        raw = broker.fetch_raw("T", 0, 0)
+        assert raw is not None and raw.start_offset == 0
+        # the returned bytes are REAL store frames: the one parser
+        # (store.segment) walks them
+        offs = [off for _p, _e, off, _k, _v, _t, _h
+                in seg.scan_records(raw.data)]
+        assert offs[0] == 0 and len(offs) == 20
+        assert broker.fetch_raw("T", 0, 20) is None  # log end
+        broker.close()
+
+
+def test_fetch_raw_wire_out_of_range():
+    from iotml.stream.broker import OffsetOutOfRangeError
+    from iotml.stream.kafka_wire import KafkaWireBroker, KafkaWireServer
+
+    broker = Broker()
+    broker.create_topic("T", retention_messages=5)
+    rng = np.random.default_rng(31)
+    for i in range(20):
+        broker.produce("T", framing.frame(CODEC.encode(_record(rng)), 1),
+                       timestamp_ms=i)
+    with KafkaWireServer(broker) as srv:
+        wb = KafkaWireBroker(f"127.0.0.1:{srv.port}")
+        with pytest.raises(OffsetOutOfRangeError) as ei:
+            wb.fetch_raw("T", 0, 0)
+        assert ei.value.earliest == 15
+        raw = wb.fetch_raw("T", 0, 15)
+        assert raw is not None and raw.start_offset == 15
+        offs = [off for _p, _e, off, _k, _v, _t, _h
+                in seg.scan_records(raw.data)]
+        assert offs == list(range(15, 20))
+        wb.close()
+
+
+@needs_native
+def test_poll_into_autoresets_after_retention_trim():
+    """A columnar cursor stranded below the retained base auto-resets
+    to earliest AND still returns data in the same poll (a trim must
+    not read as a phantom end-of-stream)."""
+    rng = np.random.default_rng(37)
+    broker = Broker()
+    broker.create_topic("T", retention_messages=8)
+    for i in range(30):
+        broker.produce("T", framing.frame(CODEC.encode(_record(rng)), 1),
+                       timestamp_ms=i)
+    assert broker.begin_offset("T", 0) == 22
+    nc = native_mod.NativeCodec(KSQL_CAR_SCHEMA)
+    dec = nc.frame_decoder()
+    consumer = StreamConsumer(broker, ["T:0:0"], group="trim")
+    x = np.zeros((64, nc.n_numeric), np.float32)
+    lab = np.zeros((64, nc.n_strings), f"S{native_mod.LABEL_STRIDE}")
+    rows, fb = consumer.poll_into(dec, x, lab)
+    assert rows == 8 and not fb
+    assert consumer.positions() == [("T", 0, 30)]
+
+
+@needs_native
+def test_tombstone_at_cursor_on_fused_wire_path():
+    """A tombstone (value=None) at the cursor trips the fused path's
+    guard; the message-path fallback must SKIP it (delete markers have
+    no payload), never crash on len(None)."""
+    from iotml.stream.kafka_wire import KafkaWireServer
+    from iotml.stream.native_kafka import NativeKafkaBroker
+
+    rng = np.random.default_rng(41)
+    broker = Broker()
+    for i in range(15):
+        broker.produce("SENSOR_DATA_S_AVRO",
+                       framing.frame(CODEC.encode(_record(rng)), 1),
+                       key=b"car-1", timestamp_ms=i)
+    broker.produce("SENSOR_DATA_S_AVRO", None, key=b"car-1",
+                   timestamp_ms=16)
+    for i in range(10):
+        broker.produce("SENSOR_DATA_S_AVRO",
+                       framing.frame(CODEC.encode(_record(rng)), 1),
+                       key=b"car-2", timestamp_ms=20 + i)
+    with KafkaWireServer(broker) as srv:
+        nb = NativeKafkaBroker(f"127.0.0.1:{srv.port}")
+        consumer = StreamConsumer(nb, ["SENSOR_DATA_S_AVRO:0:0"],
+                                  group="tomb")
+        batches = list(SensorBatches(consumer, batch_size=10,
+                                     keep_keys=True))
+        assert sum(b.n_valid for b in batches) == 25
+        nb.close()
+
+
+def test_relay_server_without_raw_downgrades_cleanly():
+    """A wire server whose backing broker RAISES NotImplementedError
+    from fetch_raw (a relay to a pre-extension upstream) must answer
+    UNSUPPORTED_VERSION — the client pins back to classic FETCH and the
+    pipeline keeps flowing."""
+    from iotml.stream.kafka_wire import KafkaWireBroker, KafkaWireServer
+
+    rng = np.random.default_rng(43)
+    broker = Broker()
+    for i in range(30):
+        broker.produce("SENSOR_DATA_S_AVRO",
+                       framing.frame(CODEC.encode(_record(rng)), 1),
+                       timestamp_ms=i)
+
+    class Relay:
+        def __getattr__(self, name):
+            return getattr(broker, name)
+
+        def fetch_raw(self, *a, **kw):
+            raise NotImplementedError("upstream lacks RAW_FETCH")
+
+    with KafkaWireServer(Relay()) as srv:
+        wb = KafkaWireBroker(f"127.0.0.1:{srv.port}")
+        with pytest.raises(NotImplementedError):
+            wb.fetch_raw("SENSOR_DATA_S_AVRO", 0, 0)
+        consumer = StreamConsumer(wb, ["SENSOR_DATA_S_AVRO:0:0"],
+                                  group="relay")
+        batches = list(SensorBatches(consumer, batch_size=10))
+        assert sum(b.n_valid for b in batches) == 30
+        # the downgrade is remembered: no further RAW_FETCH round trips
+        assert consumer._raw_unsupported is True
+        wb.close()
+
+
+def test_poll_into_none_without_raw_support():
+    """A broker without fetch_raw keeps consumers on the legacy paths."""
+
+    class NoRaw:
+        pass
+
+    consumer = StreamConsumer.__new__(StreamConsumer)
+    consumer.broker = NoRaw()
+    consumer._cursors = [["T", 0, 0]]
+    consumer._rr = 0
+    assert consumer.poll_into(None, None, None) is None
+
+
+# ------------------------------------------------------- pipeline knobs
+def test_pipeline_knobs_never_leak_into_config_tree():
+    """IOTML_PREFETCH_DEPTH / IOTML_DECODE_RING_BUFFERS /
+    IOTML_RAW_BATCH_BYTES are process toggles in config's non_config
+    set: the resolver must neither reject them nor apply them."""
+    from iotml.config import load_config
+
+    cfg, _ = load_config(argv=[], env={
+        "IOTML_PREFETCH_DEPTH": "3",
+        "IOTML_DECODE_RING_BUFFERS": "8",
+        "IOTML_RAW_BATCH_BYTES": "65536"})
+    clean, _ = load_config(argv=[], env={})
+    assert cfg.as_dict() == clean.as_dict()
+    assert cfg.applied == set()
+
+
+def test_pipeline_knob_validation(monkeypatch):
+    from iotml.data import pipeline as pl
+
+    monkeypatch.setenv("IOTML_PREFETCH_DEPTH", "4")
+    monkeypatch.setenv("IOTML_DECODE_RING_BUFFERS", "2")
+    monkeypatch.setenv("IOTML_RAW_BATCH_BYTES", "8192")
+    assert pl.prefetch_depth() == 4
+    assert pl.decode_ring_buffers() == 2
+    assert pl.raw_batch_bytes() == 8192
+    monkeypatch.setenv("IOTML_PREFETCH_DEPTH", "0")
+    with pytest.raises(ValueError):
+        pl.prefetch_depth()
+    monkeypatch.setenv("IOTML_DECODE_RING_BUFFERS", "1")
+    with pytest.raises(ValueError):
+        pl.decode_ring_buffers()
+    monkeypatch.setenv("IOTML_RAW_BATCH_BYTES", "nope")
+    with pytest.raises(ValueError):
+        pl.raw_batch_bytes()
+
+
+@needs_native
+def test_minimal_ring_still_correct(tmp_path, monkeypatch):
+    """ring=2 (the minimum) must not corrupt carried tails: batch
+    parity against the python path holds at every ring size."""
+    monkeypatch.setenv("IOTML_DECODE_RING_BUFFERS", "2")
+    monkeypatch.setenv("IOTML_RAW_BATCH_BYTES", "16384")  # small fetches
+    broker = Broker(store_dir=str(tmp_path / "store"))
+    _fill(broker, n_ticks=30)
+    cols, sb = _batches(broker, group="ring2", poll_chunk=37)
+    assert sb._ring not in (None, False) and len(sb._ring) == 2
+    pys, _ = _batches(broker, force_python=True, group="ring2-py",
+                      poll_chunk=37)
+    assert len(cols) == len(pys)
+    for a, b in zip(cols, pys):
+        assert np.array_equal(a.x, b.x, equal_nan=True)
+        assert np.array_equal(a.keys, b.keys)
+    broker.close()
+
+
+# ----------------------------------------------------------- lint (R14)
+def test_r14_confines_frame_parsing():
+    import os
+
+    from iotml.analysis.lint import lint_file
+
+    fixture = os.path.join(os.path.dirname(__file__), "fixtures",
+                           "analysis", "bad_frame.py")
+    findings = lint_file(fixture, "fixtures/bad_frame.py")
+    r14 = [f for f in findings if f.rule == "R14"]
+    assert len(r14) >= 3  # head struct + scan_records + encode_record
+    # and the production tree is clean
+    from iotml.analysis.lint import default_root, lint_paths
+
+    tree = [f for f in lint_paths([default_root()], rules={"R14"})
+            if f.rule == "R14"]
+    assert tree == []
