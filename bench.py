@@ -377,9 +377,10 @@ def bench_pipeline():
         drain_wire()
         wire_walls = [drain_wire() for _ in range(3)]
         legs["wire_columnar"], _ = _percentiles(wire_walls)
+        out = _bench_produce_legs(broker, total)
         broker.close()
         rps = {m: total / w for m, w in legs.items()}
-        return dict(
+        out.update(
             value=rps["columnar"],
             python_records_per_sec=round(rps["python"], 1),
             fused_records_per_sec=round(rps["fused"], 1),
@@ -393,8 +394,142 @@ def bench_pipeline():
             host_pipeline_s_fused=round(legs["fused"], 3),
             host_pipeline_s_columnar=round(legs["columnar"], 3),
             n_records=total)
+        return out
     finally:
         shutil.rmtree(d, ignore_errors=True)
+
+
+def _bench_produce_legs(broker, n_records):
+    """The WRITE-path legs of the zero-copy plane (ISSUE 12), measured
+    over the same durable broker as the consume legs:
+
+      produce_python:   per-record python Avro encode + frame + append
+                        (IOTML_RAW_PRODUCE=off — the pre-ISSUE-12 path),
+      produce_fused:    native batch Avro encode, classic per-record
+                        framing/append,
+      produce_columnar: ONE native convert+frame call per batch
+                        (NativeCodec.encode_frames) + Broker.produce_raw
+                        appending segment-verbatim,
+      produce_wire:     the columnar leg through RAW_PRODUCE over a
+                        KafkaWireServer socket,
+
+    plus the convert+frame vs append split of the columnar leg (the
+    produce-leg breakdown the e2e bench publishes beside its knee)."""
+    import numpy as np
+
+    from iotml.core.schema import KSQL_CAR_SCHEMA
+    from iotml.ops.avro import AvroCodec
+    from iotml.ops.framing import frame
+    from iotml.stream import native as native_mod
+
+    n = min(int(n_records), 20_000)
+    if not native_mod.available():
+        return {"produce_legs": "skipped (native engine unavailable)"}
+    nc = native_mod.NativeCodec(KSQL_CAR_SCHEMA)
+    codec = AvroCodec(KSQL_CAR_SCHEMA)
+    rng = np.random.default_rng(11)
+    numeric = rng.normal(size=(n, nc.n_numeric)).astype(np.float64)
+    labels = np.full((n, nc.n_strings), b"false", "S16")
+    ts = np.arange(n, dtype=np.int64)
+    keys = np.asarray([b"vehicles/sensor/data/car-%05d" % (i % 100)
+                       for i in range(n)], "S64")
+    numerics = [f.name for f in KSQL_CAR_SCHEMA.fields
+                if f.avro_type != "string"]
+    rows = [dict(zip(numerics, map(float, numeric[i])),
+                 FAILURE_OCCURRED="false") for i in range(n)]
+    key_list = [bytes(k) for k in keys]
+    topic_i = [0]
+
+    def fresh_topic():
+        topic_i[0] += 1
+        name = f"BENCH_PRODUCE_{topic_i[0]}"
+        broker.create_topic(name, partitions=1)
+        return name
+
+    import contextlib
+
+    @contextlib.contextmanager
+    def classic_plane():
+        # force the per-record write path, RESTORING the caller's knob
+        # (an operator running `IOTML_RAW_PRODUCE=on python bench.py`
+        # must keep the CI-parity mode for every later bench)
+        prev = os.environ.get("IOTML_RAW_PRODUCE")
+        os.environ["IOTML_RAW_PRODUCE"] = "off"
+        try:
+            yield
+        finally:
+            if prev is None:
+                os.environ.pop("IOTML_RAW_PRODUCE", None)
+            else:
+                os.environ["IOTML_RAW_PRODUCE"] = prev
+
+    def leg_python():
+        with classic_plane():
+            t = fresh_topic()
+            t0 = time.perf_counter()
+            broker.produce_many(
+                t, [(key_list[i], frame(codec.encode(rows[i]), 1),
+                     int(ts[i])) for i in range(n)], partition=0)
+            return time.perf_counter() - t0
+
+    def leg_fused():
+        with classic_plane():
+            t = fresh_topic()
+            t0 = time.perf_counter()
+            vals = nc.encode_batch(numeric, labels, schema_id=1)
+            broker.produce_many(
+                t, list(zip(key_list, vals, ts.tolist())), partition=0)
+            return time.perf_counter() - t0
+
+    split = {}
+
+    def leg_columnar():
+        t = fresh_topic()
+        t0 = time.perf_counter()
+        blob = nc.encode_frames(numeric, labels, ts, keys=keys,
+                                schema_id=1)
+        t1 = time.perf_counter()
+        broker.produce_raw(t, 0, blob)
+        t2 = time.perf_counter()
+        split["convert_frame_s"] = round(t1 - t0, 4)
+        split["append_s"] = round(t2 - t1, 4)
+        return t2 - t0
+
+    def leg_wire():
+        from iotml.stream.kafka_wire import (KafkaWireBroker,
+                                             KafkaWireServer)
+
+        t = fresh_topic()
+        with KafkaWireServer(broker) as srv:
+            wb = KafkaWireBroker(f"127.0.0.1:{srv.port}")
+            t0 = time.perf_counter()
+            blob = nc.encode_frames(numeric, labels, ts, keys=keys,
+                                    schema_id=1)
+            # one unsplit request: the upper bound of the wire leg
+            # (production riders split at IOTML_PRODUCE_BATCH_BYTES —
+            # per-request overhead there is measured by this leg's
+            # delta against produce_columnar)
+            wb.produce_raw(t, 0, blob)
+            wall = time.perf_counter() - t0
+            wb.close()
+        return wall
+
+    walls = {}
+    for name, fn in (("python", leg_python), ("fused", leg_fused),
+                     ("columnar", leg_columnar), ("wire", leg_wire)):
+        fn()  # warm
+        walls[name], _ = _percentiles([fn() for _ in
+                                       range(max(3, PASSES // 2))])
+    rps = {m: n / w for m, w in walls.items()}
+    return dict(
+        produce_python_records_per_sec=round(rps["python"], 1),
+        produce_fused_records_per_sec=round(rps["fused"], 1),
+        produce_columnar_records_per_sec=round(rps["columnar"], 1),
+        produce_wire_columnar_records_per_sec=round(rps["wire"], 1),
+        produce_speedup_vs_python=round(
+            rps["columnar"] / rps["python"], 2),
+        produce_breakdown_s=split,
+        produce_n_records=n)
 
 
 def bench_twin():
@@ -2059,6 +2194,35 @@ finally:
 """
 
 
+def _hist_sum(hist) -> float:
+    """Total observed seconds across a metrics Histogram's series."""
+    try:
+        return float(sum(hist._sums.values()))
+    except Exception:  # noqa: BLE001 - diagnostics only
+        return 0.0
+
+
+def _produce_leg_breakdown(ingest, durable: bool) -> dict:
+    """The write path's per-leg seconds for the e2e run: bridge (MQTT→
+    stream produce), convert+frame (the pump's fused native JSON→Avro→
+    frame leg), append (RAW_PRODUCE ship+land)."""
+    from iotml.stream.producer import (raw_produce_append_seconds,
+                                       raw_produce_convert_seconds,
+                                       raw_produce_fallbacks,
+                                       raw_produce_records)
+
+    return dict(
+        value=float(raw_produce_records.value()),
+        platform="durable-columnar" if durable else "in-memory",
+        bridge_produce_s=round(ingest.produce_seconds, 2),
+        convert_frame_s=round(_hist_sum(raw_produce_convert_seconds), 2),
+        raw_append_s=round(_hist_sum(raw_produce_append_seconds), 2),
+        raw_produce_records=int(raw_produce_records.value()),
+        raw_produce_fallbacks=int(raw_produce_fallbacks.value()),
+        definition="write-path seconds per leg over the whole e2e run "
+                   "(value = records shipped as pre-framed raw batches)")
+
+
 def bench_e2e_platform():
     """THE reference claim, measured: every layer live at once, with the
     model loop CLOSED.  The demo the reference actually runs is fleet →
@@ -2106,7 +2270,18 @@ def bench_e2e_platform():
     steady-state by construction on any box day — a fixed 16k pace on a
     day the box saturates at 11.5k would measure backlog drain, not the
     platform (round-4 driver capture did exactly that).
-    IOTML_BENCH_E2E_RATE overrides the policy with a fixed pace."""
+    IOTML_BENCH_E2E_RATE overrides the policy with a fixed pace.
+
+    Since ISSUE 12 the platform under test is the DURABLE COLUMNAR
+    platform (IOTML_BENCH_E2E_DURABLE=0 opts back to the in-memory
+    emulator): every partition is a segmented log, the bridge and the
+    KSQL pump's AVRO leg produce pre-framed raw batches appended
+    segment-verbatim (RAW_PRODUCE / the fused produce_many framing),
+    and the train/score children consume raw frame batches over
+    RAW_FETCH through the one columnar decoder — the zero-copy plane
+    end to end, write AND read.  The produce-leg breakdown
+    (bridge / convert+frame / append) is published beside the knee."""
+    import shutil
     import subprocess
     import tempfile
 
@@ -2117,8 +2292,13 @@ def bench_e2e_platform():
 
     rate_env = os.environ.get("IOTML_BENCH_E2E_RATE", "")
     window_s = float(os.environ.get("IOTML_BENCH_E2E_SECONDS", "20"))
+    # the sweep starts LOW enough for a held point to anchor on a
+    # 1-core box (a first point that already overdrives measures thrash
+    # capacity and breaks the sweep immediately) and climbs past the
+    # 2-core knee band
     sweep = [float(r) for r in os.environ.get(
-        "IOTML_BENCH_E2E_SWEEP", "12000,15000,18000,21000").split(",") if r]
+        "IOTML_BENCH_E2E_SWEEP",
+        "8000,12000,16000,20000,24000").split(",") if r]
     sweep_window_s = float(os.environ.get("IOTML_BENCH_E2E_SWEEP_SECONDS",
                                           "8"))
     n_conns = 200
@@ -2132,7 +2312,19 @@ def bench_e2e_platform():
     # p99 measures ≈ 0.50.
     threshold = float(os.environ.get("IOTML_BENCH_E2E_THRESHOLD", "0.5"))
 
-    platform = Platform(retention_messages=30_000).start()
+    durable = os.environ.get("IOTML_BENCH_E2E_DURABLE", "1").strip() \
+        not in ("0", "false", "no", "off")
+    store_dir = None
+    store_policy = None
+    if durable:
+        from iotml.store import StorePolicy
+
+        store_dir = tempfile.mkdtemp(prefix="iotml_e2e_store_")
+        # fsync=never: the bench measures the pipeline, not the disk's
+        # flush latency (crash durability is the store suite's job)
+        store_policy = StorePolicy(fsync="never")
+    platform = Platform(retention_messages=30_000, store_dir=store_dir,
+                        store_policy=store_policy).start()
     # derived KSQL topics are created by the engine (partitions inherited
     # from sensor-data) with no retention bound; pre-create them bounded so
     # a ~90 s run cannot grow the log without limit.  The AVRO leg gets a
@@ -2668,6 +2860,8 @@ def bench_e2e_platform():
             ingest.stop()
             platform.stop()  # ALWAYS: a leaked platform would outlive the
             #                  bench and mask the original error
+            if store_dir is not None:
+                shutil.rmtree(store_dir, ignore_errors=True)
             if payload_file is not None:
                 try:
                     os.unlink(payload_file)
@@ -2714,6 +2908,10 @@ def bench_e2e_platform():
         # work reads this to see where the shared core goes)
         ksql_pump_busy_s=round(pump_busy[0], 1),
         ksql_pump_records=int(pump_busy[1]),
+        # the produce-leg breakdown (ISSUE 12): where write-path time
+        # went over the whole run — MQTT→stream bridge produce, the
+        # pump's native convert+frame, and the raw append/ship leg
+        _produce_legs=_produce_leg_breakdown(ingest, durable),
     )
     if pr:
         pr50, pr95 = _percentiles(pr)
@@ -2859,6 +3057,10 @@ def main():
         # sweep) — the self-pacing headline window targets 0.8× this
         ("e2e_saturation_records_per_sec", "records/s",
          FLEET_BASELINE_MPS),
+        # write-path breakdown for the run above: records shipped as
+        # pre-framed raw batches + per-leg seconds (bridge produce,
+        # native convert+frame, raw append) — ISSUE 12's produce legs
+        ("e2e_produce_leg_records", "records", None),
         ("e2e_latency_ms", "ms", None),
         # the headline stays the LAST printed line (the driver parses the
         # final JSON line as the headline metric)
@@ -2924,6 +3126,9 @@ def main():
             sat_res = res.pop("_saturation", None)
             if sat_res is not None:
                 results["e2e_saturation_records_per_sec"] = sat_res
+            legs = res.pop("_produce_legs", None)
+            if legs is not None:
+                results["e2e_produce_leg_records"] = legs
         if res is not None and res.get("latency_ms_p50") is not None:
             lat_line = dict(
                 value=res.get("latency_ms_p50"),

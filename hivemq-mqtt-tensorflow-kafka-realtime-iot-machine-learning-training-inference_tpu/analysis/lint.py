@@ -178,13 +178,15 @@ RULES: Dict[str, str] = {
            "the registry (versioning, rollback gate, swap metrics) — "
            "a direct weight poke is an unversioned deploy nothing can "
            "roll back",
-    "R14": "frame parsing (the [len|crc|attrs|offset|ts|key|value|"
-           "headers] layout: scan_records / iter_frames / "
-           "decode_record / encode_record, or the >IBqqi head struct) "
-           "outside iotml/store/ + iotml/ops/framing.py: the segmented "
-           "log's frame is the ONE wire→disk→host contract with ONE "
-           "parser — consume raw batches via Broker.fetch_raw + "
-           "FrameDecoder / ops.framing helpers",
+    "R14": "frame parsing OR encoding (the [len|crc|attrs|offset|ts|"
+           "key|value|headers] layout: scan_records / iter_frames / "
+           "decode_record / encode_record, the >IBqqi head struct, or "
+           "a direct iotml_frames_* native-symbol call) outside "
+           "iotml/store/ + iotml/ops/framing.py (+ stream/native.py "
+           "for the ctypes binding): the segmented log's frame is the "
+           "ONE wire→disk→host contract with ONE codec — consume raw "
+           "batches via Broker.fetch_raw + FrameDecoder, produce them "
+           "via ops.framing helpers / RawBatchProducer",
 }
 
 # R14: the segment frame codec's entry points, and the frame-head
@@ -193,6 +195,14 @@ RULES: Dict[str, str] = {
 # suppression).
 _FRAME_PARSER_CALLS = frozenset({"scan_records", "iter_frames",
                                  "decode_record", "encode_record"})
+# R14 write-path extension (ISSUE 12): the frame engine's native
+# symbols may be bound/called ONLY by iotml/stream/native.py (the one
+# ctypes binding) and the exempt frame owners — a direct ctypes call
+# elsewhere is a second frame codec in disguise.
+_FRAME_NATIVE_SYMBOLS = frozenset({
+    "iotml_frames_decode_columnar", "iotml_frames_encode_columnar",
+    "iotml_frames_encode_values", "iotml_frames_restamp",
+    "iotml_frames_validate"})
 _FRAME_HEAD_RE = re.compile(r"IBqqi")
 _STRUCT_CALLS = frozenset({"Struct", "pack", "unpack", "unpack_from",
                            "pack_into"})
@@ -489,10 +499,15 @@ class _FileLinter(ast.NodeVisitor):
         self.in_store = "store" in parts
         # R14 scoping: the store package plus ops/framing.py (the frame
         # contract's stream-layer half, whose helpers delegate to the
-        # store codec) are the only frame parsers
+        # store codec) are the only frame parsers/encoders
         self.r14_exempt = self.in_store or (
             len(parts) >= 2 and (parts[-2], parts[-1])
             == ("ops", "framing.py"))
+        # ...and stream/native.py additionally holds the ONE ctypes
+        # binding of the frame engine's native symbols
+        self.r14_native_exempt = self.r14_exempt or (
+            len(parts) >= 2 and (parts[-2], parts[-1])
+            == ("stream", "native.py"))
         # R11 scoping: the mlops package owns registry bytes
         self.in_mlops = "mlops" in parts
         # R12 scoping: the twin package owns the CAR_TWIN changelog
@@ -815,6 +830,16 @@ class _FileLinter(ast.NodeVisitor):
                                "iotml/ops/framing.py: the frame "
                                "layout is one contract with one "
                                "parser")
+        if not self.r14_native_exempt and name in _FRAME_NATIVE_SYMBOLS:
+            # write-path extension: a direct ctypes call on the frame
+            # engine's symbols is a second frame codec in disguise —
+            # the one binding lives in stream/native.py
+            self._emit("R14", node,
+                       f"direct native frame-codec call {name}() "
+                       "outside iotml/stream/native.py: frame "
+                       "encoding/decoding goes through the bound "
+                       "NativeCodec/FrameDecoder or ops.framing "
+                       "helpers")
 
         # R13 — model updates go through the registry: an in-place
         # .set_params(...) on a serving scorer outside the mlops/online

@@ -49,6 +49,11 @@ KNOWN_POINTS: Dict[str, str] = {
     "kafka_wire.recv": "wire-client socket recv: drop connection (error), "
                        "delay",
     "broker.produce": "broker append path: produce error, delay",
+    "broker.produce_raw": "RAW_PRODUCE pre-framed batch landing: corrupt "
+                          "(flip a byte in the in-flight batch — the "
+                          "whole batch must be rejected with "
+                          "CORRUPT_MESSAGE before any byte lands), "
+                          "error, delay",
     "broker.fetch": "broker fetch path: stall (delay), partition "
                     "unavailable (error)",
     "replica.sync": "follower replication round: pause (delay), skip",
@@ -104,6 +109,7 @@ POINT_ACTIONS: Dict[str, frozenset] = {
     "kafka_wire.send": frozenset({"error", "delay", "short_write"}),
     "kafka_wire.recv": frozenset({"error", "delay"}),
     "broker.produce": frozenset({"error", "delay"}),
+    "broker.produce_raw": frozenset({"corrupt", "error", "delay"}),
     "broker.fetch": frozenset({"error", "delay"}),
     "replica.sync": frozenset({"skip", "delay", "error"}),
     "mqtt.deliver": frozenset({"drop", "dup", "delay"}),

@@ -139,6 +139,14 @@ def main(argv=None) -> int:
         p.add_argument("--raw-batch-bytes", type=int, default=None,
                        help="max bytes per raw frame fetch (sets "
                             "IOTML_RAW_BATCH_BYTES; default 1 MiB)")
+        p.add_argument("--raw-produce", default=None,
+                       choices=("auto", "on", "off"),
+                       help="zero-copy produce plane (sets "
+                            "IOTML_RAW_PRODUCE; default auto)")
+        p.add_argument("--produce-batch-bytes", type=int, default=None,
+                       help="max frame bytes per RAW_PRODUCE request "
+                            "(sets IOTML_PRODUCE_BATCH_BYTES; default "
+                            "1 MiB)")
 
     args = ap.parse_args(argv)
     from ..data.pipeline import set_knobs
@@ -146,7 +154,9 @@ def main(argv=None) -> int:
     try:
         set_knobs(prefetch_depth=args.prefetch_depth,
                   decode_ring_buffers=args.decode_ring_buffers,
-                  raw_batch_bytes=args.raw_batch_bytes)
+                  raw_batch_bytes=args.raw_batch_bytes,
+                  produce_batch_bytes=args.produce_batch_bytes,
+                  raw_produce=args.raw_produce)
     except ValueError as e:
         ap.error(str(e))
     broker = _wire_broker(args.servers, args.sasl)

@@ -476,6 +476,18 @@ def main(argv=None) -> int:
                     help="max bytes per raw frame fetch on the "
                          "zero-copy consume path (sets "
                          "IOTML_RAW_BATCH_BYTES; default 1 MiB)")
+    ap.add_argument("--raw-produce", default=None,
+                    choices=("auto", "on", "off"),
+                    help="zero-copy produce plane (sets "
+                         "IOTML_RAW_PRODUCE): auto = RAW_PRODUCE where "
+                         "supported with classic fallback, on = raw "
+                         "required (CI parity), off = classic "
+                         "everywhere (debug)")
+    ap.add_argument("--produce-batch-bytes", type=int, default=None,
+                    metavar="BYTES",
+                    help="max frame bytes per RAW_PRODUCE request "
+                         "(sets IOTML_PRODUCE_BATCH_BYTES; default "
+                         "1 MiB)")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
     from ..data.pipeline import set_knobs
@@ -483,7 +495,9 @@ def main(argv=None) -> int:
     try:
         set_knobs(prefetch_depth=args.prefetch_depth,
                   decode_ring_buffers=args.decode_ring_buffers,
-                  raw_batch_bytes=args.raw_batch_bytes)
+                  raw_batch_bytes=args.raw_batch_bytes,
+                  produce_batch_bytes=args.produce_batch_bytes,
+                  raw_produce=args.raw_produce)
     except ValueError as e:
         ap.error(str(e))
 

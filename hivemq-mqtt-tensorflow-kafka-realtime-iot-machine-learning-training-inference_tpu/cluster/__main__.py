@@ -111,6 +111,13 @@ def main(argv=None) -> int:
     up.add_argument("--raw-batch-bytes", type=int, default=None,
                     help="max bytes per raw frame fetch (sets "
                          "IOTML_RAW_BATCH_BYTES)")
+    up.add_argument("--raw-produce", default=None,
+                    choices=("auto", "on", "off"),
+                    help="zero-copy produce plane for pump fleets and "
+                         "shard appends (sets IOTML_RAW_PRODUCE)")
+    up.add_argument("--produce-batch-bytes", type=int, default=None,
+                    help="max frame bytes per RAW_PRODUCE request "
+                         "(sets IOTML_PRODUCE_BATCH_BYTES)")
     up.add_argument("--quiet", action="store_true")
     up.set_defaults(fn=cmd_up)
 
@@ -121,15 +128,14 @@ def main(argv=None) -> int:
     drill.set_defaults(fn=cmd_drill)
 
     args = ap.parse_args(argv)
-    if getattr(args, "prefetch_depth", None) is not None or \
-            getattr(args, "decode_ring_buffers", None) is not None or \
-            getattr(args, "raw_batch_bytes", None) is not None:
+    knob_names = ("prefetch_depth", "decode_ring_buffers",
+                  "raw_batch_bytes", "raw_produce",
+                  "produce_batch_bytes")
+    if any(getattr(args, k, None) is not None for k in knob_names):
         from ..data.pipeline import set_knobs
 
         try:
-            set_knobs(prefetch_depth=args.prefetch_depth,
-                      decode_ring_buffers=args.decode_ring_buffers,
-                      raw_batch_bytes=args.raw_batch_bytes)
+            set_knobs(**{k: getattr(args, k, None) for k in knob_names})
         except ValueError as e:
             ap.error(str(e))
     return args.fn(args)

@@ -251,6 +251,22 @@ class ClusterClient:
             last = max(last, off)
         return last
 
+    def produce_raw(self, topic: str, partition: int,
+                    frames: bytes) -> int:
+        """Route a pre-framed RAW_PRODUCE batch to the partition's
+        owning shard (one request, all-or-nothing — a NOT_LEADER bounce
+        re-routes with nothing appended).  NotImplementedError from an
+        extension-less shard propagates so producers pin back to
+        classic produce; ConnectionError keeps caller-owns-redelivery."""
+        def op(c):
+            pr = getattr(c, "produce_raw", None)
+            if pr is None:
+                raise NotImplementedError(
+                    "owning broker lacks raw-batch produce")
+            return pr(topic, partition, frames)
+
+        return self._routed(topic, partition, op, retry_connection=False)
+
     # -------------------------------------------------------------- fetch
     def fetch(self, topic: str, partition: int, offset: int,
               max_messages: int = 1024) -> List[Message]:

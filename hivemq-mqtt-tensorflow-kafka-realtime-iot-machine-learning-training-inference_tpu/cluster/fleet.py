@@ -217,6 +217,16 @@ class PumpFleet(_Fleet):
     partitions split across members and rebalance on death, turning the
     single-threaded KSQL pump into the reference's scalable
     stream-processing tier.
+
+    Write plane: members whose task implements ``process_raw`` (the
+    AVRO CSAS's fused JSON leg) convert+frame each chunk natively and
+    produce RAW batches to their owned partitions through the member's
+    ``ClusterClient.produce_raw`` — routed to the owning shard and
+    appended segment-verbatim (ARCHITECTURE §21).  The process knobs
+    IOTML_RAW_PRODUCE / IOTML_PRODUCE_BATCH_BYTES (``cluster up
+    --raw-produce / --produce-batch-bytes``) select the plane for every
+    member at once; extension-less shards pin members back to classic
+    PRODUCE.
     """
 
     def __init__(self, client_factory, task_factory, n_members: int,

@@ -38,7 +38,7 @@ class _UnownedPartition:
         raise NotLeaderForPartitionError(self.topic, self.partition)
 
     # the full _Partition touch-point surface, all refusing
-    append = append_at = sync_batch = note_replay = _refuse
+    append = append_at = append_raw = sync_batch = note_replay = _refuse
     end = base = read = read_raw = drop_head = enforce_retention = _refuse
     align_base = reset = offset_for_timestamp = _refuse
 

@@ -290,7 +290,11 @@ def load_config(argv: Optional[Sequence[str]] = None,
                   # the pipeline's logical config — and their names
                   # predate the SECTION_FIELD convention
                   "IOTML_PREFETCH_DEPTH", "IOTML_DECODE_RING_BUFFERS",
-                  "IOTML_RAW_BATCH_BYTES"}
+                  "IOTML_RAW_BATCH_BYTES",
+                  # write-plane knobs (ISSUE 12): same family — they
+                  # select the process's produce machinery (RAW_PRODUCE
+                  # vs classic), not the pipeline's logical config
+                  "IOTML_RAW_PRODUCE", "IOTML_PRODUCE_BATCH_BYTES"}
     for key, value in env.items():
         if not key.startswith("IOTML_") or key in non_config:
             continue

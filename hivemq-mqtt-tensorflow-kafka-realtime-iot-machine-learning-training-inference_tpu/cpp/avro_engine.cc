@@ -347,7 +347,11 @@ int64_t iotml_encode_batch(const double* numeric, const char* labels,
 // 6 = + tombstone round-trip (produce_nulls / staged_value_nulls);
 // 7 = + iotml_frames_decode_columnar (store-frame columnar decoder,
 //       frame_engine.cc) + iotml_kafka_set_pinned_id_limit (pinned
-//       writer-id guard on the fused fetch_decode paths)
-int64_t iotml_engine_version() { return 7; }
+//       writer-id guard on the fused fetch_decode paths);
+// 8 = + write-path frame codec (frame_engine.cc:
+//       iotml_frames_encode_columnar / iotml_frames_encode_values /
+//       iotml_frames_restamp / iotml_frames_validate) +
+//       iotml_kafka_produce_raw (RAW_PRODUCE wire extension)
+int64_t iotml_engine_version() { return 8; }
 
 }  // extern "C"

@@ -1,8 +1,8 @@
-// iotml native stream engine: columnar STORE-FRAME batch decoder.
+// iotml native stream engine: columnar STORE-FRAME batch codec.
 //
-// The zero-copy data plane's device-side half: one call walks a raw
-// batch of segmented-log frames (store/segment.py layout, the ONE
-// wire→disk→host contract)
+// The zero-copy data plane's native half, BOTH directions: one call
+// walks (or builds) a raw batch of segmented-log frames
+// (store/segment.py layout, the ONE wire→disk→host contract)
 //
 //     u32 length | u32 crc32c | u8 attrs | i64 offset | i64 ts |
 //     i32 key_len | key | u32 value_len | value | [headers]
@@ -28,10 +28,29 @@
 // Tombstones (attrs bit 1, compaction delete markers) carry no Avro
 // payload: they are skipped and counted, never decoded.
 //
+// The WRITE path (ISSUE 12) lives here too — frame_engine.cc is the
+// byte-layout owner:
+//   iotml_frames_encode_columnar  columnar rows → Confluent-framed Avro
+//                                 values → ready-to-append store frames
+//                                 (the KSQL pump's fused produce leg);
+//   iotml_frames_encode_values    opaque value bytes → store frames
+//                                 (the MQTT bridge's JSON leg and the
+//                                 generic durable produce_many fusion);
+//   iotml_frames_restamp          broker-side RAW_PRODUCE landing: CRC-
+//                                 validate a pre-framed batch and stamp
+//                                 the real log offsets into the heads
+//                                 (CRCs recomputed in place);
+//   iotml_frames_validate         CRC + offset-monotonicity walk for
+//                                 the replica's zero-copy mirror leg.
+// Byte parity with store/segment.py's encode_record is pinned by
+// tests (ops.framing is the oracle): a RAW_PRODUCE-ingested segment is
+// byte-identical to the same records produced classically.
+//
 // Build: part of libiotml_stream.so (see Makefile).
 
 #include <cstdint>
 #include <cstring>
+#include <vector>
 
 namespace {
 
@@ -318,6 +337,249 @@ int64_t iotml_frames_decode_columnar(
   if (out_flags) *out_flags = flags;
   if (out_skipped) *out_skipped = skipped;
   return rows;
+}
+
+// ------------------------------------------------------------ write path
+
+// avro_engine.cc's columnar Avro encoder, linked into the same .so —
+// the value bytes of the fused produce leg come from the ONE encoder.
+int64_t iotml_encode_batch_nulls(const double* numeric, const char* labels,
+                                 int64_t label_stride, int64_t n_msgs,
+                                 const int8_t* types, const uint8_t* nullable,
+                                 int64_t n_fields, int64_t frame_schema_id,
+                                 uint8_t* out, int64_t out_capacity,
+                                 int64_t* out_offsets, const uint8_t* nulls);
+
+namespace {
+
+inline void put32(uint8_t* p, uint32_t v) {
+  p[0] = (v >> 24) & 0xFF;
+  p[1] = (v >> 16) & 0xFF;
+  p[2] = (v >> 8) & 0xFF;
+  p[3] = v & 0xFF;
+}
+
+inline void put64(uint8_t* p, uint64_t v) {
+  put32(p, static_cast<uint32_t>(v >> 32));
+  put32(p + 4, static_cast<uint32_t>(v));
+}
+
+// One store frame around a ready value (or tombstone), byte-identical
+// to store/segment.py encode_record.  Returns bytes written, or -1 if
+// `cap` is too small.  `value_null` frames a tombstone (attrs bit 1,
+// value_len 0) — byte-distinct from an empty value.
+int64_t write_frame(uint8_t* out, int64_t cap, int64_t offset, int64_t ts,
+                    const uint8_t* key, int64_t key_len, bool key_null,
+                    const uint8_t* value, int64_t value_len,
+                    bool value_null) {
+  if (value_null) value_len = 0;
+  int64_t body = kHeadSize + (key_null ? 0 : key_len) + 4 + value_len;
+  if (kLenSize + body > cap) return -1;
+  put32(out, static_cast<uint32_t>(body));
+  uint8_t* b = out + kLenSize;
+  b[4] = value_null ? kAttrNullValue : 0;  // attrs (headers never framed
+  // natively: the traced/header path keeps the Python encoder)
+  put64(b + 5, static_cast<uint64_t>(offset));
+  put64(b + 13, static_cast<uint64_t>(ts));
+  put32(b + 21, static_cast<uint32_t>(key_null ? -1 : key_len));
+  uint8_t* p = b + kHeadSize;
+  if (!key_null && key_len > 0) {
+    std::memcpy(p, key, key_len);
+  }
+  if (!key_null) p += key_len;
+  put32(p, static_cast<uint32_t>(value_len));
+  p += 4;
+  if (value_len > 0) std::memcpy(p, value, value_len);
+  put32(b, crc32c(b + 4, body - 4));
+  return kLenSize + body;
+}
+
+}  // namespace
+
+// Fused produce leg: columnar rows → Confluent-framed Avro values →
+// contiguous ready-to-append store frames.  Offsets are stamped
+// base_offset + i (a producing client passes 0 and the broker restamps
+// at append; an in-process caller holding the log end passes it
+// directly so no restamp pass is needed).
+//
+//   numeric/labels/nulls: the columnar row layout of
+//       iotml_encode_batch_nulls (avro_engine.cc) — nulls may be NULL.
+//   keys/key_offsets/key_null: optional per-row message keys.  All
+//       NULL = every key null (the unkeyed stream case).  With
+//       key_offsets NULL but key_stride > 0, `keys` is a FIXED-STRIDE
+//       [n x key_stride] block of NUL-terminated entries (an S-dtype
+//       numpy column — the zero-per-record-object produce form).
+//   timestamps: per-row record timestamps (ms).
+//   schema_id: Confluent header id (>= 0) — the ONE framing point.
+// Returns total frame bytes written into `out`, or -1 on overflow /
+// impossible null.
+int64_t iotml_frames_encode_columnar(
+    const double* numeric, const char* labels, int64_t label_stride,
+    int64_t n_msgs, const int8_t* types, const uint8_t* nullable,
+    int64_t n_fields, int64_t schema_id, const uint8_t* nulls,
+    const uint8_t* keys, const int64_t* key_offsets, int64_t key_stride,
+    const uint8_t* key_null, const int64_t* timestamps,
+    int64_t base_offset, uint8_t* out, int64_t out_capacity) {
+  if (n_msgs < 0 || !out) return -1;
+  if (n_msgs == 0) return 0;
+  int64_t n_strings = 0;
+  for (int64_t f = 0; f < n_fields; ++f)
+    if (types[f] == FR_STRING) ++n_strings;
+  // scratch for the Avro values: same worst-case bound the Avro encoder
+  // itself uses (5 header + 20/field + label strides per row)
+  int64_t vcap = n_msgs * (5 + n_fields * 20 + n_strings * label_stride) + 64;
+  std::vector<uint8_t> values(static_cast<size_t>(vcap));
+  std::vector<int64_t> voff(static_cast<size_t>(n_msgs + 1));
+  int64_t total = iotml_encode_batch_nulls(
+      numeric, labels, label_stride, n_msgs, types, nullable, n_fields,
+      schema_id, values.data(), vcap, voff.data(), nulls);
+  if (total < 0) return -1;
+  int64_t pos = 0;
+  for (int64_t i = 0; i < n_msgs; ++i) {
+    bool knull = true;
+    const uint8_t* kp = nullptr;
+    int64_t kn = 0;
+    if (keys && key_offsets) {
+      knull = key_null != nullptr && key_null[i] != 0;
+      kp = keys + key_offsets[i];
+      kn = key_offsets[i + 1] - key_offsets[i];
+    } else if (keys && key_stride > 0) {
+      // fixed-stride NUL-terminated keys (an S-dtype numpy column)
+      knull = key_null != nullptr && key_null[i] != 0;
+      kp = keys + i * key_stride;
+      while (kn < key_stride && kp[kn]) ++kn;
+    }
+    int64_t wrote = write_frame(
+        out + pos, out_capacity - pos, base_offset + i,
+        timestamps ? timestamps[i] : 0, kp, kn, knull,
+        values.data() + voff[i], voff[i + 1] - voff[i], false);
+    if (wrote < 0) return -1;
+    pos += wrote;
+  }
+  return pos;
+}
+
+// Opaque-value framing: [(key, value, ts)] columnar blobs → contiguous
+// store frames (the MQTT bridge's JSON leg, the rekey pass-through and
+// the generic durable produce_many fusion — the value bytes are
+// whatever the caller already holds; framing happens ONCE, here).
+// value_null marks tombstones.  Returns frame bytes or -1 on overflow.
+int64_t iotml_frames_encode_values(
+    const uint8_t* values, const int64_t* value_offsets,
+    const uint8_t* keys, const int64_t* key_offsets,
+    const uint8_t* key_null, const uint8_t* value_null,
+    const int64_t* timestamps, int64_t n_msgs, int64_t base_offset,
+    uint8_t* out, int64_t out_capacity) {
+  if (n_msgs < 0 || !out || !values || !value_offsets) return -1;
+  int64_t pos = 0;
+  for (int64_t i = 0; i < n_msgs; ++i) {
+    bool knull = true;
+    const uint8_t* kp = nullptr;
+    int64_t kn = 0;
+    if (keys && key_offsets) {
+      knull = key_null != nullptr && key_null[i] != 0;
+      kp = keys + key_offsets[i];
+      kn = key_offsets[i + 1] - key_offsets[i];
+    }
+    bool vnull = value_null != nullptr && value_null[i] != 0;
+    int64_t wrote = write_frame(
+        out + pos, out_capacity - pos, base_offset + i,
+        timestamps ? timestamps[i] : 0, kp, kn, knull,
+        values + value_offsets[i], value_offsets[i + 1] - value_offsets[i],
+        vnull);
+    if (wrote < 0) return -1;
+    pos += wrote;
+  }
+  return pos;
+}
+
+// Broker-side RAW_PRODUCE landing: CRC-validate every frame of a
+// pre-framed batch and stamp the real log offsets (base_offset + i)
+// into the frame heads, recomputing each CRC in place.  STRICT: any
+// torn tail, corrupt frame or trailing garbage rejects the WHOLE batch
+// (returns -(frames_ok + 1)) before a byte may land in the segment —
+// Kafka CORRUPT_MESSAGE semantics.  On success returns the frame count
+// with *out_max_ts the newest record timestamp (the timeindex anchor).
+int64_t iotml_frames_restamp(uint8_t* buf, int64_t buf_len,
+                             int64_t base_offset, int64_t* out_max_ts) {
+  int64_t pos = 0, n = 0, max_ts = -1;
+  while (pos < buf_len) {
+    if (pos + kLenSize > buf_len) return -(n + 1);  // trailing garbage
+    int64_t length = static_cast<int64_t>(be32(buf + pos));
+    int64_t body = pos + kLenSize;
+    int64_t end = body + length;
+    if (length < kMinBody || end > buf_len) return -(n + 1);
+    uint32_t crc = be32(buf + body);
+    if (crc32c(buf + body + 4, length - 4) != crc) return -(n + 1);
+    put64(buf + body + 5, static_cast<uint64_t>(base_offset + n));
+    put32(buf + body, crc32c(buf + body + 4, length - 4));
+    int64_t ts = be64(buf + body + 13);
+    if (ts > max_ts) max_ts = ts;
+    ++n;
+    pos = end;
+  }
+  if (out_max_ts) *out_max_ts = max_ts;
+  return n;
+}
+
+// Replica mirror-leg validation: walk a raw fetch batch, CRC-verify
+// every frame, and report the byte range + offset span of the frames
+// at/after `start_offset` (leading frames below it are the sparse-index
+// alignment the read path documents; a torn TAIL ends the batch
+// cleanly when strict == 0, rejects it when strict != 0).  Offsets must
+// be strictly increasing.  Returns the frame count in range with
+//   *out_first/*out_last   offset span (first == -1 when empty),
+//   *out_start/*out_end    byte range [start, end) of those frames,
+//   *out_max_ts            newest timestamp in range,
+//   *out_contiguous        1 when last - first + 1 == count (no holes).
+// A corrupt frame (strict) or non-monotone offset returns -(count+1).
+int64_t iotml_frames_validate(const uint8_t* buf, int64_t buf_len,
+                              int64_t start_offset, int64_t strict,
+                              int64_t* out_first, int64_t* out_last,
+                              int64_t* out_start, int64_t* out_end,
+                              int64_t* out_max_ts,
+                              int64_t* out_contiguous) {
+  int64_t pos = 0, n = 0;
+  int64_t first = -1, last = -1, max_ts = -1;
+  int64_t byte_start = -1, byte_end = 0;
+  int64_t prev_off = -1;
+  while (pos < buf_len) {
+    if (pos + kLenSize > buf_len) {
+      if (strict) return -(n + 1);
+      break;
+    }
+    int64_t length = static_cast<int64_t>(be32(buf + pos));
+    int64_t body = pos + kLenSize;
+    int64_t end = body + length;
+    if (length < kMinBody || end > buf_len ||
+        crc32c(buf + body + 4, length - 4) != be32(buf + body)) {
+      if (strict) return -(n + 1);
+      break;  // torn tail: the valid prefix is the batch
+    }
+    int64_t offset = be64(buf + body + 5);
+    if (offset <= prev_off) return -(n + 1);  // non-monotone: corrupt
+    prev_off = offset;
+    if (offset >= start_offset) {
+      if (first < 0) {
+        first = offset;
+        byte_start = pos;
+      }
+      last = offset;
+      int64_t ts = be64(buf + body + 13);
+      if (ts > max_ts) max_ts = ts;
+      byte_end = end;
+      ++n;
+    }
+    pos = end;
+  }
+  if (out_first) *out_first = first;
+  if (out_last) *out_last = last;
+  if (out_start) *out_start = byte_start < 0 ? 0 : byte_start;
+  if (out_end) *out_end = byte_end;
+  if (out_max_ts) *out_max_ts = max_ts;
+  if (out_contiguous)
+    *out_contiguous = (n == 0 || last - first + 1 == n) ? 1 : 0;
+  return n;
 }
 
 }  // extern "C"

@@ -48,7 +48,7 @@ namespace {
 constexpr int16_t API_PRODUCE = 0, API_FETCH = 1, API_LIST_OFFSETS = 2,
                   API_METADATA = 3, API_OFFSET_COMMIT = 8,
                   API_OFFSET_FETCH = 9, API_SASL_HANDSHAKE = 17,
-                  API_CREATE_TOPICS = 19;
+                  API_CREATE_TOPICS = 19, API_RAW_PRODUCE = 65;
 constexpr int16_t ERR_NONE = 0, ERR_TOPIC_EXISTS = 36;
 constexpr int64_t K_EIO = -2;  // -1 would collide with OffsetFetch's "no committed offset"
 // The fused decode found a Confluent schema id outside the pinned band
@@ -692,6 +692,33 @@ int64_t iotml_kafka_produce_nulls(void* h, const char* topic,
                                   const int64_t* timestamps, int64_t n) {
   return kafka_produce_impl(h, topic, partition, values, val_offsets, keys,
                             key_offsets, key_null, timestamps, n, value_null);
+}
+
+// RAW_PRODUCE (emulator-family extension, api 65 v0): ship a batch of
+// PRE-FRAMED store frames the broker appends segment-verbatim (CRCs
+// validated and offsets stamped server-side).  Returns the base offset,
+// or -1035 (UNSUPPORTED_VERSION → the caller pins back to classic
+// produce), -1002 (CORRUPT_MESSAGE → the whole batch was rejected,
+// nothing appended), -1006 (NOT_LEADER), K_EIO on transport death.
+// NOT idempotent: like produce, a lost connection mid-request surfaces
+// as a transport error and the caller owns redelivery.
+int64_t iotml_kafka_produce_raw(void* h, const char* topic,
+                                int32_t partition, const uint8_t* frames,
+                                int64_t frames_len) {
+  Client* c = static_cast<Client*>(h);
+  if (!frames || frames_len < 0) return K_EIO;
+  Writer body;
+  body.str(topic);
+  body.i32(partition);
+  body.bytes(frames, static_cast<int32_t>(frames_len));
+  std::vector<uint8_t> resp;
+  if (!request(c, API_RAW_PRODUCE, 0, body, resp)) return K_EIO;
+  Reader r(resp.data(), resp.size());
+  int16_t err = r.i16();
+  if (err != ERR_NONE) return proto_err(err);
+  int64_t base = r.i64();
+  r.i32();  // count
+  return r.fail ? K_EIO : base;
 }
 
 // Value-null flags of the staged fetch (1 byte per staged message).  Read

@@ -23,6 +23,18 @@ contract):
                              batcher still views)
   IOTML_RAW_BATCH_BYTES      max bytes per raw frame fetch (default
                              1 MiB — one disk/wire read per decode call)
+  IOTML_RAW_PRODUCE          write-path plane selector: ``auto`` (the
+                             default — RAW_PRODUCE where the broker
+                             supports it, classic PRODUCE fallback
+                             pinned on UNSUPPORTED_VERSION), ``on``
+                             (raw required: an extension-less server is
+                             an error, the CI-parity mode) or ``off``
+                             (classic everywhere, the debug escape
+                             hatch — also disables the broker's durable
+                             framing fusion)
+  IOTML_PRODUCE_BATCH_BYTES  max frame bytes per RAW_PRODUCE request
+                             (default 1 MiB); bigger accumulations are
+                             split at frame boundaries
 """
 
 from __future__ import annotations
@@ -36,7 +48,10 @@ _DEFAULTS = {
     "IOTML_PREFETCH_DEPTH": (2, 1),
     "IOTML_DECODE_RING_BUFFERS": (4, 2),
     "IOTML_RAW_BATCH_BYTES": (1 << 20, 4096),
+    "IOTML_PRODUCE_BATCH_BYTES": (1 << 20, 4096),
 }
+
+_RAW_PRODUCE_MODES = ("auto", "on", "off")
 
 
 def _env_int(name: str) -> int:
@@ -71,16 +86,44 @@ def raw_batch_bytes() -> int:
     return _env_int("IOTML_RAW_BATCH_BYTES")
 
 
+def produce_batch_bytes() -> int:
+    """Max frame bytes per RAW_PRODUCE request
+    (IOTML_PRODUCE_BATCH_BYTES, 1 MiB)."""
+    return _env_int("IOTML_PRODUCE_BATCH_BYTES")
+
+
+def raw_produce_mode() -> str:
+    """Write-path plane selector (IOTML_RAW_PRODUCE): auto|on|off.
+    A malformed value fails loudly, like every pipeline knob."""
+    raw = os.environ.get("IOTML_RAW_PRODUCE", "auto").strip().lower()
+    if raw == "":
+        return "auto"
+    if raw not in _RAW_PRODUCE_MODES:
+        raise ValueError(f"env IOTML_RAW_PRODUCE={raw!r}: expected one "
+                         f"of {'|'.join(_RAW_PRODUCE_MODES)}")
+    return raw
+
+
 def set_knobs(prefetch_depth: Optional[int] = None,
               decode_ring_buffers: Optional[int] = None,
-              raw_batch_bytes: Optional[int] = None) -> None:
+              raw_batch_bytes: Optional[int] = None,
+              produce_batch_bytes: Optional[int] = None,
+              raw_produce: Optional[str] = None) -> None:
     """CLI → env bridge: publish the given knobs into this process's
     environment (validated; None = leave as-is) so every pipeline built
     afterwards — and every supervised component thread — reads them.
     Used by ``cli.up`` / ``cli.live`` flags and the cluster CLI."""
+    if raw_produce is not None:
+        mode = str(raw_produce).strip().lower()
+        if mode not in _RAW_PRODUCE_MODES:
+            # validate BEFORE publishing (same contract as below)
+            raise ValueError(f"IOTML_RAW_PRODUCE={raw_produce!r}: expected "
+                             f"one of {'|'.join(_RAW_PRODUCE_MODES)}")
     for name, value in (("IOTML_PREFETCH_DEPTH", prefetch_depth),
                         ("IOTML_DECODE_RING_BUFFERS", decode_ring_buffers),
-                        ("IOTML_RAW_BATCH_BYTES", raw_batch_bytes)):
+                        ("IOTML_RAW_BATCH_BYTES", raw_batch_bytes),
+                        ("IOTML_PRODUCE_BATCH_BYTES",
+                         produce_batch_bytes)):
         if value is None:
             continue
         _default, lo = _DEFAULTS[name]
@@ -90,6 +133,8 @@ def set_knobs(prefetch_depth: Optional[int] = None,
             # an invalid value active process-wide
             raise ValueError(f"{name}={value}: must be >= {lo}")
         os.environ[name] = str(value)
+    if raw_produce is not None:
+        os.environ["IOTML_RAW_PRODUCE"] = mode
 
 
 class _Slot:

@@ -72,6 +72,21 @@ FRAMES_STOP_TORN = 1
 FRAMES_STOP_SCHEMA = 2
 
 
+class CorruptFrameError(ValueError):
+    """A pre-framed batch failed CRC/offset validation at frame `index`.
+
+    The write-path rejection signal: RAW_PRODUCE batches are validated
+    WHOLE before any byte may land in a segment (no torn/partial
+    appends), and the broker maps this to Kafka CORRUPT_MESSAGE (2)."""
+
+    def __init__(self, index: int, detail: str = ""):
+        super().__init__(
+            f"corrupt frame batch at frame {index}"
+            + (f": {detail}" if detail else "")
+            + " — whole batch rejected, nothing appended")
+        self.index = index
+
+
 def encode_frame_batch(entries) -> bytes:
     """[(offset, key, value, timestamp_ms, headers)] → contiguous frame
     bytes — how the IN-MEMORY broker (and the chaos fixtures) express a
@@ -82,6 +97,171 @@ def encode_frame_batch(entries) -> bytes:
     return b"".join(
         seg.encode_record(off, key, value, ts, headers)
         for off, key, value, ts, headers in entries)
+
+
+def iter_frame_entries(buf: bytes):
+    """Yield (offset, key, value, timestamp_ms, headers) for every valid
+    frame in `buf` — the in-memory emulator's RAW_PRODUCE landing leg
+    and the replica's in-memory mirror leg (both decode through the ONE
+    parser; the durable backend appends the bytes verbatim instead)."""
+    from ..store import segment as seg
+
+    for _pos, _end, off, key, value, ts, hdrs in seg.scan_records(buf):
+        yield off, key, value, ts, hdrs
+
+
+# ---------------------------------------------------- write-path helpers
+def _native_lib():
+    """frame_engine.cc when present — None falls back to the oracle."""
+    try:
+        from ..stream.native import load
+
+        return load()
+    except Exception:  # noqa: BLE001 - no toolchain: pure-python path
+        return None
+
+
+def frame_entries(entries, base_offset: int = 0) -> bytes:
+    """[(key, value, timestamp_ms[, headers])] → contiguous store frames
+    stamped ``base_offset + i`` — the generic produce-side framing entry
+    (bridge JSON leg, rekey pass-through, durable produce_many fusion).
+    Native (`iotml_frames_encode_values`) when the engine is loaded and
+    no entry carries headers; the python codec otherwise — output bytes
+    identical either way (pinned by tests)."""
+    entries = entries if isinstance(entries, list) else list(entries)
+    lib = _native_lib()
+    if lib is not None and entries and \
+            not any(len(e) > 3 and e[3] for e in entries):
+        import ctypes
+
+        import numpy as np
+
+        n = len(entries)
+        values = b"".join(e[1] or b"" for e in entries)
+        voff = np.zeros((n + 1,), np.int64)
+        np.cumsum([len(e[1] or b"") for e in entries], out=voff[1:])
+        vnull = np.asarray([1 if e[1] is None else 0 for e in entries],
+                           np.uint8)
+        keys = b"".join(e[0] or b"" for e in entries)
+        koff = np.zeros((n + 1,), np.int64)
+        np.cumsum([len(e[0] or b"") for e in entries], out=koff[1:])
+        knull = np.asarray([1 if e[0] is None else 0 for e in entries],
+                           np.uint8)
+        ts = np.asarray([e[2] for e in entries], np.int64)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        cap = len(values) + len(keys) + 64 * n + 64
+        out = ctypes.create_string_buffer(cap)
+        rc = lib.iotml_frames_encode_values(
+            ctypes.c_char_p(values), voff.ctypes.data_as(i64p),
+            ctypes.c_char_p(keys), koff.ctypes.data_as(i64p),
+            knull.ctypes.data_as(u8p), vnull.ctypes.data_as(u8p),
+            ts.ctypes.data_as(i64p), ctypes.c_int64(n),
+            ctypes.c_int64(int(base_offset)),
+            ctypes.cast(out, u8p), ctypes.c_int64(cap))
+        if rc >= 0:
+            return out.raw[:rc]
+    return encode_frame_batch(
+        (base_offset + i, e[0], e[1], e[2],
+         e[3] if len(e) > 3 else None)
+        for i, e in enumerate(entries))
+
+
+def restamp_frame_batch(buf: bytes, base_offset: int
+                        ) -> Tuple[bytes, int, int]:
+    """CRC-validate a pre-framed batch WHOLE and stamp real log offsets
+    (``base_offset + i``) into the frame heads, recomputing each CRC —
+    the broker's RAW_PRODUCE landing step.  Returns
+    ``(stamped_bytes, count, max_ts)``; raises `CorruptFrameError` on
+    any torn/corrupt frame or trailing garbage (nothing may land)."""
+    lib = _native_lib()
+    if lib is not None:
+        import ctypes
+
+        mutable = ctypes.create_string_buffer(bytes(buf), len(buf))
+        max_ts = ctypes.c_int64(-1)
+        rc = lib.iotml_frames_restamp(
+            ctypes.cast(mutable, ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.c_int64(len(buf)), ctypes.c_int64(int(base_offset)),
+            ctypes.byref(max_ts))
+        if rc < 0:
+            raise CorruptFrameError(-rc - 1, "CRC/length mismatch")
+        return mutable.raw[:len(buf)], int(rc), int(max_ts.value)
+    # oracle: strict scan through the one parser, then re-encode with the
+    # stamped offsets (byte-identical to the in-place native patch)
+    from ..store import segment as seg
+
+    out = []
+    consumed = 0
+    max_ts = -1
+    for _pos, end, _off, key, value, ts, hdrs in seg.scan_records(buf):
+        out.append(seg.encode_record(base_offset + len(out), key, value,
+                                     ts, hdrs))
+        if ts > max_ts:
+            max_ts = ts
+        consumed = end
+    if consumed != len(buf):
+        raise CorruptFrameError(len(out), "torn/corrupt tail")
+    return b"".join(out), len(out), max_ts
+
+
+def validate_frame_batch(buf: bytes, start_offset: int = 0,
+                         strict: bool = False) -> dict:
+    """CRC + offset-monotonicity walk over a raw frame batch — the
+    replica's zero-copy mirror validation.  Frames below `start_offset`
+    are the sparse-index alignment (skipped); a torn TAIL ends the batch
+    (strict=False) or rejects it (strict=True).  Returns a dict with
+    ``count / first / last / max_ts / start_pos / end_pos / contiguous``
+    where [start_pos, end_pos) is the byte range of the in-range frames
+    (appendable verbatim).  Raises `CorruptFrameError` on a strict
+    violation or a non-monotone offset."""
+    lib = _native_lib()
+    if lib is not None:
+        import ctypes
+
+        outs = [ctypes.c_int64(0) for _ in range(6)]
+        rc = lib.iotml_frames_validate(
+            ctypes.cast(ctypes.c_char_p(bytes(buf)),
+                        ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.c_int64(len(buf)), ctypes.c_int64(int(start_offset)),
+            ctypes.c_int64(1 if strict else 0),
+            *[ctypes.byref(o) for o in outs])
+        if rc < 0:
+            raise CorruptFrameError(-rc - 1, "validation failed")
+        first, last, start_pos, end_pos, max_ts, contiguous = \
+            (int(o.value) for o in outs)
+        return dict(count=int(rc), first=first, last=last,
+                    max_ts=max_ts, start_pos=start_pos, end_pos=end_pos,
+                    contiguous=bool(contiguous))
+    from ..store import segment as seg
+
+    count = 0
+    first = last = -1
+    start_pos = 0
+    end_pos = 0
+    max_ts = -1
+    prev = -1
+    consumed = 0
+    for pos, end, off, _k, _v, ts, _h in seg.scan_records(buf):
+        if off <= prev:
+            raise CorruptFrameError(count, "non-monotone offset")
+        prev = off
+        consumed = end
+        if off < start_offset:
+            continue
+        if first < 0:
+            first = off
+            start_pos = pos
+        last = off
+        end_pos = end
+        if ts > max_ts:
+            max_ts = ts
+        count += 1
+    if strict and consumed != len(buf):
+        raise CorruptFrameError(count, "torn/corrupt tail")
+    return dict(count=count, first=first, last=last, max_ts=max_ts,
+                start_pos=start_pos, end_pos=end_pos,
+                contiguous=count == 0 or last - first + 1 == count)
 
 
 def decode_frames_columnar_py(

@@ -360,6 +360,50 @@ class SegmentedLog:
             self._segments[-1].next_offset = offset
         return self.append(key, value, timestamp_ms, headers, sync=sync)
 
+    def append_raw(self, blob: bytes, count: int, first_offset: int,
+                   last_offset: int, max_ts: int,
+                   sync: bool = True) -> int:
+        """Append a VALIDATED raw frame batch verbatim — the zero-copy
+        write path (RAW_PRODUCE landing, replica mirror leg, fused
+        produce_many framing): the batch's bytes become the segment's
+        bytes in one write, no per-record re-serialisation.  The caller
+        (Broker) has already CRC-validated the whole batch and stamped
+        the offsets; ``first_offset`` past the log end reproduces an
+        offset hole (the compacted-mirror case), exactly like
+        ``append_at``.  Indexing is batch-granular: one sparse-index
+        candidate at the batch head and one timeindex entry at the
+        batch's max timestamp — both are conservative lower bounds, so
+        reads only ever start earlier, never skip records."""
+        if count <= 0:
+            return first_offset
+        self._maybe_roll()
+        active = self._segments[-1]
+        if first_offset < active.next_offset:
+            raise ValueError(
+                f"append_raw({first_offset}) behind log end "
+                f"{active.next_offset}: offsets only move forward")
+        pos = self._writer.append(blob)
+        if not active.index or pos - active.index[-1][1] >= \
+                self.policy.index_interval_bytes:
+            active.index.append((first_offset, pos))
+        if max_ts > active.max_ts:
+            active.timeindex.append((max_ts, first_offset))
+            active.max_ts = max_ts
+        active.next_offset = last_offset + 1
+        active.size += len(blob)
+        self._total_bytes += len(blob)
+        if self.policy.fsync == "always":
+            if sync:
+                self._writer.sync()
+            self._last_fsync = time.monotonic()
+        elif self.policy.fsync == "interval":
+            now = time.monotonic()
+            if now - self._last_fsync >= self.policy.fsync_interval_s:
+                self._writer.sync()
+                self._last_fsync = now
+        self._update_size_gauge()
+        return first_offset
+
     def sync_batch(self) -> None:
         """The deferred half of ``append(sync=False)`` under
         ``fsync=always``; cheap no-op otherwise."""
@@ -528,22 +572,33 @@ class SegmentedLog:
         if offset >= end:
             return None
         s = self._segment_for(segments, offset)
-        if s is None or offset >= s.next_offset:
-            # recovery-truncated hole before the next segment: serve the
-            # successor from its base (same monotone-recovery promise as
-            # read_from's hole jump)
-            nxt = [x for x in segments if x.base_offset > offset]
-            if not nxt:
-                return None
-            s = nxt[0]
-            offset = s.base_offset
         start_pos = 0
-        for o, pos in reversed(s.index):
-            if o <= offset:
-                start_pos = pos
+        want = 0
+        for _ in range(len(segments) + 1):
+            if s is None or offset >= s.next_offset:
+                # recovery-truncated hole before the next segment: serve
+                # the successor from its base (same monotone-recovery
+                # promise as read_from's hole jump)
+                nxt = [x for x in segments if x.base_offset > offset]
+                if not nxt:
+                    return None
+                s = nxt[0]
+                offset = s.base_offset
+            start_pos = 0
+            for o, pos in reversed(s.index):
+                if o <= offset:
+                    start_pos = pos
+                    break
+            want = min(max(int(max_bytes), seg.MIN_BODY + 8),
+                       s.size - start_pos)
+            if want > 0:
                 break
-        want = min(max(int(max_bytes), seg.MIN_BODY + 8),
-                   s.size - start_pos)
+            # a compaction-emptied segment (zero bytes, base/next_offset
+            # preserved to keep the log head stable): jump past it like
+            # the hole case — returning None here would read as log end
+            # and park every raw reader forever
+            offset = s.next_offset
+            s = self._segment_for(segments, offset)
         if want <= 0:
             return None
         try:

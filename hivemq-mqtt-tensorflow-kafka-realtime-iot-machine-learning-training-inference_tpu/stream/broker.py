@@ -71,6 +71,25 @@ class SchemaIdMismatchError(ValueError):
         self.offset = offset
 
 
+class CorruptMessageError(ValueError):
+    """A pre-framed RAW_PRODUCE batch failed CRC/offset validation.
+
+    The whole batch is rejected BEFORE any byte lands in the segment —
+    no torn/partial appends, ever (the write-path twin of crash
+    recovery's truncate-at-first-bad-frame).  The wire server answers
+    Kafka CORRUPT_MESSAGE (2); the producing client re-frames and
+    redelivers (caller-owns-redelivery, like every produce)."""
+
+    def __init__(self, topic: str, partition: int, index: int):
+        super().__init__(
+            f"corrupt pre-framed batch for {topic}:{partition} at frame "
+            f"{index}: whole batch rejected, nothing appended "
+            f"(Kafka CORRUPT_MESSAGE)")
+        self.topic = topic
+        self.partition = partition
+        self.index = index
+
+
 class OffsetOutOfRangeError(LookupError):
     """Fetch below the partition's retained base offset.
 
@@ -261,6 +280,19 @@ class _Partition:
                 f"compacted-topic mirroring")
         return self.append(key, value, ts, headers, sync=sync)
 
+    def append_raw(self, blob, count, first, last, max_ts,
+                   sync: bool = True) -> int:
+        """Land a validated raw frame batch.  The in-memory emulator has
+        no segment to append bytes to, so it decodes through the ONE
+        frame parser (`ops.framing.iter_frame_entries`) — the compat
+        path; the durable backend appends the batch's own bytes.  Offset
+        holes follow append_at's rule (dense list = gap-free only)."""
+        from ..ops.framing import iter_frame_entries
+
+        for off, key, value, ts, hdrs in iter_frame_entries(blob):
+            self.append_at(off, key, value, ts, hdrs, sync=False)
+        return first
+
     def align_base(self, offset: int) -> None:
         if self.log:
             raise ValueError("partition not empty; base is immutable")
@@ -297,6 +329,14 @@ class _DurablePartition:
                   sync: bool = True) -> int:
         return self.slog.append_at(offset, key, value, ts, headers,
                                    sync=sync)
+
+    def append_raw(self, blob, count, first, last, max_ts,
+                   sync: bool = True) -> int:
+        """Append a validated raw frame batch SEGMENT-VERBATIM — the
+        batch's own bytes become the log's bytes, no re-serialisation
+        (the zero-copy write path; offset holes reproduce exactly)."""
+        return self.slog.append_raw(blob, count, first, last, max_ts,
+                                    sync=sync)
 
     def sync_batch(self) -> None:
         self.slog.sync_batch()
@@ -518,6 +558,15 @@ class Broker:
             off = self.produce(topic, v, key=key, partition=partition)
         return off
 
+    @staticmethod
+    def _raw_produce_enabled() -> bool:
+        """IOTML_RAW_PRODUCE gate for the broker-internal durable
+        framing fusion (on/auto = fused when the native engine loads;
+        off = the per-record python encoder, the debug escape hatch)."""
+        from ..data.pipeline import raw_produce_mode
+
+        return raw_produce_mode() != "off"
+
     def produce_many(self, topic: str, entries,
                      partition: Optional[int] = None) -> int:
         """Bulk append [(key, value, timestamp_ms[, headers]), ...] under
@@ -530,16 +579,50 @@ class Broker:
         retention trimming) — minus a lock round-trip and method dispatch
         per message, the ingest bridges' hot path.  The optional 4th
         element carries record headers (trace context); wire/native
-        clients accept and drop it (no header slot on MessageSet v1)."""
+        clients accept and drop it (no header slot on MessageSet v1).
+
+        Durable backends FUSE the framing (ISSUE 12): each partition's
+        slice is framed as ONE native batch (`ops.framing.frame_entries`,
+        byte-identical to the per-record codec) and appended
+        segment-verbatim — the per-record python encode loop disappears
+        behind a batch call.  Traced entries (record headers) keep the
+        per-record path, which is the headers' only encoder."""
         chaos.point("broker.produce")
         self._check_producer(topic)
         entries = list(entries)
         if topic not in self._topics:
             self.create_topic(topic)
         last_off = -1
+        fuse = self.store is not None and self._raw_produce_enabled()
         with self._lock:
             parts = self._parts[topic]
             spec = self._topics[topic]
+            if fuse and entries and \
+                    not any(len(e) > 3 and e[3] for e in entries):
+                from ..ops.framing import frame_entries
+                by_part: Dict[int, list] = {}
+                last_p = partition
+                if partition is None:
+                    for entry in entries:
+                        p = self._partition_for(topic, entry[0])
+                        by_part.setdefault(p, []).append(entry)
+                        last_p = p
+                else:
+                    by_part[partition] = entries
+                ends: Dict[int, int] = {}
+                for p, ents in by_part.items():
+                    part = parts[p]
+                    base = part.end()
+                    blob = frame_entries(ents, base)
+                    part.append_raw(blob, len(ents), base,
+                                    base + len(ents) - 1,
+                                    max(e[2] for e in ents), sync=False)
+                    ends[p] = base + len(ents) - 1
+                    part.sync_batch()
+                    part.enforce_retention(spec)
+                # same return contract as the per-record loop: the offset
+                # the FINAL entry landed at (its partition's batch end)
+                return ends[last_p]
             touched = set()
             for entry in entries:
                 key, value, ts = entry[0], entry[1], entry[2]
@@ -575,6 +658,85 @@ class Broker:
         with self._lock:
             return self._parts[topic][partition].append_at(
                 offset, key, value, timestamp_ms, headers)
+
+    # -------------------------------------------------------- raw produce
+    def produce_raw(self, topic: str, partition: int,
+                    frames: bytes) -> int:
+        """Append a PRE-FRAMED batch (contiguous store frames, offsets
+        unstamped) — the RAW_PRODUCE landing: every CRC is validated
+        WHOLE-batch first, then the real log offsets are stamped into
+        the frame heads (CRCs recomputed) and the durable backend
+        appends the batch's own bytes segment-verbatim; the in-memory
+        emulator decodes through the one `ops.framing` parser (compat
+        path).  Returns the batch's base offset.
+
+        A torn/corrupt batch raises `CorruptMessageError` BEFORE any
+        byte lands (Kafka CORRUPT_MESSAGE=2 on the wire): no partial
+        appends, acked counts and replay stay byte-identical after a
+        rejection.  NOT idempotent — caller owns redelivery, exactly
+        like produce."""
+        from ..ops import framing as _fr
+
+        act = chaos.point("broker.produce_raw")
+        if act is not None and act.kind == "corrupt":
+            # seeded corruption of the in-flight batch: one flipped byte
+            # must reject the WHOLE batch with zero bytes landed
+            mangled = bytearray(frames)
+            if mangled:
+                mangled[len(mangled) // 2] ^= 0xFF
+            frames = bytes(mangled)
+        self._check_producer(topic)
+        if topic not in self._topics:
+            self.create_topic(topic, partitions=max(partition + 1, 1))
+        part = self._parts[topic][partition]
+        with self._lock:
+            base = part.end()
+            try:
+                stamped, count, max_ts = _fr.restamp_frame_batch(
+                    frames, base)
+            except _fr.CorruptFrameError as e:
+                raise CorruptMessageError(topic, partition,
+                                          e.index) from e
+            if count:
+                part.append_raw(stamped, count, base, base + count - 1,
+                                max_ts, sync=False)
+                part.sync_batch()
+                part.enforce_retention(self._topics[topic])
+        return base
+
+    def produce_raw_at(self, topic: str, partition: int,
+                       frames: bytes) -> int:
+        """Append a raw frame batch AT its own stamped offsets — the
+        replica's zero-copy mirror leg (RAW_FETCH hands back frames
+        with the leader's offsets already in the heads; after CRC
+        validation they append verbatim, holes reproduced).  The
+        in-memory backend decodes per record and accepts only gap-free
+        continuations (append_at's rule).  Returns the last offset
+        appended (-1 for an empty batch)."""
+        from ..ops import framing as _fr
+
+        self._check_producer(topic)
+        if topic not in self._topics:
+            self.create_topic(topic, partitions=max(partition + 1, 1))
+        try:
+            v = _fr.validate_frame_batch(frames, strict=True)
+        except _fr.CorruptFrameError as e:
+            raise CorruptMessageError(topic, partition, e.index) from e
+        if not v["count"]:
+            return -1
+        part = self._parts[topic][partition]
+        with self._lock:
+            end = part.end()
+            if v["first"] < end:
+                raise ValueError(
+                    f"raw mirror batch for {topic}:{partition} starts at "
+                    f"{v['first']} behind log end {end}: offsets only "
+                    f"move forward")
+            part.append_raw(frames, v["count"], v["first"], v["last"],
+                            v["max_ts"], sync=False)
+            part.sync_batch()
+            part.enforce_retention(self._topics[topic])
+        return v["last"]
 
     # ---------------------------------------------------------- compaction
     def run_compaction(self, force: bool = False) -> Dict[tuple, object]:

@@ -37,7 +37,8 @@ from typing import Dict, List, Optional, Tuple
 
 from ..chaos import faults as chaos
 from ..utils.net import recv_exact
-from .broker import Broker, Message, OffsetOutOfRangeError, TopicSpec
+from .broker import (Broker, CorruptMessageError, Message,
+                     OffsetOutOfRangeError, TopicSpec)
 
 # api keys
 PRODUCE, FETCH, LIST_OFFSETS, METADATA = 0, 1, 2, 3
@@ -54,10 +55,20 @@ SASL_HANDSHAKE, API_VERSIONS, CREATE_TOPICS = 17, 18, 19
 # clients never send it; standard servers answer UNSUPPORTED_VERSION
 # and the client falls back to classic FETCH.
 RAW_FETCH = 64
+# The write-path mirror of RAW_FETCH (ISSUE 12): a produce whose
+# payload is PRE-FRAMED store frames (offsets unstamped) the broker
+# appends segment-verbatim after whole-batch CRC validation + offset
+# stamping.  NOT idempotent (caller-owns-redelivery, exactly like
+# PRODUCE — deliberately absent from IDEMPOTENT_APIS); a corrupt batch
+# answers Kafka CORRUPT_MESSAGE (2) with nothing appended, and servers
+# without the extension answer UNSUPPORTED_VERSION so producing clients
+# pin back to classic PRODUCE.
+RAW_PRODUCE = 65
 
 # error codes
 ERR_NONE = 0
 ERR_OFFSET_OUT_OF_RANGE = 1
+ERR_CORRUPT_MESSAGE = 2
 ERR_UNKNOWN_TOPIC = 3
 ERR_NOT_LEADER_FOR_PARTITION = 6
 ERR_NOT_COORDINATOR = 16
@@ -76,7 +87,8 @@ _SUPPORTED = {PRODUCE: (2, 2), FETCH: (2, 2), LIST_OFFSETS: (1, 1),
               FIND_COORDINATOR: (0, 0), JOIN_GROUP: (0, 0),
               HEARTBEAT: (0, 0), LEAVE_GROUP: (0, 0), SYNC_GROUP: (0, 0),
               SASL_HANDSHAKE: (0, 0), API_VERSIONS: (0, 0),
-              CREATE_TOPICS: (0, 0), RAW_FETCH: (0, 0)}
+              CREATE_TOPICS: (0, 0), RAW_FETCH: (0, 0),
+              RAW_PRODUCE: (0, 0)}
 
 # APIs the client may auto-retry after a reconnect (see _request): a
 # duplicate of any of these is invisible (pure reads) or a no-op
@@ -821,6 +833,41 @@ class KafkaWireBroker(ProducePartitionMixin):
                 last = max(last, base + len(by_part[p]) - 1)
         return last
 
+    def produce_raw(self, topic: str, partition: int,
+                    frames: bytes) -> int:
+        """RAW_PRODUCE over the wire: ship a pre-framed batch the broker
+        appends segment-verbatim (CRC-validated whole, offsets stamped
+        server-side).  Returns the batch's base offset.
+
+        Raises NotImplementedError against a server without the
+        extension (producers pin back to classic produce — the
+        UNSUPPORTED_VERSION fallback), CorruptMessageError when the
+        server rejected the whole batch (nothing appended; re-frame and
+        redeliver), NotLeaderForPartitionError on a sharded bounce, and
+        ConnectionError on transport death — NOT auto-retried, the
+        caller owns redelivery exactly like produce."""
+        w = _Writer()
+        w.string(topic).i32(partition).bytes_(frames)
+        # retry-ok: RAW_PRODUCE is NOT auto-retried (double-append risk,
+        # same stance as produce); ConnectionError reaches the producer
+        r = self._request(RAW_PRODUCE, 0, bytes(w.buf))
+        err = r.i16()
+        if err == ERR_UNSUPPORTED_VERSION:
+            raise NotImplementedError(
+                "server lacks the RAW_PRODUCE extension")
+        base = r.i64()
+        r.i32()  # count
+        if err == ERR_CORRUPT_MESSAGE:
+            raise CorruptMessageError(topic, partition, int(base))
+        if err == ERR_FENCED_LEADER_EPOCH:
+            raise self._fenced(f"raw produce to {topic}:{partition}")
+        if err == ERR_NOT_LEADER_FOR_PARTITION:
+            raise NotLeaderForPartitionError(topic, partition)
+        if err != ERR_NONE:
+            raise RuntimeError(
+                f"raw produce to {topic}:{partition} failed: {err}")
+        return base
+
     # --------------------------------------------------------------- fetch
     def fetch(self, topic: str, partition: int, offset: int,
               max_messages: int = 1024) -> List[Message]:
@@ -1525,6 +1572,44 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                     else:
                         w.i16(ERR_NONE).i64(raw.start_offset)
                         w.bytes_(raw.data)
+        elif api_key == RAW_PRODUCE:
+            # write-path mirror of RAW_FETCH: a pre-framed batch the
+            # broker appends segment-verbatim (CRCs validated WHOLE,
+            # offsets stamped into the frame heads server-side).  A
+            # corrupt batch answers CORRUPT_MESSAGE with nothing
+            # appended — no torn/partial appends ever reach a segment.
+            tname = r.string()
+            pid = r.i32()
+            frames = r.bytes_() or b""
+            produce_raw = getattr(broker, "produce_raw", None)
+            if self._epoch_mismatch(client_epoch):
+                # fence BEFORE touching the broker, like classic produce
+                w.i16(ERR_FENCED_LEADER_EPOCH).i64(-1).i32(0)
+            elif produce_raw is None:
+                # relay broker without raw appends: same downgrade as a
+                # pre-extension server — clients pin back to classic
+                w.i16(ERR_UNSUPPORTED_VERSION)
+            else:
+                if tname not in broker.topics() and cluster is None:
+                    broker.create_topic(tname, partitions=max(pid + 1, 1))
+                if not self._valid_part(broker, tname, pid):
+                    w.i16(ERR_UNKNOWN_TOPIC).i64(-1).i32(0)
+                else:
+                    try:
+                        base = produce_raw(tname, pid, frames)
+                    except NotImplementedError:
+                        w.i16(ERR_UNSUPPORTED_VERSION)
+                    except CorruptMessageError as e:
+                        w.i16(ERR_CORRUPT_MESSAGE).i64(e.index).i32(0)
+                    except NotLeaderForPartitionError:
+                        w.i16(ERR_NOT_LEADER_FOR_PARTITION).i64(-1).i32(0)
+                    except PermissionError:
+                        # engine-owned topic without the owner's grant
+                        w.i16(ERR_TOPIC_AUTHORIZATION_FAILED).i64(-1)
+                        w.i32(0)
+                    else:
+                        w.i16(ERR_NONE).i64(base)
+                        w.i32(broker.end_offset(tname, pid) - base)
         elif api_key == LIST_OFFSETS:
             r.i32()  # replica
 

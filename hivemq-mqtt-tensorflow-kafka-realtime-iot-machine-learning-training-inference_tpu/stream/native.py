@@ -42,7 +42,7 @@ def _build() -> bool:
         return False
 
 
-ENGINE_VERSION = 7  # must match iotml_engine_version() in avro_engine.cc
+ENGINE_VERSION = 8  # must match iotml_engine_version() in avro_engine.cc
 
 
 def _stale() -> bool:
@@ -87,6 +87,11 @@ def load() -> Optional[ctypes.CDLL]:
         lib.iotml_format_rows_f32.restype = ctypes.c_int64
         lib.iotml_format_rows_f64.restype = ctypes.c_int64
         lib.iotml_frames_decode_columnar.restype = ctypes.c_int64
+        # write-path frame codec (ABI 8, frame_engine.cc)
+        lib.iotml_frames_encode_columnar.restype = ctypes.c_int64
+        lib.iotml_frames_encode_values.restype = ctypes.c_int64
+        lib.iotml_frames_restamp.restype = ctypes.c_int64
+        lib.iotml_frames_validate.restype = ctypes.c_int64
         _lib = lib
     except (OSError, AttributeError):
         _lib = None
@@ -285,6 +290,72 @@ class NativeCodec:
             raise ValueError("encode rejected (overflow or impossible null)")
         raw = out.tobytes()
         return [raw[offsets[i]:offsets[i + 1]] for i in range(n)]
+
+    def encode_frames(self, numeric: np.ndarray,
+                      labels: Optional[np.ndarray],
+                      timestamps: Optional[np.ndarray] = None,
+                      keys=None, schema_id: int = 1,
+                      nulls: Optional[np.ndarray] = None,
+                      base_offset: int = 0,
+                      stride: int = LABEL_STRIDE) -> bytes:
+        """Columnar rows → ONE contiguous ready-to-append raw frame
+        batch: Confluent-framed Avro values wrapped in the store's
+        CRC32C frame, offsets stamped ``base_offset + i`` — the fused
+        produce leg (a record is framed ONCE at conversion and never
+        re-serialised; `Broker.produce_raw` appends these bytes
+        segment-verbatim after restamping).  Byte parity with the
+        python codec + store frame oracle is pinned by tests.
+
+        `keys`: optional list of per-row key bytes (None entries = null
+        key), or an ``S``-dtype array (all non-null) — the S-array form
+        is passed as ONE fixed-stride block, zero per-record objects."""
+        numeric = np.ascontiguousarray(numeric, np.float64)
+        n = numeric.shape[0]
+        if labels is None:
+            labels = np.zeros((n, self.n_strings), f"S{stride}")
+        labels = np.ascontiguousarray(labels.astype(f"S{stride}"))
+        ts = np.zeros((n,), np.int64) if timestamps is None else \
+            np.ascontiguousarray(timestamps, np.int64)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        kargs = (None, None, ctypes.c_int64(0), None)
+        key_bytes = 0
+        if isinstance(keys, np.ndarray):
+            keys = np.ascontiguousarray(keys)
+            kargs = (keys.ctypes.data_as(u8p), None,
+                     ctypes.c_int64(keys.dtype.itemsize), None)
+            key_bytes = keys.nbytes
+        elif keys is not None:
+            kblob = b"".join(k or b"" for k in keys)
+            koff = np.zeros((n + 1,), np.int64)
+            np.cumsum([len(k or b"") for k in keys], out=koff[1:])
+            knull = np.asarray([1 if k is None else 0 for k in keys],
+                               np.uint8)
+            kargs = (ctypes.c_char_p(kblob), koff.ctypes.data_as(i64p),
+                     ctypes.c_int64(0), knull.ctypes.data_as(u8p))
+            key_bytes = len(kblob)
+        # worst case per row: frame head + value (5 + 20/field + strings)
+        cap = n * (64 + 5 + self.n_fields * 20
+                   + self.n_strings * stride) + key_bytes + 64
+        out = ctypes.create_string_buffer(cap)
+        nargs = None if nulls is None else np.ascontiguousarray(
+            nulls, np.uint8).ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        rc = self._lib.iotml_frames_encode_columnar(
+            numeric.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            labels.ctypes.data_as(ctypes.c_char_p),
+            ctypes.c_int64(stride), ctypes.c_int64(n),
+            self.types.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+            self.nullable.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.c_int64(self.n_fields), ctypes.c_int64(schema_id),
+            nargs, *kargs,
+            ts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.c_int64(int(base_offset)),
+            ctypes.cast(out, ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.c_int64(cap))
+        if rc < 0:
+            raise ValueError(
+                "frame encode rejected (overflow or impossible null)")
+        return out.raw[:rc]
 
 
 #: flag bits reported by the frame decoder (frame_engine.cc FrameFlags)

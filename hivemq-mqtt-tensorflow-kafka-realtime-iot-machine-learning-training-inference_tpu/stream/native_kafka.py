@@ -25,8 +25,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .broker import (Message, OffsetOutOfRangeError, SchemaIdMismatchError,
-                     TopicSpec)
+from .broker import (CorruptMessageError, Message, OffsetOutOfRangeError,
+                     SchemaIdMismatchError, TopicSpec)
 from .kafka_wire import NotLeaderForPartitionError, ProducePartitionMixin
 from .native import LABEL_STRIDE, NativeCodec, load
 
@@ -77,6 +77,7 @@ def _sig(lib) -> None:
         "produce_nulls": [c.c_void_p, c.c_char_p, c.c_int32, c.c_char_p,
                           _i64p, c.c_char_p, _i64p, _u8p, _u8p, _i64p,
                           c.c_int64],
+        "produce_raw": [c.c_void_p, c.c_char_p, c.c_int32, _u8p, c.c_int64],
         "fetch": [c.c_void_p, c.c_char_p, c.c_int32, c.c_int64, c.c_int64],
         "staged_bytes": [c.c_void_p, _i64p, _i64p],
         "staged_value_nulls": [c.c_void_p, _u8p],
@@ -271,6 +272,28 @@ class NativeKafkaBroker(ProducePartitionMixin):
                 base = _check(rc, f"produce({topic}:{p})")
                 last = max(last, base + len(ents) - 1)
             return last
+
+    def produce_raw(self, topic: str, partition: int,
+                    frames: bytes) -> int:
+        """RAW_PRODUCE through the C++ client: the pre-framed batch
+        bytes go straight onto the socket (no MessageSet re-encode, no
+        per-record work).  Same error surface as the Python wire client:
+        NotImplementedError on an extension-less server (pin back to
+        classic), CorruptMessageError on whole-batch rejection,
+        NotLeaderForPartitionError on a sharded bounce."""
+        with self._lock:
+            rc = self._lib.iotml_kafka_produce_raw(
+                self._h, topic.encode(), partition,
+                ctypes.cast(ctypes.c_char_p(frames), _u8p),
+                ctypes.c_int64(len(frames)))
+            if rc == -1035:
+                raise NotImplementedError(
+                    "server lacks the RAW_PRODUCE extension")
+            if rc == -1002:
+                raise CorruptMessageError(topic, partition, -1)
+            if rc == -1006:
+                raise NotLeaderForPartitionError(topic, partition)
+            return _check(rc, f"produce_raw({topic}:{partition})")
 
     # --------------------------------------------------------------- fetch
     def _raise_out_of_range(self, rc: int, topic: str, partition: int,

@@ -139,6 +139,21 @@ class FollowerReplica:
         self.sync_errors: list = []
         self.rounds = 0
         self.promoted = False
+        #: zero-copy mirror plane (ISSUE 12): RAW_FETCH batches append
+        #: verbatim after CRC validation (offsets already stamped).
+        #: None = undecided; the first UNSUPPORTED_VERSION from the
+        #: leader pins the follower back to the classic per-record leg
+        #: permanently (same one-way downgrade as every raw-plane
+        #: client).  IOTML_RAW_PRODUCE=off starts pinned classic.
+        self._raw_mirror: Optional[bool] = None
+        try:
+            from ..data.pipeline import raw_produce_mode
+
+            if raw_produce_mode() == "off":
+                self._raw_mirror = False
+        except ValueError:
+            self._raw_mirror = False
+        self.raw_mirrored = 0  # records copied over the raw leg
 
     # -------------------------------------------------------- lifecycle
     @property
@@ -273,6 +288,15 @@ class FollowerReplica:
                     continue
                 while not self._stop.is_set():
                     local_end = self.local.end_offset(t, p)
+                    if self._raw_mirror is not False:
+                        n, verdict = self._sync_raw(t, p, local_end,
+                                                    compacted)
+                        copied += n
+                        if verdict == "continue":
+                            continue
+                        if verdict == "break":
+                            break
+                        # "classic": per-record leg takes this batch
                     try:
                         msgs = self._leader.fetch(t, p, local_end,
                                                   max_messages=self._batch)
@@ -351,6 +375,76 @@ class FollowerReplica:
                     self.local.commit(g, t, p, off)
             self._last_commit_sync = time.monotonic()
         return copied
+
+    def _sync_raw(self, t: str, p: int, local_end: int,
+                  compacted: bool):
+        """One zero-copy mirror round: RAW_FETCH the leader's frame
+        batch, CRC-validate it, and append the in-range bytes VERBATIM
+        (offsets already stamped by the leader — identical offsets are
+        the failover contract, now also identical bytes).  Returns
+        ``(records_copied, verdict)`` with verdict one of ``continue``
+        (made progress / realigned — poll again), ``break`` (caught
+        up), ``classic`` (this batch takes the per-record leg; a
+        NotImplementedError pins the whole follower back)."""
+        from ..data.pipeline import raw_batch_bytes
+        from ..ops import framing as _fr
+
+        try:
+            raw = self._leader.fetch_raw(t, p, local_end,
+                                         max_bytes=raw_batch_bytes())
+        except NotImplementedError:
+            # pre-extension leader: one-way downgrade, like consumers
+            self._raw_mirror = False
+            return 0, "classic"
+        except OffsetOutOfRangeError as e:
+            begin = max(e.earliest, self._leader.begin_offset(t, p))
+            if begin <= local_end:
+                return 0, "break"  # raced a concurrent trim; next round
+            self.sync_errors.append(
+                f"trimmed past cursor {t}:{p} "
+                f"{local_end}->{begin}; realigned")
+            self.local.reset_partition(t, p, begin)
+            return 0, "continue"
+        if raw is None:
+            return 0, "break"
+        try:
+            v = _fr.validate_frame_batch(raw.data,
+                                         start_offset=local_end)
+        except _fr.CorruptFrameError as e:
+            # a corrupt mid-batch frame from the leader: let the
+            # classic leg (whose fetch re-reads decoded records) decide
+            self.sync_errors.append(f"raw mirror {t}:{p}: {e}")
+            return 0, "classic"
+        if v["count"] == 0:
+            # a NON-empty batch with no complete in-range frame: either
+            # torn at the cursor (a record larger than the raw-batch
+            # byte cap) or pure alignment slack — the classic
+            # per-record leg takes this batch, so an oversized record
+            # can never park the mirror forever (the write-side twin of
+            # the consume path's torn-at-cursor probe)
+            return 0, ("classic" if raw.data else "break")
+        if not compacted and v["first"] != local_end:
+            # leader trimmed past our cursor (retention outran
+            # replication): REALIGN — the PR 6 semantics, unchanged
+            self.sync_errors.append(
+                f"trimmed past cursor {t}:{p} "
+                f"{local_end}->{v['first']}; realigned")
+            self.local.reset_partition(t, p, v["first"])
+        if compacted and not getattr(self.local, "durable", False) and \
+                (v["first"] != local_end or not v["contiguous"]):
+            # compaction holes need a durable local (a dense in-memory
+            # list cannot hold them): per-record leg, same surface as
+            # produce_at's refusal
+            return 0, "classic"
+        blob = raw.data[v["start_pos"]:v["end_pos"]]
+        try:
+            self.local.produce_raw_at(t, p, blob)
+        except ValueError as e:
+            self.sync_errors.append(f"raw mirror {t}:{p}: {e}")
+            return 0, "classic"
+        self._raw_mirror = True
+        self.raw_mirrored += v["count"]
+        return v["count"], "continue"
 
     def lag(self) -> Dict[str, int]:
         """Per-topic messages the leader has that this follower doesn't —

@@ -715,6 +715,9 @@ class SqlSelectTask(StreamTask):
         #: batches instead of reaching downstream consumers silently
         self._sample_every = max(int(passthrough_sample), 0)
         self._passthrough_batches = 0
+        #: partition-affinity verdict for the raw produce leg (None =
+        #: not yet checked; see process_raw)
+        self._raw_affine = None
 
     def _project(self, rec: dict) -> Optional[dict]:
         out = {}
@@ -848,6 +851,126 @@ class SqlSelectTask(StreamTask):
                         header + zigzag_encode(len(key)) + key + m.value[5:],
                         m.timestamp_ms))
         return out
+
+    def process_raw(self, messages):
+        """Zero-copy produce leg of the fused JSON→AVRO star copy
+        (ISSUE 12): the C++ JSON parser fills columnar buffers, the C++
+        frame encoder emits a ready-to-append raw frame batch (Avro
+        encoded AND framed in ONE native call — a record is framed once
+        at conversion and never re-serialised), and RAW_PRODUCE appends
+        it segment-verbatim.  Partition AFFINITY makes this sound: the
+        star copy preserves the message key, so the sink's key-hash
+        partition equals the source partition whenever the partition
+        counts match (the bridge hashed the same key with the same
+        function) — each source chunk lands on the same-numbered sink
+        partition, byte- and routing-identical to the classic path.
+
+        Chunks that cannot ride (no fused leg, pinned-classic producer,
+        partition counts differ, a fallback row in the group, traced
+        session) return None and take the classic path unchanged."""
+        if self._fused_json is None or self.sink_schema_id is None:
+            return None
+        from ..stream.broker import Broker as _InprocBroker
+
+        if isinstance(self.broker, _InprocBroker) and \
+                self.broker.store is None:
+            # in-memory in-process broker: produce_raw would only decode
+            # the frames right back per record (the emulator's compat
+            # path) — strictly extra work vs the classic fused encode
+            # (the same opt-out NativeIngestBridge applies)
+            return None
+        raw = self.raw_producer()
+        if raw.engaged is False:
+            return None
+        if self._raw_affine is None:
+            try:
+                self._raw_affine = (
+                    self.broker.topic(self.sink_meta.topic).partitions
+                    == self.broker.topic(self.src_meta.topic).partitions)
+            except KeyError:
+                return None
+        if not self._raw_affine:
+            return None
+        import time as _time
+
+        import numpy as np
+
+        from ..data.pipeline import produce_batch_bytes
+        from ..stream.producer import raw_produce_convert_seconds
+
+        def classic_group(group) -> int:
+            """One group through the classic path (exact per-key order,
+            DLQ routing, key-hash partitioning) — every fallback site."""
+            outs = self.process(group)
+            if outs:
+                self.broker.produce_many(self.sink_meta.topic, outs)
+            return len(outs)
+
+        def classic_entries(group, num, lab, nulls):
+            """Lazy classic form of an encoded slice — built only when
+            the producer downgrades (UNSUPPORTED_VERSION server)."""
+            vals = self._native_sink.encode_batch(
+                num, lab if self._sink_strings else None,
+                schema_id=self.sink_schema_id,
+                stride=self._label_stride, nulls=nulls)
+            return [(m.key, v, m.timestamp_ms)
+                    for m, v in zip(group, vals)]
+
+        emitted = 0
+        by_part: Dict[int, list] = {}
+        for m in messages:
+            by_part.setdefault(m.partition, []).append(m)
+        for p, group in by_part.items():
+            _t0 = _time.perf_counter()
+            num, lab, nulls, fb = self._fused_json.json_decode_batch(
+                [m.value for m in group], stride=self._label_stride)
+            if fb.any():
+                # a row the native parser can't reproduce exactly:
+                # classic path for the WHOLE group
+                emitted += classic_group(group)
+                continue
+            ts = np.fromiter((m.timestamp_ms for m in group), np.int64,
+                             len(group))
+            keys = [m.key for m in group]
+            if any(k is None for k in keys):
+                # unkeyed records round-robin in the classic
+                # partitioner; only KEYED records carry the affinity
+                # identity — classic path for the whole group
+                emitted += classic_group(group)
+                continue
+            try:
+                blob = self._fused_json.encode_frames(
+                    num, lab, ts, keys=keys, nulls=nulls,
+                    schema_id=self.sink_schema_id,
+                    stride=self._label_stride)
+            except ValueError:
+                emitted += classic_group(group)
+                continue
+            raw_produce_convert_seconds.observe(
+                _time.perf_counter() - _t0)
+            cap = produce_batch_bytes()
+            if len(blob) <= cap or len(group) <= 1:
+                raw.produce_frames(
+                    p, blob, len(group),
+                    entries=lambda g=group, n=num, la=lab, nu=nulls:
+                    classic_entries(g, n, la, nu))
+            else:
+                # oversize accumulation: split at frame boundaries by
+                # re-encoding row slices (IOTML_PRODUCE_BATCH_BYTES)
+                per = max(1, int(len(group) * cap / len(blob)))
+                for i in range(0, len(group), per):
+                    sl = slice(i, i + per)
+                    sub = self._fused_json.encode_frames(
+                        num[sl], lab[sl], ts[sl], keys=keys[sl],
+                        nulls=nulls[sl], schema_id=self.sink_schema_id,
+                        stride=self._label_stride)
+                    raw.produce_frames(
+                        p, sub, len(keys[sl]),
+                        entries=lambda g=group[sl], n=num[sl],
+                        la=lab[sl], nu=nulls[sl]: classic_entries(
+                            g, n, la, nu))
+            emitted += len(group)
+        return emitted
 
     def process(self, messages):
         if self._fused_json is not None:

@@ -38,6 +38,9 @@ class StreamTask:
         self.broker = broker
         self.src = src
         self.dst = dst
+        #: lazily-built RawBatchProducer for tasks with a raw produce
+        #: leg (process_raw); None until first used
+        self._raw_producer = None
         broker.create_topic(dst, partitions=partitions)
         if consumer is not None:
             # injected cursor — a GroupConsumer makes the task GROUP-
@@ -58,6 +61,26 @@ class StreamTask:
     def process(self, messages: List[Message]) -> List[Tuple]:
         """Return [(key, value, timestamp_ms)] outputs."""
         raise NotImplementedError
+
+    def raw_producer(self):
+        """The task's RawBatchProducer for its output topic (built on
+        first use) — the zero-copy produce plane with the classic
+        fallback ladder (IOTML_RAW_PRODUCE)."""
+        if self._raw_producer is None:
+            from ..stream.producer import RawBatchProducer
+
+            self._raw_producer = RawBatchProducer(self.broker, self.dst)
+        return self._raw_producer
+
+    def process_raw(self, messages: List[Message]) -> Optional[int]:
+        """Optional zero-copy produce hook: transform `messages` and
+        ship the outputs as pre-framed raw batches (ISSUE 12 — a record
+        is framed ONCE at conversion and appended segment-verbatim).
+        Return the records emitted, or None to take the classic
+        process() + produce_many path for this chunk.  Only consulted
+        on untraced sessions: trace headers exist only on the classic
+        per-record path."""
+        return None
 
     def dead_letter(self, message: Message, error) -> None:
         """Route one poisoned input to `<src>_DLQ` instead of silently
@@ -107,6 +130,15 @@ class StreamTask:
             if not msgs:
                 self.consumer.commit()
                 return n
+            if not tracing.ENABLED:
+                # the zero-copy produce leg (tasks that implement it):
+                # converted chunks ship as pre-framed raw batches, no
+                # per-record python between convert and append
+                handled = self.process_raw(msgs)
+                if handled is not None:
+                    n += handled
+                    self.consumer.commit()
+                    continue
             outs = self.process(msgs)
             if outs:
                 if tracing.ENABLED:

@@ -17,3 +17,10 @@ def sniff(buf: bytes):
 def rewrite(offset, key, value):
     # BAD: frame encoding outside the store / framing helpers
     return seg.encode_record(offset, key, value, 0, None)
+
+
+def frame_myself(lib, blob):
+    # BAD: direct native frame-codec call outside stream/native.py —
+    # a second frame ENCODER in disguise (ISSUE 12 write-path rule)
+    return lib.iotml_frames_encode_values(blob, None, None, None, None,
+                                          None, None, 0, 0, None, 0)

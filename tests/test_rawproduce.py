@@ -1,0 +1,592 @@
+"""Zero-copy produce plane (ISSUE 12): RAW_PRODUCE wire extension,
+native write-path framing parity, byte-identical segments, whole-batch
+corruption rejection, the fallback ladder, replica raw mirroring, the
+fused KSQL produce leg, and the allocation contract."""
+
+import gc
+import json
+import os
+import tracemalloc
+
+import numpy as np
+import pytest
+
+from iotml.core.schema import KSQL_CAR_SCHEMA
+from iotml.ops import framing
+from iotml.ops.avro import AvroCodec
+from iotml.store import segment as seg
+from iotml.stream import native as native_mod
+from iotml.stream.broker import Broker, CorruptMessageError
+from iotml.stream.kafka_wire import (IDEMPOTENT_APIS, RAW_PRODUCE,
+                                     KafkaWireBroker, KafkaWireServer)
+from iotml.stream.producer import RawBatchProducer
+
+NATIVE = native_mod.available()
+needs_native = pytest.mark.skipif(not NATIVE,
+                                  reason="C++ engine not built")
+
+CODEC = AvroCodec(KSQL_CAR_SCHEMA)
+
+
+def _entries(n=40, tombstones=()):
+    return [(b"car-%d" % (i % 7),
+             None if i in tombstones else b"payload-%d" % i,
+             1000 + i)
+            for i in range(n)]
+
+
+def _log_bytes(store_dir, topic, partition):
+    root = os.path.join(store_dir, "segments", topic, str(partition))
+    out = b""
+    for name in sorted(os.listdir(root)):
+        if name.endswith(".log"):
+            with open(os.path.join(root, name), "rb") as fh:
+                out += fh.read()
+    return out
+
+
+# ------------------------------------------------ native == python oracle
+@needs_native
+def test_frame_entries_native_matches_python_oracle():
+    """Opaque-value framing: native iotml_frames_encode_values output is
+    bit-exact with the python store codec — null keys, tombstones,
+    empty values, all of it."""
+    entries = _entries(24, tombstones=(3, 17))
+    entries[5] = (None, b"", 0)          # null key + empty value
+    entries[9] = (b"", b"x" * 300, 5)    # empty (non-null) key
+    native = framing.frame_entries(entries, base_offset=77)
+    oracle = framing.encode_frame_batch(
+        (77 + i, e[0], e[1], e[2], None) for i, e in enumerate(entries))
+    assert native == oracle
+
+
+@needs_native
+def test_encode_frames_columnar_matches_python_oracle():
+    """Fused columnar framing (Avro encode + Confluent header + store
+    frame in one native call) is bit-exact with the python codec path,
+    including NaN floats, null unions and message keys."""
+    nc = native_mod.NativeCodec(KSQL_CAR_SCHEMA)
+    rng = np.random.default_rng(7)
+    n = 16
+    numeric = rng.normal(size=(n, nc.n_numeric)).astype(np.float64)
+    numeric[3, 2] = np.nan
+    labels = np.array([["true" if i % 3 else "false"] for i in range(n)],
+                      "S16")
+    nulls = np.zeros((n, nc.n_fields), np.uint8)
+    nulls[4, 0] = 1  # null union on a nullable field
+    ts = np.arange(n, dtype=np.int64) + 500
+    keys = [b"vehicles/sensor/data/car-%05d" % i for i in range(n)]
+    blob = nc.encode_frames(numeric, labels, ts, keys=keys,
+                            nulls=nulls, schema_id=1, base_offset=9)
+    values = nc.encode_batch(numeric, labels, schema_id=1, nulls=nulls)
+    oracle = framing.encode_frame_batch(
+        (9 + i, keys[i], values[i], int(ts[i]), None) for i in range(n))
+    assert blob == oracle
+    # the S-dtype key array form (the zero-object fast path) agrees
+    blob2 = nc.encode_frames(numeric, labels, ts,
+                             keys=np.asarray(keys, "S64"),
+                             nulls=nulls, schema_id=1, base_offset=9)
+    assert blob2 == blob
+
+
+def test_restamp_oracle_and_rejection_without_native(monkeypatch):
+    """The pure-python restamp/validate oracles match the native path's
+    semantics (the no-toolchain fallback contract)."""
+    monkeypatch.setattr(framing, "_native_lib", lambda: None)
+    entries = _entries(12, tombstones=(2,))
+    frames = framing.frame_entries(entries)
+    stamped, count, max_ts = framing.restamp_frame_batch(frames, 40)
+    assert count == 12 and max_ts == 1011
+    assert stamped == framing.encode_frame_batch(
+        (40 + i, e[0], e[1], e[2], None)
+        for i, e in enumerate(entries))
+    bad = bytearray(frames)
+    bad[len(bad) // 2] ^= 0xFF
+    with pytest.raises(framing.CorruptFrameError):
+        framing.restamp_frame_batch(bytes(bad), 0)
+    v = framing.validate_frame_batch(stamped, start_offset=45)
+    assert (v["count"], v["first"], v["last"]) == (7, 45, 51)
+    assert stamped[v["start_pos"]:v["end_pos"]]
+
+
+@needs_native
+def test_restamp_native_matches_oracle(monkeypatch):
+    frames = framing.frame_entries(_entries(9, tombstones=(1,)))
+    native = framing.restamp_frame_batch(frames, 123)
+    monkeypatch.setattr(framing, "_native_lib", lambda: None)
+    oracle = framing.restamp_frame_batch(frames, 123)
+    assert native == oracle
+
+
+# ------------------------------------------------- segment byte parity
+def test_raw_produce_segments_byte_identical_to_classic(tmp_path,
+                                                        monkeypatch):
+    """A topic ingested via RAW_PRODUCE is segment-byte-identical to the
+    same records via classic produce — compaction/recovery/replica
+    semantics untouched by construction."""
+    entries = _entries(60, tombstones=(10, 44))
+    frames = framing.frame_entries(entries)
+    raw = Broker(store_dir=str(tmp_path / "raw"))
+    raw.create_topic("t", partitions=1)
+    raw.produce_raw("t", 0, frames)
+    raw.flush()
+    monkeypatch.setenv("IOTML_RAW_PRODUCE", "off")
+    classic = Broker(store_dir=str(tmp_path / "classic"))
+    classic.create_topic("t", partitions=1)
+    for key, value, ts in entries:
+        classic.produce("t", value, key=key, partition=0,
+                        timestamp_ms=ts)
+    classic.flush()
+    assert _log_bytes(str(tmp_path / "raw"), "t", 0) == \
+        _log_bytes(str(tmp_path / "classic"), "t", 0)
+    # and both serve identical records (tombstones as value None)
+    a = raw.fetch("t", 0, 0, 100)
+    b = classic.fetch("t", 0, 0, 100)
+    assert a == b
+    assert a[10].value is None
+    raw.close()
+    classic.close()
+
+
+def test_fused_produce_many_byte_identical(tmp_path, monkeypatch):
+    """The durable broker's internal framing fusion (produce_many →
+    one native frame batch per partition) produces byte-identical
+    segments to the per-record python encoder."""
+    entries = _entries(80)
+    fused = Broker(store_dir=str(tmp_path / "fused"))
+    fused.create_topic("t", partitions=3)
+    fused.produce_many("t", entries)
+    fused.flush()
+    monkeypatch.setenv("IOTML_RAW_PRODUCE", "off")
+    classic = Broker(store_dir=str(tmp_path / "classic"))
+    classic.create_topic("t", partitions=3)
+    classic.produce_many("t", entries)
+    classic.flush()
+    for p in range(3):
+        assert _log_bytes(str(tmp_path / "fused"), "t", p) == \
+            _log_bytes(str(tmp_path / "classic"), "t", p)
+    fused.close()
+    classic.close()
+
+
+# ------------------------------------------- corruption: whole-batch NAK
+def test_corrupt_batch_rejected_whole_before_any_byte_lands(tmp_path):
+    broker = Broker(store_dir=str(tmp_path / "store"))
+    broker.create_topic("t", partitions=1)
+    frames = framing.frame_entries(_entries(30))
+    broker.produce_raw("t", 0, frames)
+    end = broker.end_offset("t", 0)
+    broker.flush()
+    size = os.path.getsize(
+        os.path.join(str(tmp_path / "store"), "segments", "t", "0",
+                     "00000000000000000000.log"))
+    for cut in (3, len(frames) // 2, len(frames) - 2):
+        bad = bytearray(frames)
+        bad[cut] ^= 0xFF
+        with pytest.raises(CorruptMessageError):
+            broker.produce_raw("t", 0, bytes(bad))
+        assert broker.end_offset("t", 0) == end
+    # a torn tail (truncated batch) is rejected whole too
+    with pytest.raises(CorruptMessageError):
+        broker.produce_raw("t", 0, frames[:-7])
+    broker.flush()
+    assert os.path.getsize(
+        os.path.join(str(tmp_path / "store"), "segments", "t", "0",
+                     "00000000000000000000.log")) == size
+    broker.close()
+
+
+def test_chaos_corrupt_faultpoint_invariants(tmp_path):
+    """Seeded chaos at broker.produce_raw: the corrupted batch is
+    rejected whole (typed CORRUPT_MESSAGE over the wire), acked counts
+    stay exact, and replay is byte-identical to an unfaulted control
+    run after the producer redelivers."""
+    from iotml.chaos import faults as chaos
+    from iotml.chaos.scenarios import FaultEvent
+
+    frames = [framing.frame_entries(_entries(20), base_offset=0)
+              for _ in range(5)]
+
+    def run(store, with_chaos):
+        broker = Broker(store_dir=store)
+        broker.create_topic("t", partitions=1)
+        server = KafkaWireServer(broker)
+        acked = 0
+        rejected = 0
+        with server:
+            client = KafkaWireBroker(f"127.0.0.1:{server.port}")
+            if with_chaos:
+                chaos.arm(chaos.ChaosEngine([
+                    FaultEvent(at=3, point="broker.produce_raw",
+                               action="corrupt")]))
+            try:
+                for blob in frames:
+                    for _attempt in range(2):
+                        try:
+                            client.produce_raw("t", 0, blob)
+                            acked += 20
+                            break
+                        except CorruptMessageError:
+                            rejected += 1  # redeliver: nothing landed
+            finally:
+                chaos.disarm()
+                client.close()
+        replay = broker.fetch("t", 0, 0, 1000)
+        broker.flush()
+        blob = _log_bytes(store, "t", 0)
+        broker.close()
+        return acked, rejected, replay, blob
+
+    acked_c, rej_c, replay_c, bytes_c = run(str(tmp_path / "ctl"), False)
+    acked_f, rej_f, replay_f, bytes_f = run(str(tmp_path / "flt"), True)
+    assert (acked_c, rej_c) == (100, 0)
+    assert (acked_f, rej_f) == (100, 1)  # injected, rejected, redelivered
+    assert replay_f == replay_c          # acked counts + replay identical
+    assert bytes_f == bytes_c            # byte-identical after rejection
+
+
+# --------------------------------------------------- the fallback ladder
+def test_raw_produce_less_server_pins_clients_back(monkeypatch):
+    """A server without the RAW_PRODUCE extension answers
+    UNSUPPORTED_VERSION; the client raises NotImplementedError and a
+    RawBatchProducer (auto) pins back to classic PRODUCE — the stream
+    content is identical either way."""
+    from iotml.stream import kafka_wire as kw
+
+    broker = Broker()
+    broker.create_topic("t", partitions=1)
+    supported = dict(kw._SUPPORTED)
+    supported.pop(RAW_PRODUCE)
+    monkeypatch.setattr(kw, "_SUPPORTED", supported)
+    entries = _entries(15)
+    frames = framing.frame_entries(entries)
+    with KafkaWireServer(broker) as server:
+        client = KafkaWireBroker(f"127.0.0.1:{server.port}")
+        with pytest.raises(NotImplementedError):
+            client.produce_raw("t", 0, frames)
+        producer = RawBatchProducer(client, "t", mode="auto")
+        base = producer.produce_frames(0, frames, len(entries),
+                                       entries=entries)
+        assert base == 0 and producer.engaged is False
+        # pinned: the second batch goes classic without re-probing
+        producer.produce_frames(0, frames, len(entries), entries=entries)
+        assert producer.classic_records == 30
+        with pytest.raises(NotImplementedError):
+            RawBatchProducer(client, "t", mode="on").produce_frames(
+                0, frames, len(entries))
+        client.close()
+    assert [(m.key, m.value, m.timestamp_ms)
+            for m in broker.fetch("t", 0, 0, 100)] == entries * 2
+
+
+def test_raw_produce_deliberately_not_idempotent():
+    """RAW_PRODUCE mutates the log: a blind retry double-appends, so it
+    is handled in the idempotency table deliberately — absent, like
+    PRODUCE (caller-owns-redelivery)."""
+    from iotml.analysis import lint as lint_mod
+    from iotml.stream import kafka_wire as kw
+
+    assert RAW_PRODUCE in kw._SUPPORTED
+    assert RAW_PRODUCE not in IDEMPOTENT_APIS
+    assert "RAW_PRODUCE" not in lint_mod.IDEMPOTENT_API_NAMES
+
+
+def test_knobs_validated_and_not_config(monkeypatch):
+    """IOTML_RAW_PRODUCE / IOTML_PRODUCE_BATCH_BYTES are process knobs
+    (config non_config — they must not be rejected as unknown config
+    sections), validated loudly."""
+    from iotml.config import load_config
+    from iotml.data.pipeline import (produce_batch_bytes,
+                                     raw_produce_mode, set_knobs)
+
+    monkeypatch.setenv("IOTML_RAW_PRODUCE", "auto")
+    monkeypatch.setenv("IOTML_PRODUCE_BATCH_BYTES", "65536")
+    cfg, _ = load_config([])  # no ValueError: both are non_config
+    assert raw_produce_mode() == "auto"
+    assert produce_batch_bytes() == 65536
+    monkeypatch.setenv("IOTML_RAW_PRODUCE", "sometimes")
+    with pytest.raises(ValueError):
+        raw_produce_mode()
+    monkeypatch.setenv("IOTML_PRODUCE_BATCH_BYTES", "12")
+    with pytest.raises(ValueError):
+        produce_batch_bytes()
+    with pytest.raises(ValueError):
+        set_knobs(raw_produce="maybe")
+    with pytest.raises(ValueError):
+        set_knobs(produce_batch_bytes=16)
+    # a failed set_knobs must not have published anything
+    assert os.environ["IOTML_PRODUCE_BATCH_BYTES"] == "12"
+    set_knobs(raw_produce="off", produce_batch_bytes=8192)
+    assert raw_produce_mode() == "off"
+    assert produce_batch_bytes() == 8192
+
+
+# ------------------------------------------------- replica raw mirroring
+def test_replica_mirrors_raw_batches_byte_identical(tmp_path):
+    from iotml.stream.replica import FollowerReplica
+
+    leader_dir = str(tmp_path / "leader")
+    follower_dir = str(tmp_path / "follower")
+    leader = Broker(store_dir=leader_dir)
+    leader.create_topic("t", partitions=2)
+    for i in range(300):
+        leader.produce("t", b"v%d" % i, key=b"k%d" % (i % 5),
+                       timestamp_ms=i)
+    with KafkaWireServer(leader) as server:
+        rep = FollowerReplica(f"127.0.0.1:{server.port}", topics=["t"],
+                              groups=("g",), store_dir=follower_dir)
+        copied = rep.sync_once()
+        assert copied == 300
+        assert rep.raw_mirrored == 300  # the zero-copy leg carried it
+        leader.flush()
+        rep.local.flush()
+        for p in range(2):
+            assert _log_bytes(leader_dir, "t", p) == \
+                _log_bytes(follower_dir, "t", p)
+        # realignment semantics unchanged: trim the leader past the
+        # follower's cursor and the follower resets, not shifts
+        leader.reset_partition("t", 0, 500)
+        leader.produce("t", b"post-trim", partition=0, timestamp_ms=999)
+        rep.sync_once()
+        assert rep.local.begin_offset("t", 0) == 500
+        assert rep.local.fetch("t", 0, 500, 5)[0].value == b"post-trim"
+        assert any("realigned" in e for e in rep.sync_errors)
+        rep.local.close()
+        try:
+            rep._leader.close()
+        except OSError:
+            pass
+    leader.close()
+
+
+def test_replica_partition_filter_on_raw_leg(tmp_path):
+    from iotml.stream.replica import FollowerReplica
+
+    leader = Broker(store_dir=str(tmp_path / "leader"))
+    leader.create_topic("t", partitions=2)
+    for i in range(100):
+        leader.produce("t", b"v%d" % i, partition=i % 2, timestamp_ms=i)
+    with KafkaWireServer(leader) as server:
+        rep = FollowerReplica(f"127.0.0.1:{server.port}", topics=["t"],
+                              store_dir=str(tmp_path / "follower"),
+                              partition_filter=lambda t, p: p == 1)
+        assert rep.sync_once() == 50
+        assert rep.local.end_offset("t", 1) == 50
+        assert rep.local.end_offset("t", 0) == 0  # unowned: untouched
+        rep.local.close()
+        try:
+            rep._leader.close()
+        except OSError:
+            pass
+    leader.close()
+
+
+def test_replica_oversized_record_falls_back_to_classic(tmp_path,
+                                                        monkeypatch):
+    """A record larger than the raw-batch byte cap tears every raw
+    fetch at the cursor: the mirror must hand that batch to the classic
+    per-record leg instead of reading 'caught up' and parking forever
+    (regression)."""
+    from iotml.stream.replica import FollowerReplica
+
+    monkeypatch.setenv("IOTML_RAW_BATCH_BYTES", "4096")
+    leader = Broker(store_dir=str(tmp_path / "leader"))
+    leader.create_topic("t", partitions=1)
+    leader.produce("t", b"small", partition=0, timestamp_ms=1)
+    leader.produce("t", b"x" * 16384, partition=0, timestamp_ms=2)
+    leader.produce("t", b"tail", partition=0, timestamp_ms=3)
+    with KafkaWireServer(leader) as server:
+        rep = FollowerReplica(f"127.0.0.1:{server.port}", topics=["t"],
+                              store_dir=str(tmp_path / "follower"))
+        assert rep.sync_once() == 3
+        msgs = rep.local.fetch("t", 0, 0, 10)
+        assert [m.value for m in msgs] == [b"small", b"x" * 16384,
+                                           b"tail"]
+        rep.local.close()
+        try:
+            rep._leader.close()
+        except OSError:
+            pass
+    leader.close()
+
+
+def test_replica_pins_classic_when_leader_lacks_raw(tmp_path,
+                                                    monkeypatch):
+    from iotml.stream import kafka_wire as kw
+    from iotml.stream.replica import FollowerReplica
+
+    leader = Broker(store_dir=str(tmp_path / "leader"))
+    leader.create_topic("t", partitions=1)
+    for i in range(40):
+        leader.produce("t", b"v%d" % i, timestamp_ms=i)
+    supported = dict(kw._SUPPORTED)
+    supported.pop(kw.RAW_FETCH)
+    monkeypatch.setattr(kw, "_SUPPORTED", supported)
+    with KafkaWireServer(leader) as server:
+        rep = FollowerReplica(f"127.0.0.1:{server.port}", topics=["t"],
+                              store_dir=str(tmp_path / "follower"))
+        assert rep.sync_once() == 40
+        assert rep.raw_mirrored == 0
+        assert rep._raw_mirror is False  # pinned back permanently
+        assert rep.local.end_offset("t", 0) == 40
+        rep.local.close()
+        try:
+            rep._leader.close()
+        except OSError:
+            pass
+    leader.close()
+
+
+# ------------------------------------------------- cluster-routed appends
+def test_cluster_client_routes_raw_batches_to_owning_shards():
+    from iotml.cluster import ClusterController
+
+    ctl = ClusterController(brokers=3).start()
+    try:
+        ctl.create_topic("t", partitions=6)
+        cli = ctl.client()
+        frames = framing.frame_entries(_entries(10))
+        for p in range(6):
+            base = cli.produce_raw("t", p, frames)
+            assert base == 0
+        for p in range(6):
+            assert cli.end_offset("t", p) == 10
+        # the shard actually holding the partition served the append
+        for i, b in enumerate(ctl.brokers):  # lint-not-applicable: tests
+            for p in range(6):
+                if b.owns("t", p):
+                    assert b.end_offset("t", p) == 10
+        cli.close()
+    finally:
+        ctl.stop()
+
+
+# ------------------------------------------------ fused KSQL produce leg
+@needs_native
+def test_pump_raw_leg_output_identical_to_classic(tmp_path, monkeypatch):
+    """The AVRO CSAS's fused JSON→frames leg (RAW_PRODUCE) emits the
+    same topic content as the classic python path — keys, bytes,
+    timestamps, partitioning."""
+    from iotml.gen.simulator import FleetGenerator, FleetScenario
+    from iotml.stream import SchemaRegistry
+    from iotml.stream.producer import raw_produce_records
+    from iotml.streamproc import SqlEngine
+    from iotml.streamproc.sql import install_reference_pipeline
+
+    def run(mode, store):
+        monkeypatch.setenv("IOTML_RAW_PRODUCE", mode)
+        broker = Broker(store_dir=store)
+        broker.create_topic("sensor-data", partitions=4)
+        engine = SqlEngine(broker, registry=SchemaRegistry())
+        install_reference_pipeline(engine)
+        gen = FleetGenerator(FleetScenario(num_cars=16,
+                                           failure_rate=0.05, seed=5))
+        for tick in range(8):
+            cols = gen.step_columns()
+            broker.produce_many("sensor-data", [
+                (b"vehicles/sensor/data/car-%05d" % i,
+                 json.dumps(gen.row_record(cols, i,
+                                           KSQL_CAR_SCHEMA)).encode(),
+                 1000 + tick)
+                for i in range(16)])
+        engine.pump()
+        spec = broker.topic("SENSOR_DATA_S_AVRO")
+        out = [[(m.offset, m.key, m.value, m.timestamp_ms)
+                for m in broker.fetch("SENSOR_DATA_S_AVRO", p, 0, 10000)]
+               for p in range(spec.partitions)]
+        broker.close()
+        return out
+
+    before = raw_produce_records.value()
+    got_raw = run("auto", str(tmp_path / "raw"))
+    assert raw_produce_records.value() > before  # the raw leg carried it
+    got_classic = run("off", str(tmp_path / "classic"))
+    assert got_raw == got_classic
+
+
+# --------------------------------------- zero per-record allocation path
+@needs_native
+def test_zero_per_record_python_objects_on_fused_produce_path(tmp_path):
+    """PR 10's consume assertion, mirrored for produce: shipping 16x
+    more records through columnar-frames → RAW_PRODUCE must NOT
+    allocate ~16x more Python objects — per-batch cost is O(1)."""
+    broker = Broker(store_dir=str(tmp_path / "store"))
+    broker.create_topic("t", partitions=1)
+    nc = native_mod.NativeCodec(KSQL_CAR_SCHEMA)
+    rng = np.random.default_rng(3)
+    numeric = rng.normal(size=(2048, nc.n_numeric)).astype(np.float64)
+    labels = np.full((2048, nc.n_strings), b"false", "S16")
+    ts = np.arange(2048, dtype=np.int64)
+    keys = np.asarray([b"car-%04d" % (i % 50) for i in range(2048)],
+                      "S64")
+
+    def count_allocs(rows):
+        # warm everything (codec scratch, broker topic path)
+        blob = nc.encode_frames(numeric[:8], labels[:8], ts[:8],
+                                keys=keys[:8], schema_id=1)
+        broker.produce_raw("t", 0, blob)
+        gc.collect()
+        tracemalloc.start()
+        blob = nc.encode_frames(numeric[:rows], labels[:rows], ts[:rows],
+                                keys=keys[:rows], schema_id=1)
+        broker.produce_raw("t", 0, blob)
+        snap = tracemalloc.take_snapshot()
+        tracemalloc.stop()
+        return sum(s.count for s in snap.statistics("filename"))
+
+    small = count_allocs(128)
+    big = count_allocs(2048)
+    assert big < small * 2 + 64, (small, big)
+    broker.close()
+
+
+# -------------------------------------------------- engine-owned topics
+def test_raw_produce_respects_topic_ownership():
+    broker = Broker()
+    broker.create_topic("OWNED_TOPIC", partitions=1)
+    token = broker.restrict_topic("OWNED_")
+    frames = framing.frame_entries(_entries(5))
+    with pytest.raises(PermissionError):
+        broker.produce_raw("OWNED_TOPIC", 0, frames)
+    with broker.producer_grant(token):
+        assert broker.produce_raw("OWNED_TOPIC", 0, frames) == 0
+
+
+def test_fetch_raw_jumps_compaction_emptied_head_segment(tmp_path):
+    """A compaction pass that empties the head segment (zero bytes,
+    base preserved) must not read as log end on the raw path: fetch_raw
+    jumps to the successor exactly like read_from's hole jump — the
+    replica's raw mirror leg parks forever otherwise (regression)."""
+    from iotml.store.log import StorePolicy
+
+    broker = Broker(store_dir=str(tmp_path / "store"),
+                    store_policy=StorePolicy(segment_bytes=256))
+    broker.create_topic("C", cleanup_policy="compact")
+    for rnd in range(8):
+        for k in range(4):
+            broker.produce("C", b"v%d" % rnd, key=b"k%d" % k,
+                           partition=0, timestamp_ms=1000 + rnd)
+    broker.store.log_for("C", 0).roll()
+    broker.run_compaction(force=True)
+    survivors = broker.fetch("C", 0, 0, 1000)
+    raw = broker.fetch_raw("C", 0, 0)
+    assert raw is not None
+    v = framing.validate_frame_batch(raw.data, start_offset=0)
+    assert v["first"] == survivors[0].offset
+    assert v["count"] >= 1
+    broker.close()
+
+
+def test_wire_raw_produce_tombstones_roundtrip():
+    """Tombstones framed into a raw batch land as value-None records
+    over the wire (the compaction delete-marker contract)."""
+    broker = Broker()
+    broker.create_topic("t", partitions=1)
+    frames = framing.frame_entries(_entries(6, tombstones=(2, 5)))
+    with KafkaWireServer(broker) as server:
+        client = KafkaWireBroker(f"127.0.0.1:{server.port}")
+        client.produce_raw("t", 0, frames)
+        client.close()
+    msgs = broker.fetch("t", 0, 0, 10)
+    assert msgs[2].value is None and msgs[5].value is None
+    assert msgs[0].value == b"payload-0"
