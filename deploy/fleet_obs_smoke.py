@@ -1,0 +1,319 @@
+#!/usr/bin/env python3
+"""Fleet-scope observability drill (ISSUE 13 acceptance run).
+
+A LIVE 3-broker cluster with four OS processes — the cluster harness
+(this process), a RAW_PRODUCE producer, a columnar scorer, and a
+continuous trainer — all tracing into ONE span log and serving
+/metrics into one endpoints manifest.  Asserts:
+
+- ``iotml_watermark_lag_seconds`` published for the score AND train
+  stages (the columnar plane's event-time watermarks, per process);
+- the federation collector serves merged cluster metrics from >= 4
+  processes and snapshots fleet state into the compacted
+  ``_IOTML_METRICS`` changelog;
+- ``python -m iotml.obs trace`` reconstructs at least one CLOSED e2e
+  trace whose spans cross >= 3 processes (producer → shard → scorer:
+  the wire-carried batch-trace leg, which PR 2's header-dropping wire
+  clients could never do);
+- the /healthz stage-liveness view reports the columnar consume stage
+  LIVE (the false-dead regression this PR fixes).
+
+    python deploy/fleet_obs_smoke.py [--records 6000] [--quick]
+
+CI (obs.yml) runs this followed by the trace CLI assertions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TOPIC = "SENSOR_DATA_S_AVRO"
+PREDICTIONS = "model-predictions"
+PARTITIONS = 3
+BASE_PORT = 19412
+
+
+def _env(role: str, workdir: str) -> dict:
+    env = dict(os.environ)
+    env.update(IOTML_PROC=role, IOTML_TRACE="1",
+               IOTML_TRACE_SAMPLE="1.0",
+               IOTML_TRACE_PATH=os.path.join(workdir, "spans.jsonl"),
+               IOTML_OBS_ENDPOINTS=os.path.join(workdir,
+                                                "endpoints.json"),
+               JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
+    return env
+
+
+def _bootstrap() -> str:
+    return ",".join(f"127.0.0.1:{BASE_PORT + i}"
+                    for i in range(3))
+
+
+def _mark_done(workdir: str, role: str) -> None:
+    with open(os.path.join(workdir, f"{role}.done"), "w") as fh:
+        fh.write("done")
+
+
+# ----------------------------------------------------------- child roles
+def run_producer(args) -> int:
+    import numpy as np
+
+    from iotml.cluster.client import ClusterClient
+    from iotml.core.schema import KSQL_CAR_SCHEMA
+    from iotml.obs.metrics import start_http_server
+    from iotml.stream import native as native_mod
+    from iotml.stream.producer import RawBatchProducer
+
+    start_http_server(0)
+    client = ClusterClient(bootstrap=_bootstrap())
+    nc = native_mod.NativeCodec(KSQL_CAR_SCHEMA)
+    prod = [RawBatchProducer(client, TOPIC) for _ in range(PARTITIONS)]
+    rng = np.random.default_rng(11)
+    batch = 200
+    sent = 0
+    while sent < args.records:
+        n = min(batch, args.records - sent)
+        numeric = rng.normal(size=(n, nc.n_numeric))
+        labels = np.full((n, nc.n_strings), b"false", "S16")
+        now_ms = int(time.time() * 1000)  # wallclock-ok: record
+        # timestamps ARE wall-domain event time (the watermark source)
+        ts = np.full((n,), now_ms, np.int64)
+        keys = np.asarray([b"car-%03d" % (i % 40) for i in range(n)],
+                          "S64")
+        frames = nc.encode_frames(numeric, labels, timestamps=ts,
+                                  keys=keys, schema_id=1)
+        p = (sent // batch) % PARTITIONS
+        prod[p].produce_frames(p, frames, n)
+        sent += n
+        time.sleep(0.01)  # a paced fleet, not one burst
+    print(f"producer: {sent} records over RAW_PRODUCE "
+          f"(raw plane engaged: {prod[0].engaged})", flush=True)
+    _mark_done(args.workdir, "producer")
+    time.sleep(args.linger)  # stay scrapeable for the federation pass
+    client.close()
+    return 0
+
+
+def run_scorer(args) -> int:
+    import numpy as np
+
+    from iotml.cluster.client import ClusterClient
+    from iotml.data.dataset import SensorBatches
+    from iotml.models.autoencoder import CAR_AUTOENCODER
+    from iotml.obs.metrics import start_http_server
+    from iotml.serve.scorer import StreamScorer
+    from iotml.stream.consumer import StreamConsumer
+    from iotml.stream.producer import OutputSequence
+    from iotml.train.loop import Trainer
+
+    start_http_server(0)
+    client = ClusterClient(bootstrap=_bootstrap())
+    specs = [f"{TOPIC}:{p}:0" for p in range(PARTITIONS)]
+    consumer = StreamConsumer(client, specs, group="fleet-obs-score",
+                              eof=False)
+    sb = SensorBatches(consumer, batch_size=100, keep_labels=True,
+                       poll_chunk=2048)
+    tr = Trainer(CAR_AUTOENCODER)
+    tr._ensure_state(np.zeros((100, 18), np.float32))
+    scorer = StreamScorer(CAR_AUTOENCODER, tr.state.params, sb,
+                          OutputSequence(client, PREDICTIONS))
+    deadline = time.monotonic() + args.timeout
+    while scorer.scored < args.records and time.monotonic() < deadline:
+        if scorer.score_available() == 0:
+            time.sleep(0.1)
+    print(f"scorer: {scorer.scored} records scored "
+          f"(columnar ring: {sb._ring not in (None, False)})",
+          flush=True)
+    _mark_done(args.workdir, "scorer")
+    time.sleep(args.linger)
+    client.close()
+    return 0 if scorer.scored >= args.records else 1
+
+
+def run_trainer(args) -> int:
+    import tempfile
+
+    from iotml.cluster.client import ClusterClient
+    from iotml.obs.metrics import start_http_server
+    from iotml.train.artifacts import ArtifactStore
+    from iotml.train.live import ContinuousTrainer
+
+    start_http_server(0)
+    client = ClusterClient(bootstrap=_bootstrap())
+    with tempfile.TemporaryDirectory(prefix="iotml_fleet_obs_") as tmp:
+        svc = ContinuousTrainer(client, TOPIC, ArtifactStore(tmp),
+                                group="fleet-obs-train",
+                                batch_size=50, take_batches=4)
+        deadline = time.monotonic() + args.timeout
+        rounds = 0
+        while rounds < 2 and time.monotonic() < deadline:
+            if svc.available() < svc.min_available:
+                time.sleep(0.1)
+                continue
+            if svc.train_round():
+                rounds += 1
+    print(f"trainer: {rounds} rounds, loss {svc.last_loss}", flush=True)
+    _mark_done(args.workdir, "trainer")
+    time.sleep(args.linger)
+    client.close()
+    return 0 if rounds >= 2 else 1
+
+
+# ------------------------------------------------------------- harness
+def run_harness(args) -> int:
+    workdir = args.workdir
+    os.makedirs(workdir, exist_ok=True)
+    env = _env("cluster", workdir)
+    os.environ.update(env)
+
+    from iotml.cluster import ClusterController
+    from iotml.obs import federate, tracing
+    from iotml.obs.metrics import start_http_server
+
+    tracing.configure_from_env()
+    ctl = ClusterController(brokers=3, base_port=BASE_PORT)
+    ctl.start()
+    ctl.create_topic(TOPIC, partitions=PARTITIONS)
+    ctl.create_topic(PREDICTIONS, partitions=PARTITIONS)
+    start_http_server(0)  # the cluster process joins the manifest too
+
+    def spawn(role: str) -> subprocess.Popen:
+        return subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--role", role,
+             "--records", str(args.records),
+             "--timeout", str(args.timeout),
+             "--linger", str(args.linger), "--workdir", workdir],
+            env=_env(role, workdir))
+
+    children = {r: spawn(r) for r in ("producer", "scorer", "trainer")}
+    failures = []
+    try:
+        # wait for every child's done marker (they then linger, still
+        # serving /metrics, so federation scrapes a LIVE fleet)
+        deadline = time.monotonic() + args.timeout + 30
+        want = set(children)
+        while want and time.monotonic() < deadline:
+            for role in list(want):
+                if os.path.exists(os.path.join(workdir, f"{role}.done")):
+                    want.discard(role)
+                elif children[role].poll() not in (None, 0):
+                    failures.append(f"{role} exited "
+                                    f"{children[role].returncode}")
+                    want.discard(role)
+            time.sleep(0.2)
+        if want:
+            failures.append(f"children never finished: {sorted(want)}")
+
+        # ---------------- federation: merged metrics from >= 4 procs
+        manifest = os.path.join(workdir, "endpoints.json")
+        col = federate.FleetCollector(manifest=manifest)
+        snaps = col.collect()
+        merged = col.render(snaps)
+        hz = col.healthz(snaps)
+        print(f"federation: {hz['up_count']}/{hz['process_count']} "
+              f"processes up: {sorted(hz['processes'])}", flush=True)
+        if hz["up_count"] < 4:
+            failures.append(f"federation saw {hz['up_count']} live "
+                            "processes, need >= 4")
+        # watermarks for score AND train stages, from the live fleet
+        for stage, proc in (("score", "scorer"), ("train", "trainer")):
+            needle = f'stage="{stage}"'
+            hit = any(needle in line and f'process="{proc}"' in line
+                      for line in merged.splitlines()
+                      if line.startswith("iotml_watermark_lag_seconds"))
+            if not hit:
+                failures.append(
+                    f"no iotml_watermark_lag_seconds{{stage={stage}}} "
+                    f"from process {proc} in the merged metrics")
+        if "iotml_cluster_records_scored_total" not in merged:
+            failures.append("cluster rollup families missing")
+        # fleet state into the compacted changelog, replayed back
+        client = ctl.client()
+        col.snapshot_changelog(client, snaps)
+        state = federate.read_fleet_state(client)
+        if len(state) < 4:
+            failures.append(f"_IOTML_METRICS replay has {len(state)} "
+                            "processes, need >= 4")
+        # columnar consume liveness (the false-dead fix): the scorer's
+        # own /healthz must show a fresh consume stage
+        scorer_addr = next(
+            (e["address"] for e in federate.load_manifest(manifest)
+             if e["name"] == "scorer"), None)
+        if scorer_addr is None:
+            failures.append("scorer endpoint missing from manifest")
+        else:
+            doc = json.loads(urllib.request.urlopen(
+                f"http://{scorer_addr}/healthz", timeout=5).read())
+            age = doc.get("stages", {}).get("consume",
+                                            {}).get("last_span_age_s")
+            if age is None or age > args.linger + args.timeout:
+                failures.append(
+                    f"scorer /healthz consume-stage age {age}: the "
+                    "columnar session reads as stalled")
+            wm = doc.get("watermarks", {})
+            if not any(k.startswith("score:") for k in wm):
+                failures.append(f"scorer /healthz watermarks: {wm}")
+    finally:
+        for p in children.values():
+            p.terminate()
+        for p in children.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        ctl.stop()
+    tracing.flush()
+
+    # ---------------- trace reconstruction across processes
+    spans = os.path.join(workdir, "spans.jsonl")
+    from iotml.obs.__main__ import main as obs_main
+
+    rc = obs_main(["trace", spans, "--require-cross-process", "3",
+                   "--show-trace"])
+    if rc != 0:
+        failures.append("trace CLI found no closed e2e trace spanning "
+                        ">= 3 processes")
+    for f in failures:
+        print(f"FLEET OBS CHECK FAILED: {f}", file=sys.stderr)
+    print("fleet obs drill:", "FAIL" if failures else "PASS",
+          flush=True)
+    return 1 if failures else 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--role", default="harness",
+                    choices=("harness", "producer", "scorer", "trainer"))
+    ap.add_argument("--records", type=int, default=6000)
+    ap.add_argument("--timeout", type=float, default=90.0)
+    ap.add_argument("--linger", type=float, default=25.0,
+                    help="seconds a finished child stays scrapeable")
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--quick", action="store_true",
+                    help="small run for CI (2000 records)")
+    args = ap.parse_args()
+    if args.quick:
+        args.records = min(args.records, 2000)
+    if args.workdir is None:
+        import tempfile
+
+        args.workdir = tempfile.mkdtemp(prefix="iotml_fleet_obs_")
+    if args.role == "producer":
+        return run_producer(args)
+    if args.role == "scorer":
+        return run_scorer(args)
+    if args.role == "trainer":
+        return run_trainer(args)
+    return run_harness(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -141,6 +141,18 @@ _SPAN_LITERAL_CALLS = frozenset({"mark", "close"})  # TraceContext methods
 _TRACING_MODULE_CALLS = frozenset({"start", "flush", "liveness"})
 _SNAKE_NAME_RE = re.compile(r"[a-z][a-z0-9_]*\Z")
 _IOTML_NAME_RE = re.compile(r"iotml_[a-z0-9_]+\Z")
+# R6 label vocabulary (ISSUE 13): metric labels at .inc/.set/.observe/
+# .time call sites must come from the CLOSED key set mirrored in
+# obs.metrics.ALLOWED_LABEL_KEYS.  Labels multiply series — one key
+# drawn from an unbounded domain (a car id, a trace id, an offset)
+# turns a fixed-cost scrape into an unbounded allocation, so a new
+# label key is a reviewed vocabulary change, not a drive-by.
+_METRIC_RECORD_CALLS = frozenset({"inc", "observe", "set", "time"})
+_ALLOWED_METRIC_LABELS = frozenset({
+    "stage", "topic", "partition", "group", "phase", "loop", "process",
+    "component", "detector", "action", "fault", "source", "outcome",
+    "unit", "le",
+})
 
 RULES: Dict[str, str] = {
     "R1": "non-monotonic clock (time.time) in wire/broker/replica code; "
@@ -685,6 +697,24 @@ class _FileLinter(ast.NodeVisitor):
                        "convention ([a-z][a-z0-9_]*): the span CLI and "
                        "the stage-label histograms aggregate by this "
                        "string")
+        # R6 — metric LABEL vocabulary: keyword labels at metric record
+        # sites must come from the closed set (see
+        # obs.metrics.ALLOWED_LABEL_KEYS).  A runaway per-entity label
+        # (car_id, trace, offset...) must fail here before it fails
+        # production with an unbounded series explosion.
+        if name in _METRIC_RECORD_CALLS and \
+                isinstance(node.func, ast.Attribute) and node.keywords:
+            for kw in node.keywords:
+                if kw.arg is None:  # **labels passthrough: the metric
+                    continue        # classes' own plumbing
+                if kw.arg not in _ALLOWED_METRIC_LABELS:
+                    self._emit("R6", node,
+                               f"metric label {kw.arg!r} outside the "
+                               "closed label vocabulary "
+                               "(obs.metrics.ALLOWED_LABEL_KEYS): "
+                               "unbounded label domains explode series "
+                               "cardinality — extend the vocabulary "
+                               "deliberately or drop the label")
 
         # R7 — faultpoint shim compiled outside the allowlist
         if name == "point" and not self.chaos_allowed \

@@ -147,6 +147,11 @@ def main(argv=None) -> int:
                        help="max frame bytes per RAW_PRODUCE request "
                             "(sets IOTML_PRODUCE_BATCH_BYTES; default "
                             "1 MiB)")
+        p.add_argument("--metrics-port", type=int, default=0,
+                       help="serve /metrics + /healthz on this port "
+                            "(0 = off); with IOTML_OBS_ENDPOINTS set "
+                            "the endpoint auto-joins the fleet's "
+                            "federation manifest (iotml.obs fleet)")
 
     args = ap.parse_args(argv)
     from ..data.pipeline import set_knobs
@@ -159,6 +164,10 @@ def main(argv=None) -> int:
                   raw_produce=args.raw_produce)
     except ValueError as e:
         ap.error(str(e))
+    if args.metrics_port:
+        from ..obs.metrics import start_http_server
+
+        start_http_server(args.metrics_port)
     broker = _wire_broker(args.servers, args.sasl)
     stop = _stopper(args.max_seconds)
 

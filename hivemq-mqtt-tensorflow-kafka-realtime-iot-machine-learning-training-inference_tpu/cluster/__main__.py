@@ -36,6 +36,10 @@ def cmd_up(args) -> int:
     for topic in args.topics.split(","):
         if topic:
             ctl.create_topic(topic, partitions=args.partitions)
+    if args.metrics_port:
+        from ..obs.metrics import start_http_server
+
+        start_http_server(args.metrics_port)
     sup = None
     if args.replicated:
         sup = ctl.supervised().start()
@@ -118,6 +122,10 @@ def main(argv=None) -> int:
     up.add_argument("--produce-batch-bytes", type=int, default=None,
                     help="max frame bytes per RAW_PRODUCE request "
                          "(sets IOTML_PRODUCE_BATCH_BYTES)")
+    up.add_argument("--metrics-port", type=int, default=0,
+                    help="serve /metrics + /healthz (0 = off); with "
+                         "IOTML_OBS_ENDPOINTS set the endpoint auto-"
+                         "joins the federation manifest")
     up.add_argument("--quiet", action="store_true")
     up.set_defaults(fn=cmd_up)
 

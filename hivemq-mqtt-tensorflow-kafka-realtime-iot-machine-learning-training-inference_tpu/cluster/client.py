@@ -290,6 +290,26 @@ class ClusterClient:
 
         return self._routed(topic, partition, op, retry_connection=True)
 
+    def last_hwm(self, topic: str, partition: int):
+        """The owning shard connection's cached high-water mark (fetch
+        responses carry it), None when uncached — consumer-lag telemetry
+        must never trigger a routing round trip, so this reads only the
+        LIVE connection caches (see StreamConsumer.record_lag)."""
+        with self._lock:
+            conns = list(self._conns.values())
+        best = None
+        for c in conns:
+            hwm = getattr(c, "last_hwm", lambda *a: None)(topic,
+                                                          partition)
+            # MAX over the caches: after a failover an old leader's
+            # connection keeps a frozen pre-failover hwm, and returning
+            # it first would report zero lag for a partition actually
+            # falling behind.  The hwm only ever grows, so max is the
+            # freshest answer any live connection has.
+            if hwm is not None and (best is None or hwm > best):
+                best = hwm
+        return best
+
     def end_offset(self, topic: str, partition: int = 0) -> int:
         return self._routed(topic, partition,
                             lambda c: c.end_offset(topic, partition),

@@ -294,7 +294,13 @@ def load_config(argv: Optional[Sequence[str]] = None,
                   # write-plane knobs (ISSUE 12): same family — they
                   # select the process's produce machinery (RAW_PRODUCE
                   # vs classic), not the pipeline's logical config
-                  "IOTML_RAW_PRODUCE", "IOTML_PRODUCE_BATCH_BYTES"}
+                  "IOTML_RAW_PRODUCE", "IOTML_PRODUCE_BATCH_BYTES",
+                  # fleet-scope observability (ISSUE 13): watermark
+                  # toggle, the process name stamped into span logs,
+                  # and the metrics-endpoint manifest path the
+                  # federation collector scrapes
+                  "IOTML_WATERMARK", "IOTML_PROC",
+                  "IOTML_OBS_ENDPOINTS"}
     for key, value in env.items():
         if not key.startswith("IOTML_") or key in non_config:
             continue

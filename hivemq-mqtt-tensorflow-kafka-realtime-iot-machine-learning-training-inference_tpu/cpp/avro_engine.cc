@@ -352,6 +352,6 @@ int64_t iotml_encode_batch(const double* numeric, const char* labels,
 //       iotml_frames_encode_columnar / iotml_frames_encode_values /
 //       iotml_frames_restamp / iotml_frames_validate) +
 //       iotml_kafka_produce_raw (RAW_PRODUCE wire extension)
-int64_t iotml_engine_version() { return 8; }
+int64_t iotml_engine_version() { return 9; }
 
 }  // extern "C"

@@ -240,14 +240,22 @@ enum FrameFlags : int64_t {
 //       skipped-tombstone); unchanged when nothing was consumed.
 //   out_flags / out_skipped: stop reason bits; tombstones skipped.
 //
+//   out_ts_min / out_ts_max (the _ts variant): event-time bounds (ms)
+//       over the frames CONSUMED at/after start_offset this call —
+//       decoded rows and skipped tombstones alike (both advance the
+//       stream's event-time watermark).  -1 when nothing was consumed.
+//       This is the zero-per-record-cost watermark source: the
+//       timestamps are already in every frame head, so batch min/max
+//       falls out of the walk the decoder does anyway.
+//
 // Returns rows decoded (>= 0), or -1 on invalid arguments.
-int64_t iotml_frames_decode_columnar(
+int64_t iotml_frames_decode_columnar_ts(
     const uint8_t* buf, int64_t buf_len, int64_t start_offset,
     const int8_t* types, const uint8_t* nullable, int64_t n_fields,
     int64_t pinned_id_limit, float* out_numeric, char* out_labels,
     int64_t label_stride, char* out_keys, int64_t key_stride,
     int64_t cap_rows, int64_t* out_next_offset, int64_t* out_flags,
-    int64_t* out_skipped) {
+    int64_t* out_skipped, int64_t* out_ts_min, int64_t* out_ts_max) {
   if (!buf || !types || !nullable || !out_numeric || !out_labels ||
       label_stride < 1 || cap_rows < 0 || (out_keys && key_stride < 1))
     return -1;
@@ -258,6 +266,7 @@ int64_t iotml_frames_decode_columnar(
   int64_t rows = 0, skipped = 0, flags = 0;
   int64_t pos = 0;
   int64_t next_offset = start_offset;
+  int64_t ts_min = -1, ts_max = -1;
   while (rows < cap_rows) {
     if (pos + kLenSize > buf_len) break;  // clean end of buffer
     int64_t length = static_cast<int64_t>(be32(buf + pos));
@@ -274,6 +283,7 @@ int64_t iotml_frames_decode_columnar(
     }
     uint8_t attrs = buf[body + 4];
     int64_t offset = be64(buf + body + 5);
+    int64_t ts = be64(buf + body + 13);
     int32_t key_len = static_cast<int32_t>(be32(buf + body + 21));
     int64_t p = body + kHeadSize;
     const uint8_t* key = nullptr;
@@ -298,9 +308,12 @@ int64_t iotml_frames_decode_columnar(
       continue;
     }
     if (attrs & kAttrNullValue) {
-      // tombstone: no Avro payload to decode; consumed, counted
+      // tombstone: no Avro payload to decode; consumed, counted — and
+      // it still advances the event-time watermark
       ++skipped;
       next_offset = offset + 1;
+      if (ts_min < 0 || ts < ts_min) ts_min = ts;
+      if (ts > ts_max) ts_max = ts;
       pos = end;
       continue;
     }
@@ -331,12 +344,32 @@ int64_t iotml_frames_decode_columnar(
     }
     ++rows;
     next_offset = offset + 1;
+    if (ts_min < 0 || ts < ts_min) ts_min = ts;
+    if (ts > ts_max) ts_max = ts;
     pos = end;
   }
   if (out_next_offset) *out_next_offset = next_offset;
   if (out_flags) *out_flags = flags;
   if (out_skipped) *out_skipped = skipped;
+  if (out_ts_min) *out_ts_min = ts_min;
+  if (out_ts_max) *out_ts_max = ts_max;
   return rows;
+}
+
+// Pre-watermark ABI: the same decode without the event-time out-params
+// (kept so a caller built against ABI <= 8 keeps its exact signature).
+int64_t iotml_frames_decode_columnar(
+    const uint8_t* buf, int64_t buf_len, int64_t start_offset,
+    const int8_t* types, const uint8_t* nullable, int64_t n_fields,
+    int64_t pinned_id_limit, float* out_numeric, char* out_labels,
+    int64_t label_stride, char* out_keys, int64_t key_stride,
+    int64_t cap_rows, int64_t* out_next_offset, int64_t* out_flags,
+    int64_t* out_skipped) {
+  return iotml_frames_decode_columnar_ts(
+      buf, buf_len, start_offset, types, nullable, n_fields,
+      pinned_id_limit, out_numeric, out_labels, label_stride, out_keys,
+      key_stride, cap_rows, out_next_offset, out_flags, out_skipped,
+      nullptr, nullptr);
 }
 
 // ------------------------------------------------------------ write path

@@ -246,6 +246,18 @@ class SensorBatches:
                 self._ring = False
                 return
             n, fallback = res
+            if tracing.ENABLED:
+                # batch-granular wire traces (ISSUE 13): poll_into
+                # extracted any first-frame trace contexts — queue them
+                # for the pipeline closer exactly like record traces,
+                # so the scorer/train step closes them with e2e spans
+                take = getattr(self.consumer, "take_batch_traces", None)
+                if take is not None:
+                    pending = self._pending_traces
+                    for ctx in take():
+                        if len(pending) == pending.maxlen:
+                            tracing.spans_dropped.inc()
+                        pending.append(ctx)
             if n:
                 keys = slot.keys[:n].copy() if self.keep_keys else None
                 yield self._emit_chunk(

@@ -20,9 +20,12 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Iterable, Optional
 
 import jax
+
+from ..obs import metrics as obs_metrics
 
 
 class DevicePrefetcher:
@@ -41,11 +44,15 @@ class DevicePrefetcher:
     _END = object()
 
     def __init__(self, batches: Iterable, to_device: Optional[Callable] = None,
-                 depth: Optional[int] = None, sharding=None):
+                 depth: Optional[int] = None, sharding=None,
+                 loop: str = "train"):
         if depth is None:
             from .pipeline import prefetch_depth
 
             depth = prefetch_depth()
+        #: step_seconds/occupancy loop label (train | score | online) —
+        #: which hot loop this prefetcher feeds (ISSUE 13 profiling)
+        self.loop = loop
         if depth < 1:
             # queue.Queue(maxsize=0) means UNBOUNDED — a depth of 0 would
             # silently stage the entire stream onto the device with no
@@ -113,9 +120,22 @@ class DevicePrefetcher:
                 "DevicePrefetcher is single-use: the background thread already "
                 "drained its source; build a new one per pass")
         self._consumed = True
+        depth = self.q.maxsize or 1
         try:
             while True:
+                # profiling hooks (ISSUE 13): host_wait is the time the
+                # consuming loop starves on the host pipeline, and the
+                # occupancy gauge is the queue's fill fraction at each
+                # dequeue — together the measured host-vs-device balance
+                # (occupancy ~0 + large host_wait = input-bound; ~full
+                # queue = device-bound).  Per-batch cost: one clock pair.
+                t0 = time.perf_counter()
                 item = self.q.get()
+                obs_metrics.step_seconds.observe(
+                    time.perf_counter() - t0, loop=self.loop,
+                    phase="host_wait")
+                obs_metrics.prefetch_occupancy.set(
+                    min(self.q.qsize() / depth, 1.0), loop=self.loop)
                 if item is self._END:
                     if self._err is not None:
                         raise self._err

@@ -2,15 +2,30 @@
 
     python -m iotml.obs trace SPANS.jsonl [--json] [--top N]
                               [--min-stages N] [--require-e2e]
+                              [--require-cross-process N] [--show-trace]
+    python -m iotml.obs fleet [--endpoints MANIFEST] [--port 9200]
+                              [--bootstrap HOST:PORT] [--once]
+                              [--min-processes N]
 
 ``trace`` summarizes a span log written by `iotml.obs.tracing`
 (``IOTML_TRACE=1 IOTML_TRACE_PATH=spans.jsonl``) into a per-stage
 latency breakdown and flags the bottleneck stage — the question the
 reference stack's external Prometheus view cannot answer: *which stage
 ate the budget between the sensor reading and its anomaly score?*
+A FLEET run appends every process's spans to one log (`proc` field);
+``--require-cross-process N`` asserts a closed e2e trace really
+crossed the wire through N processes and ``--show-trace`` prints that
+journey (stages, offset ranges, which process ran what).
 
-``--min-stages`` / ``--require-e2e`` turn the summary into an
-assertion (exit 1 on violation) for CI smoke runs.
+``fleet`` is the metrics federation collector (ISSUE 13): scrape every
+endpoint in the manifest (processes auto-join it via
+``IOTML_OBS_ENDPOINTS`` when they serve /metrics), serve ONE merged
+/metrics + /healthz with ``process=`` labels and ``iotml_cluster_*``
+rollups, and snapshot fleet state into the compacted
+``_IOTML_METRICS`` changelog.
+
+``--min-stages`` / ``--require-e2e`` / ``--min-processes`` turn the
+summaries into assertions (exit 1 on violation) for CI smoke runs.
 """
 
 from __future__ import annotations
@@ -30,8 +45,28 @@ def _percentile(sorted_vals: List[int], q: float) -> float:
 
 def load_spans(path: str):
     """Parse a span log: returns (stages, e2e) aggregation dicts."""
+    stages, e2e, _traces = load_spans_traces(path)
+    return stages, e2e
+
+
+def load_spans_traces(path: str):
+    """Parse a span log with per-trace reconstruction: returns
+    (stages, e2e, traces) where traces maps trace id → {spans:
+    [(start_us, stage, dur_us, proc)], e2e: [(closer, dur_us, proc)],
+    batches: [batch docs], procs: set} — the cross-process view a
+    fleet run appends into ONE log (O_APPEND lines from every
+    process, disambiguated by the `proc` field)."""
     stages: Dict[str, List[int]] = {}
     e2e: Dict[str, List[int]] = {}
+    traces: Dict[str, dict] = {}
+
+    def tr(tid):
+        t = traces.get(tid)
+        if t is None:
+            t = traces[tid] = {"spans": [], "e2e": [], "batches": [],
+                               "procs": set()}
+        return t
+
     with open(path, "r", encoding="utf-8") as fh:
         for line in fh:
             line = line.strip()
@@ -41,11 +76,60 @@ def load_spans(path: str):
                 doc = json.loads(line)
             except json.JSONDecodeError:
                 continue  # torn tail line of a live run: skip
-            if doc.get("kind") == "span":
+            kind = doc.get("kind")
+            proc = doc.get("proc", "?")
+            if kind == "span":
                 stages.setdefault(doc["stage"], []).append(int(doc["dur_us"]))
-            elif doc.get("kind") == "e2e":
+                t = tr(doc.get("trace", "?"))
+                t["spans"].append((int(doc.get("start_us", 0)),
+                                   doc["stage"], int(doc["dur_us"]),
+                                   proc))
+                t["procs"].add(proc)
+            elif kind == "e2e":
                 e2e.setdefault(doc["closer"], []).append(int(doc["dur_us"]))
-    return stages, e2e
+                t = tr(doc.get("trace", "?"))
+                t["e2e"].append((doc["closer"], int(doc["dur_us"]),
+                                 proc))
+                t["procs"].add(proc)
+            elif kind == "batch":
+                t = tr(doc.get("trace", "?"))
+                t["batches"].append(doc)
+                t["procs"].add(proc)
+    return stages, e2e, traces
+
+
+def best_cross_process_trace(traces: Dict[str, dict]):
+    """(trace_id, trace) spanning the most processes — closed e2e
+    traces preferred, then span count; None when the log has none."""
+    best = None
+    for tid, t in traces.items():
+        key = (len(t["procs"]), 1 if t["e2e"] else 0, len(t["spans"]))
+        if best is None or key > best[0]:
+            best = (key, tid, t)
+    if best is None:
+        return None, None
+    return best[1], best[2]
+
+
+def print_trace(tid: str, t: dict) -> None:
+    """One trace's cross-process breakdown, stages in birth-relative
+    order with the process that ran each."""
+    procs = sorted(t["procs"])
+    print(f"\ntrace {tid} across {len(procs)} process(es): "
+          f"{', '.join(procs)}")
+    for start_us, stage, dur_us, proc in sorted(t["spans"]):
+        print(f"  +{start_us / 1000.0:9.3f} ms  {stage:<18} "
+              f"{dur_us / 1000.0:9.3f} ms  [{proc}]")
+    for doc in sorted(t["batches"],
+                      key=lambda d: (d.get("topic", ""),
+                                     d.get("first_offset", -1))):
+        print(f"      batch {doc.get('topic')}:{doc.get('partition')}"
+              f" offsets {doc.get('first_offset')}-"
+              f"{doc.get('last_offset')} n={doc.get('n')} "
+              f"stage={doc.get('stage')} [{doc.get('proc')}]")
+    for closer, dur_us, proc in t["e2e"]:
+        print(f"  e2e ingest->{closer}: {dur_us / 1000.0:.3f} ms "
+              f"[{proc}]")
 
 
 def summarize(stages: Dict[str, List[int]], e2e: Dict[str, List[int]]) -> dict:
@@ -103,7 +187,7 @@ def print_table(summary: dict) -> None:
 
 def cmd_trace(args) -> int:
     try:
-        stages, e2e = load_spans(args.path)
+        stages, e2e, traces = load_spans_traces(args.path)
     except OSError as e:
         print(f"cannot read span log: {e}", file=sys.stderr)
         return 2
@@ -126,9 +210,84 @@ def cmd_trace(args) -> int:
         nonzero = any(r["max_ms"] > 0 for r in summary["e2e"].values())
         if not closed or not nonzero:
             failures.append("expected closed e2e spans with nonzero latency")
+    if args.show_trace or args.require_cross_process:
+        tid, t = best_cross_process_trace(traces)
+        if not args.json and tid is not None and args.show_trace:
+            print_trace(tid, t)
+        if args.require_cross_process:
+            # the fleet assertion: at least one CLOSED trace whose
+            # stages were recorded by >= N distinct processes — proof
+            # the context really crossed the wire (ISSUE 13)
+            ok = any(len(tr["procs"]) >= args.require_cross_process
+                     and tr["e2e"]
+                     for tr in traces.values())
+            if not ok:
+                have = max((len(tr["procs"]) for tr in traces.values()
+                            if tr["e2e"]), default=0)
+                failures.append(
+                    f"expected a closed e2e trace spanning >= "
+                    f"{args.require_cross_process} processes; best "
+                    f"closed trace spans {have}")
     for f in failures:
         print(f"TRACE CHECK FAILED: {f}", file=sys.stderr)
     return 1 if failures else 0
+
+
+def cmd_fleet(args) -> int:
+    """Run (or one-shot) the metrics federation collector."""
+    from .federate import FleetCollector, FleetServer, load_manifest
+
+    endpoints = None
+    if args.endpoints:
+        endpoints = load_manifest(args.endpoints)
+        if not endpoints and args.once:
+            print(f"no endpoints in manifest {args.endpoints!r}",
+                  file=sys.stderr)
+            return 2
+    collector = FleetCollector(
+        endpoints=None if args.follow_manifest else endpoints,
+        manifest=args.endpoints)
+    broker = None
+    if args.bootstrap:
+        from ..stream.kafka_wire import KafkaWireBroker
+
+        try:
+            broker = KafkaWireBroker(args.bootstrap,
+                                     client_id="iotml-obs-fleet")
+        except OSError as e:
+            print(f"cannot reach broker {args.bootstrap!r}: {e}",
+                  file=sys.stderr)
+            if args.once:
+                return 2
+    if args.once:
+        snaps = collector.collect()
+        if broker is not None:
+            collector.snapshot_changelog(broker, snaps)
+        hz = collector.healthz(snaps)
+        if args.json:
+            print(json.dumps(hz, indent=2, sort_keys=True))
+        else:
+            print(collector.render(snaps), end="")
+            print(f"# fleet: {hz['up_count']}/{hz['process_count']} "
+                  f"processes up, status={hz['status']}",
+                  file=sys.stderr)
+        if args.min_processes and hz["up_count"] < args.min_processes:
+            print(f"FLEET CHECK FAILED: {hz['up_count']} processes up, "
+                  f"expected >= {args.min_processes}", file=sys.stderr)
+            return 1
+        return 0
+    srv = FleetServer(collector, port=args.port,
+                      interval_s=args.interval, broker=broker).start()
+    print(f"fleet metrics on :{srv.port}/metrics (+ /healthz), "
+          f"scraping every {args.interval}s; ctrl-c to stop")
+    try:
+        import time as _time
+
+        while True:
+            _time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
 
 
 def cmd_dlq(args) -> int:
@@ -223,6 +382,37 @@ def main(argv=None) -> int:
     tp.add_argument("--require-e2e", action="store_true",
                     help="exit 1 unless closed e2e spans with nonzero "
                          "latency appear")
+    tp.add_argument("--require-cross-process", type=int, default=0,
+                    metavar="N",
+                    help="exit 1 unless a closed e2e trace spans >= N "
+                         "distinct processes (fleet smoke assertion)")
+    tp.add_argument("--show-trace", action="store_true",
+                    help="print the breakdown of the trace spanning "
+                         "the most processes")
+    fp = sub.add_parser(
+        "fleet", help="metrics federation: scrape every fleet "
+                      "process's /metrics and serve one merged view")
+    fp.add_argument("--endpoints", default=None,
+                    help="endpoints manifest (JSON [{name, address}]); "
+                         "defaults to $IOTML_OBS_ENDPOINTS")
+    fp.add_argument("--port", type=int, default=9200,
+                    help="merged /metrics + /healthz port")
+    fp.add_argument("--interval", type=float, default=2.0,
+                    help="scrape cadence seconds")
+    fp.add_argument("--bootstrap", default=None,
+                    help="broker address: snapshot fleet state into "
+                         "the compacted _IOTML_METRICS changelog")
+    fp.add_argument("--once", action="store_true",
+                    help="scrape once, print the merged exposition, "
+                         "exit (CI smoke mode)")
+    fp.add_argument("--json", action="store_true",
+                    help="with --once: print the merged healthz JSON")
+    fp.add_argument("--min-processes", type=int, default=0,
+                    help="with --once: exit 1 unless >= N processes "
+                         "answered their scrape")
+    fp.add_argument("--follow-manifest", action="store_true",
+                    help="re-read the manifest every pass (processes "
+                         "may join after the collector starts)")
     dp = sub.add_parser(
         "dlq", help="peek a dead-letter topic's poisoned-record "
                     "envelopes over the Kafka wire protocol")
@@ -238,6 +428,12 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.cmd == "trace":
         return cmd_trace(args)
+    if args.cmd == "fleet":
+        import os
+
+        if args.endpoints is None:
+            args.endpoints = os.environ.get("IOTML_OBS_ENDPOINTS")
+        return cmd_fleet(args)
     if args.cmd == "dlq":
         return cmd_dlq(args)
     ap.print_help()

@@ -375,6 +375,91 @@ dlq_total = default_registry.counter(
 dlq_route_errors = default_registry.counter(
     "iotml_dlq_route_errors_total",
     "dead letters that could not be routed (degraded to a plain drop)")
+# fleet-scope observability v2 (ISSUE 13): event-time watermarks on the
+# columnar plane.  Per-record spans cannot exist where zero Python
+# records materialise, but every store frame carries the record's
+# timestamp — so each consuming stage reports, batch-granularly, how
+# far behind EVENT TIME its progress frontier sits.  Lag is observed
+# for the batch's min AND max event time, so the histogram brackets the
+# true per-record e2e latency from below and above at zero per-record
+# cost.  `stage` is a closed set (consume | score | train | twin).
+watermark_lag_seconds = default_registry.histogram(
+    "iotml_watermark_lag_seconds",
+    "event-time lag (now - record timestamp) at each stage's progress "
+    "frontier, batch-granular (min and max event time per batch)",
+    buckets=(0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0,
+             60.0, 300.0))
+watermark_event_ms = default_registry.gauge(
+    "iotml_watermark_event_time_ms",
+    "newest event timestamp (ms) each stage has fully processed — the "
+    "stage's event-time watermark, by stage/topic/partition")
+# consumer lag made first-class (ISSUE 13 satellite): records between
+# the group's cursor and the partition high-water mark, refreshed at
+# batch/commit granularity from the hwm every fetch response already
+# carries (wire legs) or one end_offset read (in-process legs)
+consumer_lag_records = default_registry.gauge(
+    "iotml_consumer_lag_records",
+    "records between a consumer group's cursor and the partition "
+    "high-water mark, by group/topic/partition")
+# hot-loop profiling hooks (ISSUE 13): where a train/score/online step's
+# wall time actually goes — waiting on data (host_wait), inside the
+# jitted program (device_compute), or in host-side decode/convert/
+# format (host_pipeline).  The measured host-vs-device balance ROADMAP
+# item 3 (multi-chip training) starts from.
+step_seconds = default_registry.histogram(
+    "iotml_step_seconds",
+    "hot-loop wall time by loop (train|score|online) and phase "
+    "(host_wait | device_compute | host_pipeline)",
+    buckets=(0.0001, 0.001, 0.005, 0.02, 0.05, 0.1, 0.5, 1.0, 5.0,
+             30.0))
+prefetch_occupancy = default_registry.gauge(
+    "iotml_prefetch_occupancy",
+    "DevicePrefetcher queue fill fraction (0 = device starving on the "
+    "host pipeline, 1 = host running ahead)")
+
+
+#: the CLOSED label-key vocabulary every iotml metric must draw from.
+#: Metric labels multiply series: one label drawn from an unbounded set
+#: (a car id, a trace id, an offset) turns a fixed-cost scrape into an
+#: unbounded allocation — the cardinality-bound test (and lint R6)
+#: fails such a label before production does.
+ALLOWED_LABEL_KEYS = frozenset({
+    "stage", "topic", "partition", "group", "phase", "loop", "process",
+    "component", "detector", "action", "fault", "source", "outcome",
+    "unit", "le",
+})
+
+#: per-metric ceiling on distinct label-value combinations.  Generous —
+#: topics × partitions × stages legitimately reach dozens — but far
+#: below what one runaway per-entity label produces in seconds.
+MAX_LABEL_SERIES = 256
+
+
+def cardinality_violations(registry: "Registry" = None,
+                           max_series: int = MAX_LABEL_SERIES):
+    """[(metric, problem)] for labels outside the closed vocabulary or
+    metrics whose labeled-series count exceeds `max_series` — the
+    label-cardinality bound the obs test suite pins."""
+    registry = default_registry if registry is None else registry
+    out = []
+    with registry._lock:
+        metrics = dict(registry._metrics)
+    for name, m in sorted(metrics.items()):
+        if isinstance(m, Histogram):
+            with m._lock:
+                keysets = list(m._series.keys())
+        else:
+            with m._lock:
+                keysets = list(m._vals.keys())
+        label_keys = {k for key in keysets for k, _v in key}
+        bad = label_keys - ALLOWED_LABEL_KEYS
+        if bad:
+            out.append((name, f"label keys outside the closed "
+                              f"vocabulary: {sorted(bad)}"))
+        if len(keysets) > max_series:
+            out.append((name, f"{len(keysets)} labeled series exceeds "
+                              f"the {max_series} cardinality bound"))
+    return out
 
 
 def start_http_server(port: int = 9100, registry: Registry = default_registry):
@@ -430,6 +515,34 @@ def start_http_server(port: int = 9100, registry: Registry = default_registry):
         if lag_vals:
             doc["replica_lag_records"] = {
                 dict(k).get("topic", ""): v for k, v in lag_vals.items()}
+        # event-time watermarks (ISSUE 13): per-stage event-time
+        # frontier and its lag vs now — true e2e staleness on the
+        # columnar paths where per-record spans cannot exist
+        with watermark_event_ms._lock:
+            wm_vals = dict(watermark_event_ms._vals)
+        if wm_vals:
+            now_ms = time.time() * 1000.0  # wallclock-ok: event
+            # timestamps live in the wall domain; this is staleness
+            # display, not a deadline
+            doc["watermarks"] = {}
+            for k, v in sorted(wm_vals.items()):
+                d = dict(k)
+                name = (f"{d.get('stage', '')}:{d.get('topic', '')}"
+                        f":{d.get('partition', '')}")
+                if d.get("group"):
+                    name += f":{d['group']}"
+                doc["watermarks"][name] = {
+                    "event_time_ms": int(v),
+                    "lag_s": round(max(now_ms - v, 0.0) / 1000.0, 3)}
+        # consumer lag (ISSUE 13 satellite): group cursor vs partition
+        # high-water mark, the federation rollup's input
+        with consumer_lag_records._lock:
+            clag_vals = dict(consumer_lag_records._vals)
+        if clag_vals:
+            doc["consumer_lag_records"] = {
+                (f"{dict(k).get('group', '')}:{dict(k).get('topic', '')}"
+                 f":{dict(k).get('partition', '')}"): v
+                for k, v in sorted(clag_vals.items())}
         epoch = failover_epoch.value()
         if epoch:
             doc["failover_epoch"] = epoch
@@ -466,4 +579,21 @@ def start_http_server(port: int = 9100, registry: Registry = default_registry):
         target=srv.serve_forever, daemon=True,
         name=f"iotml-metrics-{srv.server_address[1]}"))
     t.start()
+    # federation auto-join (ISSUE 13): every process that serves
+    # /metrics publishes its endpoint — into the in-process registry
+    # always, and into the fleet's endpoints manifest when
+    # IOTML_OBS_ENDPOINTS names one — so `python -m iotml.obs fleet`
+    # discovers the whole fleet without per-process wiring.
+    try:
+        from . import federate, tracing
+
+        name = tracing.proc_name()
+        addr = f"127.0.0.1:{srv.server_address[1]}"
+        manifest = federate.manifest_path()
+        if manifest:
+            federate.publish_endpoint(manifest, name, addr)
+        else:
+            federate.register_local_endpoint(name, addr)
+    except Exception:  # noqa: BLE001 - metrics serving must not die on
+        pass           # a manifest hiccup (read-only fs, lock contention)
     return srv

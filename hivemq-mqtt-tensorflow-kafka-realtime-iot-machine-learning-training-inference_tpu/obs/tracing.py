@@ -71,6 +71,15 @@ HEADER_KEY = "iotml_trace"
 
 _WIRE_PREFIX = "iotml1"
 
+
+def proc_name() -> str:
+    """This process's identity in cross-process span logs: IOTML_PROC
+    when the operator names the role (scorer/trainer/broker-0/...),
+    else pid-derived.  Several fleet processes append to ONE span log
+    (O_APPEND line writes); the proc field is what lets the trace CLI
+    reconstruct which process ran which stage."""
+    return os.environ.get("IOTML_PROC") or f"pid{os.getpid()}"
+
 #: per-thread span buffer bound — overload drops oldest, counted below.
 _BUFFER_BOUND = 65536
 
@@ -321,6 +330,36 @@ def set_current(ctx: Optional[TraceContext]):
     return prev
 
 
+def touch(stage: str) -> None:
+    """Mark `stage` live WITHOUT a span — the batch-granular liveness
+    beat for the columnar plane (ISSUE 13 satellite): ``poll_into``
+    materialises zero records, so an untraced-record columnar consumer
+    emits no consume-stage spans and the /healthz stage-age view would
+    report a perfectly healthy pipeline as stalled.  A plain dict store
+    under the GIL; racing a concurrent drain is benign (both write
+    "recent")."""
+    if ENABLED:
+        _last_seen[stage] = time.monotonic()
+
+
+def mark_batch(ctx: Optional[TraceContext], stage: str,
+               topic: Optional[str] = None, partition: int = -1,
+               first_offset: int = -1, last_offset: int = -1,
+               n: int = 0) -> None:
+    """One span for a whole RAW batch (ISSUE 13 wire-trace leg): marks
+    `stage` on `ctx` (the timing span, like mark()) and records a batch
+    annotation — topic/partition, offset range, record count — that the
+    span log carries so ``python -m iotml.obs trace`` can show which
+    bytes the cross-process span covered.  Batch-granular by contract:
+    one call per raw batch, never per record."""
+    if ctx is None or ctx.closed:
+        return
+    ctx.mark(stage)
+    _collector.record(("batch", ctx.trace_id, stage, topic or "",
+                       int(partition), int(first_offset),
+                       int(last_offset), int(n), ctx.wall0_ns))
+
+
 def headers_for(ctx: Optional[TraceContext]) -> Optional[Tuple]:
     """Record headers carrying `ctx` (None stays None: untraced records
     pay no header tuple)."""
@@ -363,6 +402,7 @@ def flush() -> Dict[str, int]:
         return {"spans": 0, "e2e": 0}
     n_span = n_e2e = 0
     lines: List[str] = []
+    proc = proc_name()
     for e in entries:
         if e[0] == "span":
             _, tid, stage, start_s, dur_s, wall0_ns, t_mark = e
@@ -377,7 +417,20 @@ def flush() -> Dict[str, int]:
                 lines.append(json.dumps(
                     {"kind": "span", "trace": f"{tid:016x}", "stage": stage,
                      "start_us": int(start_s * 1e6),
-                     "dur_us": int(dur_s * 1e6), "wall0_ns": wall0_ns}))
+                     "dur_us": int(dur_s * 1e6), "wall0_ns": wall0_ns,
+                     "proc": proc}))
+        elif e[0] == "batch":
+            # batch annotation (mark_batch): the timing span was already
+            # recorded by the mark() inside mark_batch — this line
+            # carries the WHAT (topic/partition/offset range/count) for
+            # the cross-process trace reconstruction
+            _, tid, stage, topic, part, first, last, n, wall0_ns = e
+            if _PATH:
+                lines.append(json.dumps(
+                    {"kind": "batch", "trace": f"{tid:016x}",
+                     "stage": stage, "topic": topic, "partition": part,
+                     "first_offset": first, "last_offset": last,
+                     "n": n, "wall0_ns": wall0_ns, "proc": proc}))
         else:
             _, tid, closer, dur_s, wall0_ns = e
             n_e2e += 1
@@ -388,7 +441,8 @@ def flush() -> Dict[str, int]:
             if _PATH:
                 lines.append(json.dumps(
                     {"kind": "e2e", "trace": f"{tid:016x}", "closer": closer,
-                     "dur_us": int(dur_s * 1e6), "wall0_ns": wall0_ns}))
+                     "dur_us": int(dur_s * 1e6), "wall0_ns": wall0_ns,
+                     "proc": proc}))
     if lines and _PATH:
         try:
             with _log_lock:

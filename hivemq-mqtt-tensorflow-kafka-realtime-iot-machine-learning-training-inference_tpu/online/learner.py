@@ -39,6 +39,7 @@ import numpy as np
 from ..chaos import faults as chaos
 from ..data.dataset import SensorBatches
 from ..obs import metrics as obs_metrics
+from ..obs import watermark
 from ..stream.consumer import StreamConsumer
 from ..train.live import commit_manifest_offsets
 from ..train.loop import (Trainer, adam_injectable_cached,
@@ -240,7 +241,9 @@ class OnlineLearner:
         """One incremental step on one window; returns the pre-update
         loss (the drift signal)."""
         self.trainer._ensure_state(b.x)
-        with obs_metrics.train_step_seconds.time():
+        with obs_metrics.train_step_seconds.time(), \
+                obs_metrics.step_seconds.time(loop="online",
+                                              phase="device_compute"):
             self.trainer.state, m = self.trainer._step(
                 self.trainer.state, b.x, b.x, b.mask)
         loss = float(m["loss"])
@@ -260,7 +263,9 @@ class OnlineLearner:
         masks = np.stack([b.mask for b in bs])
         scan = scanned_window_steps_cached(
             self.model, self._tx, tx_key=("online-adam", self.base_lr))
-        with obs_metrics.train_step_seconds.time():
+        with obs_metrics.train_step_seconds.time(), \
+                obs_metrics.step_seconds.time(loop="online",
+                                              phase="device_compute"):
             self.trainer.state, losses = scan(self.trainer.state, xs,
                                               masks)
         losses = [float(v) for v in np.asarray(losses)]
@@ -284,10 +289,12 @@ class OnlineLearner:
         design exactly as in ContinuousTrainer)."""
         self.batches.take = max(1, limit)
         group = []
-        for b in iter(self.batches):
-            chaos.point("online.update")
-            if b.n_valid:
-                group.append(b)
+        with obs_metrics.step_seconds.time(loop="online",
+                                           phase="host_pipeline"):
+            for b in iter(self.batches):
+                chaos.point("online.update")
+                if b.n_valid:
+                    group.append(b)
         return group
 
     def _after_update(self, loss: float) -> None:
@@ -349,6 +356,11 @@ class OnlineLearner:
                 for loss in losses:
                     self._after_update(loss)
                 n += k
+            # group boundary: consumed == trained — publish the
+            # ingest→train watermark from the folded event-time ranges
+            watermark.observe_taken("train",
+                                    self.consumer.take_event_time(),
+                                    group=self.group)
             if self._publish_pending:
                 force, self._publish_pending = self._publish_force, False
                 self._publish_force = False

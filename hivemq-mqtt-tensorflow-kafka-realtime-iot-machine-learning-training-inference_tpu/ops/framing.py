@@ -72,6 +72,44 @@ FRAMES_STOP_TORN = 1
 FRAMES_STOP_SCHEMA = 2
 
 
+# ----------------------------------------------- batch-granular headers
+def stamp_first_frame(buf: bytes, headers) -> bytes:
+    """Attach `headers` ([(key, value)]) to the FIRST frame of a raw
+    batch, re-framing only that one record — the batch-granular trace
+    carrier of the wire-trace leg (ISSUE 13): one record re-encode per
+    SAMPLED batch, zero cost on unstamped batches.  Headers ride the
+    store frame's own headers field, so they survive RAW_PRODUCE,
+    segment append, replica mirroring and RAW_FETCH verbatim.  Returns
+    `buf` unchanged when it holds no complete frame."""
+    from ..store import segment as seg
+
+    for pos, end, off, key, value, ts, hdrs in seg.scan_records(buf):
+        merged = tuple(hdrs or ()) + tuple(headers)
+        return (buf[:pos] + seg.encode_record(off, key, value, ts, merged)
+                + buf[end:])
+    return buf
+
+
+def first_frame_headers(buf, at_or_after: Optional[int] = None
+                        ) -> Optional[tuple]:
+    """Headers of the first complete frame in a raw batch (None when
+    absent) — the consume-side twin of `stamp_first_frame`.  O(one
+    frame), never a batch walk: batch-granular by construction.
+
+    ``at_or_after``: answer None when the first frame's offset is below
+    it.  A raw read is sparse-index ALIGNED — it may re-serve the batch
+    head below the requested cursor — and without this guard every
+    later slice of one stamped batch would re-extract (and re-close)
+    the same trace context."""
+    from ..store import segment as seg
+
+    for _pos, _end, off, _k, _v, _ts, hdrs in seg.scan_records(buf):
+        if at_or_after is not None and off < at_or_after:
+            return None
+        return hdrs
+    return None
+
+
 class CorruptFrameError(ValueError):
     """A pre-framed batch failed CRC/offset validation at frame `index`.
 
@@ -268,7 +306,8 @@ def decode_frames_columnar_py(
         buf: bytes, start_offset: int, schema,
         pinned_id_limit: Optional[int] = None,
         cap_rows: int = 1 << 62, label_stride: int = 16,
-        key_stride: int = 64, with_keys: bool = False
+        key_stride: int = 64, with_keys: bool = False,
+        want_ts: bool = False
 ) -> Tuple["np.ndarray", "np.ndarray", Optional["np.ndarray"],
            int, int, int]:
     """Pure-Python twin of ``cpp/frame_engine.cc``'s columnar decoder —
@@ -279,7 +318,10 @@ def decode_frames_columnar_py(
     Confluent schema-id mismatch, cap) and fills float32 numeric /
     fixed-stride label / key columns.  Returns
     ``(numeric [n,F] float32, labels [n,S] S-stride, keys|None,
-    next_offset, flags, skipped_tombstones)``.
+    next_offset, flags, skipped_tombstones)``; with ``want_ts`` the
+    tuple grows ``(ts_min, ts_max)`` — event-time bounds (ms) over the
+    consumed frames, tombstones included, -1 when nothing consumed
+    (parity with ``iotml_frames_decode_columnar_ts``).
     """
     import numpy as np
 
@@ -301,15 +343,26 @@ def decode_frames_columnar_py(
     next_offset = start_offset
     consumed = 0
     stopped = False
+    ts_min = ts_max = -1
+
+    def _fold_ts(ts):
+        nonlocal ts_min, ts_max
+        if ts_min < 0 or ts < ts_min:
+            ts_min = ts
+        if ts > ts_max:
+            ts_max = ts
+
     for _pos, end, off, key, value, _ts, _hdrs in seg.scan_records(buf):
         if len(rows_num) >= cap_rows:
             stopped = True
             break
         if off >= start_offset and value is None:
-            # tombstone: no payload to decode, consumed + counted
+            # tombstone: no payload to decode, consumed + counted — and
+            # it still advances the event-time watermark
             skipped += 1
             next_offset = off + 1
             consumed = end
+            _fold_ts(_ts)
             continue
         if off < start_offset:
             consumed = end  # sparse-index alignment: skip, still consumed
@@ -337,6 +390,7 @@ def decode_frames_columnar_py(
             rows_key.append((key or b"")[:key_stride - 1])
         next_offset = off + 1
         consumed = end
+        _fold_ts(_ts)
     if not stopped and consumed < len(buf):
         flags |= FRAMES_STOP_TORN  # scan parked on a torn/corrupt frame
     n = len(rows_num)
@@ -350,4 +404,5 @@ def decode_frames_columnar_py(
     if with_keys:
         keys = np.asarray(rows_key, f"S{key_stride}") if rows_key \
             else np.zeros((0,), f"S{key_stride}")
-    return numeric, labels, keys, next_offset, flags, skipped
+    out = (numeric, labels, keys, next_offset, flags, skipped)
+    return out + (ts_min, ts_max) if want_ts else out

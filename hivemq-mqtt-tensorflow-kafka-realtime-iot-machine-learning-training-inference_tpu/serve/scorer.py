@@ -24,7 +24,7 @@ import numpy as np
 
 from ..chaos import faults as chaos
 from ..obs import metrics as obs_metrics
-from ..obs import tracing
+from ..obs import tracing, watermark
 from ..data.dataset import SensorBatches
 from ..stream.producer import OutputSequence
 from ..train.loop import make_eval_step
@@ -196,7 +196,11 @@ class StreamScorer:
             chaos.point("scorer.poll")  # injected stall/crash lands at a
             # super-batch boundary: exactly where a real broker death
             # surfaces, upstream of the commit (redelivery covers it)
-            bs = list(itertools.islice(it, self.max_super_batches))
+            with obs_metrics.step_seconds.time(loop="score",
+                                               phase="host_pipeline"):
+                # the host leg: poll + columnar decode + batching (the
+                # batcher's iterator does all three)
+                bs = list(itertools.islice(it, self.max_super_batches))
             if not bs:
                 break
             self._score_super_batch(bs, it_base)
@@ -217,6 +221,15 @@ class StreamScorer:
             # skips data; under sustained overload (every call truncated)
             # commits simply wait for the first completed drain.
             self.batches.consumer.commit()
+            # completed drain: everything consumed has been SCORED, so
+            # the accumulated event-time ranges become the ingest→score
+            # watermark (ISSUE 13) — true e2e staleness on the columnar
+            # paths where per-record spans cannot exist
+            take = getattr(self.batches.consumer, "take_event_time", None)
+            if take is not None:
+                watermark.observe_taken(
+                    "score", take(),
+                    group=getattr(self.batches.consumer, "group", ""))
             if tracing.ENABLED:
                 # completed drain: every decoded record has been scored,
                 # so close each trace with its e2e (ingest → score) span.
@@ -250,8 +263,10 @@ class StreamScorer:
                 [xs, np.zeros((S_pad - S, B) + row_shape, xs.dtype)])
         else:
             xs_in = xs
-        preds = jax.device_get(self._eval(
-            self.params, xs_in.reshape((S_pad * B,) + row_shape)))
+        with obs_metrics.step_seconds.time(loop="score",
+                                           phase="device_compute"):
+            preds = jax.device_get(self._eval(
+                self.params, xs_in.reshape((S_pad * B,) + row_shape)))
         preds = preds.reshape((S_pad, B) + preds.shape[1:])[:S]
         # per-row reconstruction error over every non-batch axis
         err_axes = tuple(range(2, preds.ndim))

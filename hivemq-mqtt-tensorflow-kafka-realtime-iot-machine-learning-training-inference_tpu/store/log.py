@@ -146,7 +146,11 @@ class SegmentedLog:
                  metric_labels: Optional[dict] = None):
         self.dir = dir
         self.policy = policy or StorePolicy()
-        self._labels = metric_labels or {"dir": dir}
+        # mounted logs label by topic/partition (store/mount.py); a BARE
+        # construction gets the unlabeled series — labeling by the raw
+        # directory path was a cardinality leak (one series per tmp dir,
+        # forever), exactly the class the closed-vocabulary test rejects
+        self._labels = metric_labels or {}
         os.makedirs(dir, exist_ok=True)
         self._segments: List[_Segment] = []
         self._writer: Optional[SegmentWriter] = None

@@ -18,7 +18,10 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from struct import error as struct_error
+
 from ..obs import metrics as obs_metrics
+from ..obs import tracing, watermark
 from .broker import (Broker, Message, OffsetOutOfRangeError,
                      SchemaIdMismatchError)
 
@@ -56,6 +59,20 @@ class StreamConsumer:
             self._cursors.append([t, p, o])
         self._start = [c[2] for c in self._cursors]
         self._rr = 0
+        # event-time accounting (ISSUE 13): per-(topic, partition)
+        # [min_ts, max_ts] of records consumed since the last
+        # take_event_time() — the consume paths fold decoder-reported
+        # (columnar) or message (classic) timestamps in at batch
+        # granularity; processing stages (scorer/trainer/twin) take the
+        # ranges at their drain/commit boundary and publish the
+        # ingest→stage watermark lag.
+        self._event_ts: dict = {}
+        # batch-granular trace contexts extracted from RAW batch frame
+        # headers (the wire-trace leg): bounded, drained by the batcher
+        import collections
+
+        self._batch_traces: "collections.deque" = collections.deque(
+            maxlen=1024)
 
     @classmethod
     def from_committed(cls, broker: Broker, topic: str, partitions: Sequence[int],
@@ -77,6 +94,80 @@ class StreamConsumer:
             topic, part, _ = cur
             off = self.broker.committed(self.group, topic, part)
             cur[2] = off if off is not None else self._start[i]
+
+    # ------------------------------------------- event-time watermarks
+    def _note_event_ts(self, topic: str, part: int,
+                       ts_min: int, ts_max: int) -> None:
+        """Fold one consumed batch's event-time bounds into the
+        per-partition accumulation AND publish the consume-stage
+        watermark — batch-granular, the columnar plane's substitute for
+        per-record spans (ISSUE 13)."""
+        if ts_max is None or ts_max < 0:
+            return
+        lo = ts_min if ts_min is not None and ts_min >= 0 else ts_max
+        cur = self._event_ts.get((topic, part))
+        if cur is None:
+            self._event_ts[(topic, part)] = [lo, ts_max]
+        else:
+            if lo < cur[0]:
+                cur[0] = lo
+            if ts_max > cur[1]:
+                cur[1] = ts_max
+        # group-labeled: a trainer and a scorer consuming the same
+        # partition in one process are different frontiers — without
+        # the group the gauge would flap between them
+        watermark.observe("consume", topic, part, lo, ts_max,
+                          group=self.group)
+
+    def take_event_time(self) -> dict:
+        """{(topic, partition): (ts_min, ts_max)} of event time consumed
+        since the last take, cleared on read — the processing stage's
+        half of the watermark contract: take at the drain/commit
+        boundary (where consumed == processed) and hand the ranges to
+        ``watermark.observe_taken(stage, ...)``."""
+        out = {k: tuple(v) for k, v in self._event_ts.items()}
+        self._event_ts.clear()
+        return out
+
+    def take_batch_traces(self) -> list:
+        """Drain batch-granular trace contexts extracted from RAW batch
+        frame headers (the wire-trace leg): the batcher appends them to
+        its pending set so the pipeline closer (scorer / train step)
+        closes them with the e2e span, exactly like record traces."""
+        out: list = []
+        while True:
+            try:
+                out.append(self._batch_traces.popleft())
+            except IndexError:
+                return out
+
+    def record_lag(self) -> int:
+        """Refresh ``iotml_consumer_lag_records{group,topic,partition}``
+        from the high-water mark and return the total lag.  Wire
+        brokers answer from the hwm CACHED off every fetch response —
+        classic FETCH and RAW_FETCH both carry it (zero extra round
+        trips); otherwise one ``end_offset`` read per partition —
+        called at commit/drain granularity, never per record.  This is
+        TELEMETRY riding the commit path: no failure here may crash a
+        drain, so anything the broker throws (dead socket, transient
+        wire error, racing topic deletion) degrades to a skipped
+        refresh."""
+        total = 0
+        hwm_of = getattr(self.broker, "last_hwm", None)
+        for topic, part, off in self._cursors:
+            try:
+                hwm = hwm_of(topic, part) if hwm_of is not None else None
+                if hwm is None:
+                    hwm = self.broker.end_offset(topic, part)
+            except (KeyError, RuntimeError, OSError):
+                # OSError covers ConnectionError AND socket timeouts;
+                # RuntimeError is the wire client's non-OK error answer
+                continue
+            lag = max(int(hwm) - int(off), 0)
+            total += lag
+            obs_metrics.consumer_lag_records.set(
+                lag, group=self.group, topic=topic, partition=part)
+        return total
 
     def _fetch_autoreset(self, topic: str, part: int, off: int,
                          max_messages: int) -> tuple:
@@ -119,6 +210,18 @@ class StreamConsumer:
                 cur[2] = batch[-1].offset + 1
                 out.extend(batch)
                 attempts = 0  # progress was made; give others another chance
+                # true min/max over the batch — event timestamps are
+                # NOT append-monotone (a flap-recovered car's store-and-
+                # forward buffer appends old event times after fresh
+                # ones), and endpoint sampling would hide exactly those
+                # records' lag.  O(n) attribute reads over an already-
+                # materialised message list; the columnar path gets the
+                # same bounds from the decoder's walk for free.
+                self._note_event_ts(
+                    topic, part,
+                    min(m.timestamp_ms for m in batch),
+                    max(m.timestamp_ms for m in batch))
+                tracing.touch("consume")
         if out:
             # batch-shape telemetry: a drifting-down batch size under
             # constant load means the consumer is outpacing the producers
@@ -250,10 +353,21 @@ class StreamConsumer:
                 out_keys[rows:] if out_keys is not None else None,
                 cap_rows=max_rows - rows)
             if got or next_off > off:
-                # progress: decoded rows and/or skipped tombstones
+                # progress: decoded rows and/or skipped tombstones.
+                # Event-time bounds fall out of the decoder's frame walk
+                # for free (ISSUE 13): fold them into the watermark and
+                # beat the consume-stage liveness — the batch-granular
+                # telemetry the zero-record path otherwise cannot have.
                 cur[2] = next_off
                 rows += got
                 attempts = 0
+                self._note_event_ts(topic, part,
+                                    getattr(decoder, "last_ts_min", -1),
+                                    getattr(decoder, "last_ts_max", -1))
+                if tracing.ENABLED:
+                    tracing.touch("consume")
+                    self._extract_batch_trace(raw, topic, part, off,
+                                              next_off, got)
                 continue
             if flags & FRAMES_STOP_SCHEMA:
                 # evolved writer at the cursor: the caller resolves this
@@ -275,6 +389,36 @@ class StreamConsumer:
         if rows:
             obs_metrics.fetch_batch_size.observe(rows)
         return rows, False
+
+    def _extract_batch_trace(self, raw, topic: str, part: int,
+                             first_off: int, next_off: int,
+                             got: int) -> None:
+        """Wire-trace leg (ISSUE 13): a SAMPLED raw batch carries a
+        trace context in its first frame's headers — ONE bounded
+        first-frame parse per RAW fetch (only under tracing), never a
+        batch walk.  The context is marked `consume` with the batch's
+        offset range and held for the pipeline closer (scorer / train
+        step) to close with its e2e span.  Gated at the cursor: a
+        sparse-index-aligned re-serve of the batch head (first frame
+        below `first_off`) is NOT a new batch — re-extracting it would
+        close the same trace once per slice."""
+        from ..ops.framing import first_frame_headers
+
+        try:
+            hdrs = first_frame_headers(raw.data, at_or_after=first_off)
+        except (ValueError, struct_error):
+            return
+        ctx = tracing.from_headers(hdrs)
+        if ctx is None:
+            return
+        tracing.mark_batch(ctx, "consume", topic, part, first_off,
+                           next_off - 1, got)
+        if len(self._batch_traces) == self._batch_traces.maxlen:
+            # bounded like the batcher's pending set, and COUNTED like
+            # it: a drill losing its cross-process traces to this bound
+            # must show counter evidence of why
+            tracing.spans_dropped.inc()
+        self._batch_traces.append(ctx)
 
     def at_end(self) -> bool:
         return all(off >= self.broker.end_offset(t, p)
@@ -320,6 +464,9 @@ class StreamConsumer:
         return [tuple(c) for c in self._cursors]
 
     def commit(self):
+        # commit is the drain boundary — the batch-granular spot to
+        # refresh the first-class lag gauge (ISSUE 13 satellite)
+        self.record_lag()
         with obs_metrics.commit_seconds.time():
             commit_many = getattr(self.broker, "commit_many", None)
             if commit_many is not None:

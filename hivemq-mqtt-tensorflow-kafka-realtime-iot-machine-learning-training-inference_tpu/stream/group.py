@@ -445,6 +445,22 @@ class GroupConsumer:
     def at_end(self) -> bool:
         return self._sc.at_end()
 
+    def take_event_time(self) -> dict:
+        """Event-time ranges consumed since the last take (ISSUE 13) —
+        delegated so group-elastic pipelines publish the same
+        ingest→stage watermarks as static ones."""
+        return self._sc.take_event_time()
+
+    def take_batch_traces(self) -> list:
+        """Wire-carried batch traces extracted by the columnar poll —
+        delegated (see StreamConsumer.take_batch_traces)."""
+        return self._sc.take_batch_traces()
+
+    def record_lag(self) -> int:
+        """Refresh iotml_consumer_lag_records for the assigned
+        partitions (see StreamConsumer.record_lag)."""
+        return self._sc.record_lag()
+
     def __iter__(self):
         while True:
             batch = self.poll()
@@ -470,6 +486,7 @@ class GroupConsumer:
     def commit(self) -> bool:
         """Generation-fenced commit; returns False (and writes nothing) when
         this member has been fenced by a rebalance it hasn't seen yet."""
+        self._sc.record_lag()  # drain boundary: refresh the lag gauge
         return self.coord.fenced_commit(self.member_id, self.generation,
                                         self._sc.positions())
 

@@ -36,6 +36,7 @@ import zlib
 from typing import Dict, List, Optional, Tuple
 
 from ..chaos import faults as chaos
+from ..obs import tracing as _tracing
 from ..utils.net import recv_exact
 from .broker import (Broker, CorruptMessageError, Message,
                      OffsetOutOfRangeError, TopicSpec)
@@ -488,6 +489,10 @@ class KafkaWireBroker(ProducePartitionMixin):
         self._connect_any()  # resolves topology first (its only caller)
         self._meta: Dict[str, int] = {}  # topic → partition count
         self._rr: Dict[str, int] = {}
+        # high-water marks stashed off every classic fetch response —
+        # the consumer-lag source that costs zero extra round trips
+        # (ISSUE 13 satellite; see last_hwm)
+        self._hwm: Dict[tuple, int] = {}
 
     # ------------------------------------------------------ epoch fencing
     @property
@@ -901,6 +906,9 @@ class KafkaWireBroker(ProducePartitionMixin):
                     raise NotLeaderForPartitionError(tname or topic, pid)
                 if err != ERR_NONE:
                     raise RuntimeError(f"fetch {topic}:{pid} failed: {err}")
+                # the hwm already rides every fetch response: cache it so
+                # consumer-lag needs no extra round trip (last_hwm)
+                self._hwm[(tname or topic, pid)] = int(hwm)
                 for off, key, value, ts in decode_message_set(record_set or b""):
                     if off >= offset and len(out) < max_messages:
                         # a null VALUE is a tombstone (compacted-topic
@@ -910,6 +918,13 @@ class KafkaWireBroker(ProducePartitionMixin):
                         out.append(Message(tname, pid, off, value,
                                            key, ts))
         return out
+
+    def last_hwm(self, topic: str, partition: int) -> Optional[int]:
+        """The newest high-water mark seen for (topic, partition) in a
+        fetch response, None before the first classic fetch — the
+        zero-round-trip consumer-lag source (StreamConsumer.record_lag
+        falls back to end_offset when absent)."""
+        return self._hwm.get((topic, partition))
 
     def fetch_raw(self, topic: str, partition: int, offset: int,
                   max_bytes: int = 1 << 20):
@@ -934,6 +949,16 @@ class KafkaWireBroker(ProducePartitionMixin):
                 "server lacks the RAW_FETCH extension")
         aux = r.i64()  # start offset; earliest-retained for error 1
         blob = r.bytes_()
+        # trailing-optional hwm (ISSUE 13 satellite): newer servers
+        # append the partition high-water mark after the blob so the
+        # COLUMNAR path feeds consumer-lag with zero extra round trips,
+        # exactly like classic fetch.  Optional both directions: an
+        # older server simply ends the response here, an older client
+        # never reads past the blob.
+        if err == ERR_NONE and r.pos + 8 <= len(r.buf):
+            hwm = r.i64()
+            if hwm >= 0:  # -1 = the server could not answer cheaply
+                self._hwm[(topic, partition)] = hwm
         if not blob and err == ERR_NONE:
             return None  # log end
         if err == ERR_OFFSET_OUT_OF_RANGE:
@@ -1373,6 +1398,28 @@ class _KafkaConn(socketserver.BaseRequestHandler):
         return topic in broker.topics() and \
             0 <= pid < broker.topic(topic).partitions
 
+    @staticmethod
+    def _mark_raw_batch(frames: bytes, stage: str, topic: str,
+                        pid: int, at_or_after=None) -> None:
+        """Record the broker-process hop of a wire-carried batch trace
+        (ISSUE 13): a sampled RAW batch carries its context in the
+        first frame's headers — decode it and mark `stage`, so a
+        cross-process reconstruction shows the MQTT→bridge→shard→
+        consumer path through THIS broker.  One bounded first-frame
+        parse, only under tracing; any malformed bytes are simply not a
+        trace (the produce/fetch path itself validates separately).
+        ``at_or_after`` gates re-served batch heads on the fetch side
+        exactly like StreamConsumer._extract_batch_trace."""
+        from ..ops.framing import first_frame_headers
+
+        try:
+            hdrs = first_frame_headers(frames, at_or_after=at_or_after)
+        except (ValueError, struct.error):
+            return
+        ctx = _tracing.from_headers(hdrs)
+        if ctx is not None:
+            _tracing.mark_batch(ctx, stage, topic, pid)
+
     def _epoch_mismatch(self, client_epoch: Optional[int]) -> bool:
         """True when the fencing epochs disagree.  A stamped epoch below
         the server's means the CLIENT slept through a failover; above it
@@ -1567,11 +1614,36 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                     w.i16(ERR_OFFSET_OUT_OF_RANGE).i64(e.earliest)
                     w.bytes_(None)
                 else:
+                    # cheap for local (in-memory/durable) brokers; a
+                    # RELAY broker (wire client backing this server)
+                    # must not pay an upstream round trip per fetch —
+                    # its own fetch_raw just cached the upstream's
+                    # trailing hwm, so answer from that cache (-1 =
+                    # genuinely absent)
+                    if hasattr(broker, "_request"):
+                        lh = getattr(broker, "last_hwm", None)
+                        hwm = lh(tname, pid) if lh is not None else None
+                        hwm = -1 if hwm is None else hwm
+                    else:
+                        hwm = broker.end_offset(tname, pid)
                     if raw is None:
                         w.i16(ERR_NONE).i64(offset).bytes_(b"")
                     else:
+                        if _tracing.ENABLED:
+                            # broker-process hop of a wire-carried batch
+                            # trace: one first-frame parse per raw fetch
+                            # (batch-granular), so the trace CLI sees
+                            # the shard the batch crossed
+                            self._mark_raw_batch(raw.data,
+                                                 "wire_raw_fetch",
+                                                 tname, pid,
+                                                 at_or_after=offset)
                         w.i16(ERR_NONE).i64(raw.start_offset)
                         w.bytes_(raw.data)
+                    # trailing-optional hwm: consumer lag for the
+                    # columnar path at zero extra round trips (older
+                    # clients never read past the blob)
+                    w.i64(hwm)
         elif api_key == RAW_PRODUCE:
             # write-path mirror of RAW_FETCH: a pre-framed batch the
             # broker appends segment-verbatim (CRCs validated WHOLE,
@@ -1595,6 +1667,9 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                 if not self._valid_part(broker, tname, pid):
                     w.i16(ERR_UNKNOWN_TOPIC).i64(-1).i32(0)
                 else:
+                    if _tracing.ENABLED:
+                        self._mark_raw_batch(frames, "wire_raw_produce",
+                                             tname, pid)
                     try:
                         base = produce_raw(tname, pid, frames)
                     except NotImplementedError:

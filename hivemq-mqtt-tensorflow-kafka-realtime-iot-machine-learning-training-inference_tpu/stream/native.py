@@ -42,7 +42,7 @@ def _build() -> bool:
         return False
 
 
-ENGINE_VERSION = 8  # must match iotml_engine_version() in avro_engine.cc
+ENGINE_VERSION = 9  # must match iotml_engine_version() in avro_engine.cc
 
 
 def _stale() -> bool:
@@ -87,6 +87,9 @@ def load() -> Optional[ctypes.CDLL]:
         lib.iotml_format_rows_f32.restype = ctypes.c_int64
         lib.iotml_format_rows_f64.restype = ctypes.c_int64
         lib.iotml_frames_decode_columnar.restype = ctypes.c_int64
+        # watermark-carrying decode (ABI 9): same walk, event-time
+        # min/max out-params — the columnar plane's zero-cost watermark
+        lib.iotml_frames_decode_columnar_ts.restype = ctypes.c_int64
         # write-path frame codec (ABI 8, frame_engine.cc)
         lib.iotml_frames_encode_columnar.restype = ctypes.c_int64
         lib.iotml_frames_encode_values.restype = ctypes.c_int64
@@ -394,6 +397,13 @@ class FrameDecoder:
         self.pinned_id_limit = RESERVED_ID_BASE \
             if pinned_id_limit is None else int(pinned_id_limit)
         self._lib = codec._lib
+        #: event-time bounds (ms) of the frames CONSUMED by the last
+        #: decode_into call — decoded rows and skipped tombstones alike;
+        #: -1 when that call consumed nothing.  The batch-granular
+        #: watermark source (ISSUE 13): the frame head already carries
+        #: every record's timestamp, so min/max costs nothing extra.
+        self.last_ts_min = -1
+        self.last_ts_max = -1
 
     @property
     def n_numeric(self) -> int:
@@ -435,8 +445,10 @@ class FrameDecoder:
         next_off = ctypes.c_int64(start_offset)
         flags = ctypes.c_int64(0)
         skipped = ctypes.c_int64(0)
+        ts_min = ctypes.c_int64(-1)
+        ts_max = ctypes.c_int64(-1)
         label_stride = out_labels.dtype.itemsize
-        rows = self._lib.iotml_frames_decode_columnar(
+        rows = self._lib.iotml_frames_decode_columnar_ts(
             c_buf,
             ctypes.c_int64(len(buf)), ctypes.c_int64(int(start_offset)),
             codec.types.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
@@ -451,8 +463,11 @@ class FrameDecoder:
             ctypes.c_int64(out_keys.dtype.itemsize
                            if out_keys is not None else 0),
             ctypes.c_int64(cap), ctypes.byref(next_off),
-            ctypes.byref(flags), ctypes.byref(skipped))
+            ctypes.byref(flags), ctypes.byref(skipped),
+            ctypes.byref(ts_min), ctypes.byref(ts_max))
         if rows < 0:
             raise ValueError("frame decoder rejected arguments")
+        self.last_ts_min = int(ts_min.value)
+        self.last_ts_max = int(ts_max.value)
         return int(rows), int(next_off.value), int(flags.value), \
             int(skipped.value)

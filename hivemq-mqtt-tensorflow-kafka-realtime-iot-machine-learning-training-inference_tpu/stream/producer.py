@@ -22,6 +22,7 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
+from ..obs import tracing
 from ..obs.metrics import default_registry as _metrics
 from .broker import Broker
 
@@ -149,6 +150,20 @@ class RawBatchProducer:
         if produce_raw is None:
             self._pin_classic()
             return self._classic(partition, entries)
+        ctx = None
+        if tracing.ENABLED:
+            # wire-trace leg (ISSUE 13): a SAMPLED batch carries one
+            # trace context in its first frame's headers — the frame
+            # field survives RAW_PRODUCE, the segment, replica mirrors
+            # and RAW_FETCH verbatim, so the batch's journey is
+            # reconstructable across processes.  Cost: one record
+            # re-encode per sampled batch, zero on unsampled ones.
+            ctx = tracing.start("raw_produce")
+            if ctx is not None:
+                from ..ops.framing import stamp_first_frame
+
+                frames = stamp_first_frame(
+                    frames, ((tracing.HEADER_KEY, ctx),))
         try:
             t0 = time.perf_counter()
             base = produce_raw(self.topic, partition, frames)
@@ -156,6 +171,9 @@ class RawBatchProducer:
         except NotImplementedError:
             self._pin_classic()
             return self._classic(partition, entries)
+        if ctx is not None:
+            tracing.mark_batch(ctx, "raw_produce_append", self.topic,
+                               partition, base, base + count - 1, count)
         self._raw = True
         self.raw_batches += 1
         raw_produce_records.inc(count)

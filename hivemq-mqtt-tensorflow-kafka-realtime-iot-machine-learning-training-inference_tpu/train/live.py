@@ -27,6 +27,7 @@ import numpy as np
 from ..chaos import faults as chaos
 from ..data.dataset import SensorBatches
 from ..obs import metrics as obs_metrics
+from ..obs import watermark
 from ..stream.consumer import StreamConsumer
 from .artifacts import ArtifactStore
 from .loop import Trainer
@@ -193,6 +194,11 @@ class ContinuousTrainer:
         self.last_loss = float(history["loss"][-1])
         obs_metrics.live_train_rounds.inc()
         obs_metrics.live_train_loss.set(self.last_loss)
+        # the round's slice is fully trained: publish the ingest→train
+        # watermark from the event-time ranges the consume paths folded
+        # (ISSUE 13) — batch-granular, exact on the columnar plane
+        watermark.observe_taken("train", self.consumer.take_event_time(),
+                                group=self.group)
         if self.checkpointer is not None:
             # async path: capture (device->host) the state + the exact
             # cursors it was trained through and return to training —

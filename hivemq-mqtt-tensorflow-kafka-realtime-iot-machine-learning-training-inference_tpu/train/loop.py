@@ -362,7 +362,11 @@ class Trainer:
         # fit over the same source would see nothing).
         it = next(batches.epochs(1)) if hasattr(batches, "epochs") \
             else iter(batches)
-        bs = list(it)
+        with obs_metrics.step_seconds.time(loop="train",
+                                           phase="host_pipeline"):
+            # the host leg of the round: poll + decode + batch assembly
+            # all happen inside the batcher's iterator
+            bs = list(it)
         if not bs:
             return {"loss": [], "accuracy": [], "records": [], "seconds": []}
         xs = np.stack([b.x for b in bs])
@@ -381,6 +385,10 @@ class Trainer:
         if fused == "always" and not use_fused:
             raise ValueError("fused fit unsupported for this model/optimizer/"
                              "slice size")
+        # device leg: transfer + compiled program + the one sync below —
+        # measured through the device_get because dispatch is async and
+        # the program is not "done" until the host observes its results
+        t_dev = time.perf_counter()
         if use_fused:
             xs, masks = jax.device_put((xs, masks))
             self.state, losses, accs = fused_train.fused_fit(
@@ -411,6 +419,9 @@ class Trainer:
         # tunnel round trip, and the second would wait on nothing new
         losses, accs = (np.asarray(a)
                         for a in jax.device_get((losses, accs)))
+        obs_metrics.step_seconds.observe(time.perf_counter() - t_dev,
+                                         loop="train",
+                                         phase="device_compute")
         dt = time.perf_counter() - t0
         return {"loss": losses.tolist(), "accuracy": accs.tolist(),
                 "records": [records] * epochs, "seconds": [dt / epochs] * epochs}

@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Sequence
 
 from ..core.schema import KSQL_CAR_SCHEMA, RecordSchema
 from ..obs import metrics as obs_metrics
+from ..obs import watermark
 from ..ops.avro import AvroCodec
 from ..ops.framing import strip_frame
 from ..stream.broker import OffsetOutOfRangeError
@@ -214,6 +215,11 @@ class TwinService:
                     self.emitted += len(entries)
                     twin_changelog.inc(len(entries))
         self.consumer.commit()
+        # fold + changelog + commit done: the pass's event-time ranges
+        # become the ingest→twin watermark (ISSUE 13) — how stale the
+        # digital twin's knowledge of the fleet is, in event time
+        watermark.observe_taken("twin", self.consumer.take_event_time(),
+                                group=self.group)
         return len(msgs)
 
     def retire(self, car: str) -> bool:

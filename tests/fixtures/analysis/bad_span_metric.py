@@ -27,3 +27,12 @@ class Pump:
         ctx.mark("Decode-Stage")                # R6: bad stage name
         with self._lock:
             return 1                            # clean: no span inside
+
+    def step_runaway_label(self, car):
+        # R6: label key outside the closed vocabulary — a per-entity
+        # label (one series per car) is the cardinality explosion the
+        # bound test exists to catch
+        self._h.observe(0.1, car_id=car)
+
+    def step_good_label(self):
+        self._h.observe(0.1, stage="decode")    # clean: closed-set key

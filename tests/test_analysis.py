@@ -72,15 +72,25 @@ def test_lint_r6_naming_and_span_under_lock():
     call-graph walk (reused from R4)."""
     path = os.path.join(FIXTURES, "bad_span_metric.py")
     findings = lint_file(path)
-    assert [f.rule for f in findings] == ["R6"] * 4
-    assert [f.line for f in findings] == [12, 20, 24, 27]
+    assert [f.rule for f in findings] == ["R6"] * 5
+    assert [f.line for f in findings] == [12, 20, 24, 27, 35]
     msgs = [f.message for f in findings]
     assert "iotml-Records.Total" in msgs[0]          # malformed family name
     assert "while holding _lock" in msgs[1]          # direct mark under lock
     assert "_note()" in msgs[2]                      # transitive chain named
     assert "Decode-Stage" in msgs[3]                 # malformed stage name
-    # clean shapes stay clean: a conforming iotml_ name and a mark with
-    # no lock held produced no findings (exactly the 4 above)
+    assert "car_id" in msgs[4]                       # runaway label key
+    assert "vocabulary" in msgs[4]
+    # the lint mirror and the runtime bound test must enforce ONE
+    # vocabulary — a key added to either set alone silently forks the
+    # closed label discipline
+    from iotml.analysis.lint import _ALLOWED_METRIC_LABELS
+    from iotml.obs.metrics import ALLOWED_LABEL_KEYS
+
+    assert _ALLOWED_METRIC_LABELS == ALLOWED_LABEL_KEYS
+    # clean shapes stay clean: a conforming iotml_ name, a mark with no
+    # lock held, and a closed-vocabulary label produced no findings
+    # (exactly the 5 above)
 
 
 def test_lint_r7_chaos_allowlist_and_shim_discipline(tmp_path):
