@@ -296,6 +296,97 @@ def bench_store_log():
                 n_passes=len(walls))
 
 
+def bench_replication():
+    """Quorum replication costs (ISSUE 14): acks=all vs acks=1 produce
+    throughput through a live leader + 2 ISR followers (background
+    sync threads — the ack latency floor is the followers' fetch
+    cadence), and reassignment catch-up MB/s: a brand-new replica
+    bootstrapping a pre-filled durable leader's segment log over
+    zero-copy RAW_FETCH mirroring until it joins the ISR."""
+    import shutil
+    import tempfile
+
+    from iotml.replication import ReplicaSet
+    from iotml.stream.broker import Broker
+    from iotml.stream.kafka_wire import KafkaWireBroker, KafkaWireServer
+
+    n_records = int(os.environ.get("IOTML_BENCH_REPL_RECORDS", "20000"))
+    batch = 500
+    value = b"x" * 256
+
+    def produce_leg(acks):
+        leader = Broker()
+        leader.create_topic("bench-repl", partitions=1)
+        srv = KafkaWireServer(leader).start()
+        rs = ReplicaSet(leader_broker=leader, leader_server=srv,
+                        n_followers=2, min_isr=2, max_lag_s=2.0,
+                        topics=["bench-repl"],
+                        poll_interval_s=0.001).start(sync="thread")
+        client = KafkaWireBroker(f"127.0.0.1:{srv.port}")
+        try:
+            assert rs.await_isr(3, "bench-repl", timeout_s=15)
+            entries = [(None, value, 0)] * batch
+            t0 = time.perf_counter()
+            for _ in range(n_records // batch):
+                client.produce_many("bench-repl", entries, partition=0,
+                                    acks=acks, timeout_ms=30_000)
+            return n_records / (time.perf_counter() - t0)
+        finally:
+            client.close()
+            rs.stop()
+            srv.shutdown()
+            srv.server_close()
+
+    acks1 = max(produce_leg(1) for _ in range(3))
+    acks_all = max(produce_leg(-1) for _ in range(3))
+
+    # catch-up: a fresh replica mirrors a pre-filled DURABLE leader
+    d = tempfile.mkdtemp(prefix="iotml_bench_repl_")
+    try:
+        leader = Broker(store_dir=os.path.join(d, "leader"))
+        leader.create_topic("bench-repl", partitions=1)
+        # bounded produce batches (the RawBatchProducer shape): the
+        # sparse index gets batch-granular entries, so one giant fused
+        # append would force the mirror's alignment fallback — real
+        # ingest never writes 2.9 MB in one append
+        for _ in range(n_records // batch):
+            leader.produce_many("bench-repl", [(None, value, 0)] * batch,
+                                partition=0)
+        leader.flush()
+        mb = n_records * len(value) / 1e6
+        srv = KafkaWireServer(leader).start()
+        rs = ReplicaSet(leader_broker=leader, leader_server=srv,
+                        n_followers=0, min_isr=1, max_lag_s=2.0,
+                        topics=["bench-repl"], poll_interval_s=0.001)
+        try:
+            t0 = time.perf_counter()
+            rid = rs.add_follower(sync="thread")
+            deadline = time.monotonic() + 120
+            while rid not in rs.state.isr_follower_ids():
+                if time.monotonic() > deadline:
+                    raise RuntimeError("catch-up never joined the ISR")
+                time.sleep(0.002)
+            catch_up_s = time.perf_counter() - t0
+            raw = rs.followers[rid].raw_mirrored
+        finally:
+            rs.stop()
+            srv.shutdown()
+            srv.server_close()
+            leader.close()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+    return dict(value=acks_all,
+                acks1_records_per_sec=round(acks1, 1),
+                acks_all_overhead_pct=round(
+                    (acks1 - acks_all) / acks1 * 100.0, 1),
+                catchup_mb_per_sec=round(mb / catch_up_s, 2),
+                catchup_s=round(catch_up_s, 3),
+                catchup_raw_mirrored=raw,
+                n_records=n_records, batch=batch,
+                payload_bytes=len(value))
+
+
 def bench_pipeline():
     """Zero-copy columnar data plane (ISSUE 10): the consume path's
     decode rate through its three legs over the SAME durable topic —
@@ -3070,6 +3161,12 @@ def main():
         # passes and the incremental-throughput guard.  No reference
         # twin (its README disclaims online learning), vs_baseline 0
         ("online_adapt_records", "records", None),
+        # quorum replication (iotml.replication): acks=all throughput
+        # vs acks=1 through a live leader + 2 ISR followers, and the
+        # reassignment catch-up rate over zero-copy RAW_FETCH — the
+        # reference ran RF 3 on managed Kafka (no published overhead
+        # numbers), so vs_baseline deliberately 0
+        ("replication_acks_all_records_per_sec", "records/s", None),
         # the partitioned data plane's saturation knee at 3 brokers
         # (separate processes), vs the r05 single-LEADER platform knee
         # it exists to move; on >=8-core hosts scaling_x also shows the
@@ -3122,6 +3219,11 @@ def main():
         run("twin_apply_records_per_sec", bench_twin)
         run("train_ckpt_async_records_per_sec", bench_checkpoint)
         run("online_adapt_records", bench_online)
+        try:
+            run("replication_acks_all_records_per_sec",
+                bench_replication)
+        except Exception as e:
+            print(f"# replication skipped: {e}", file=sys.stderr)
         try:
             run("cluster_saturation_records_per_sec",
                 bench_cluster_saturation)

@@ -23,6 +23,8 @@ JAX_PLATFORMS=cpu python -m iotml.chaos run --scenario compaction-under-crash \
   --seed 7 --records 500
 JAX_PLATFORMS=cpu python -m iotml.chaos run --scenario drift-storm \
   --seed 7 --records 2000
+JAX_PLATFORMS=cpu python -m iotml.chaos run --scenario double-fault \
+  --seed 7 --records 500
 
 echo "== 2/5 supervised restart: live scorer-crash drill (the scorer"
 echo "        thread dies twice; the supervisor must heal the pipeline)"

@@ -69,6 +69,16 @@ Rules (see ARCHITECTURE.md §analysis for the full table):
       ``iotml/store/``-internal — everyone else triggers compaction
       through ``Broker.run_compaction`` so the swap protocol, the
       broker lock and the crash-safety story live in exactly one place.
+  R15 ISR / quorum-HWM mutation discipline (R9/R11/R12's story for
+      replicated durability): the in-sync-replica set and the quorum
+      high-water mark are mutated ONLY inside ``iotml/replication/``
+      (``register_follower`` / ``unregister_follower`` /
+      ``evict_stale``), and the two wire-ingress calls —
+      ``observe_fetch`` (follower positions entering the ISR) and
+      ``wait_replicated`` (the acks=all quorum wait) — may additionally
+      appear in ``stream/kafka_wire.py``, where the protocol lands.
+      A foreign mutation would let acks=all ack records a failover can
+      lose (the exact loss the quorum exists to rule out).
 
 Suppression: append ``# lint-ok: RN <reason>`` to the flagged line (for
 R4, to the ``with`` line holding the lock).  A suppression WITHOUT a
@@ -126,6 +136,7 @@ CHAOS_HARNESS_MODULES = frozenset({
     ("mlops", "drill.py"), ("mlops", "__main__.py"),
     ("twin", "drill.py"), ("twin", "__main__.py"),
     ("online", "drill.py"), ("online", "__main__.py"),
+    ("replication", "drill.py"), ("replication", "__main__.py"),
 })
 
 # R6 (naming): metric families and span/stage names are lowercase
@@ -190,6 +201,12 @@ RULES: Dict[str, str] = {
            "the registry (versioning, rollback gate, swap metrics) — "
            "a direct weight poke is an unversioned deploy nothing can "
            "roll back",
+    "R15": "ISR-set / quorum-HWM mutation (register_follower / "
+           "unregister_follower / evict_stale) outside "
+           "iotml/replication/, or the wire-ingress calls "
+           "(observe_fetch / wait_replicated) outside "
+           "iotml/replication/ + stream/kafka_wire.py: a foreign "
+           "mutation lets acks=all ack records a failover can lose",
     "R14": "frame parsing OR encoding (the [len|crc|attrs|offset|ts|"
            "key|value|headers] layout: scan_records / iter_frames / "
            "decode_record / encode_record, the >IBqqi head struct, or "
@@ -225,6 +242,15 @@ _STRUCT_CALLS = frozenset({"Struct", "pack", "unpack", "unpack_from",
 _TWIN_CHANGELOG_TOPICS = frozenset({"CAR_TWIN"})
 _COMPACT_WRITE_CALLS = frozenset({"compact_log", "sweep_cleaned"})
 _CLEANED_PATH_RE = re.compile(r"\.cleaned|CLEANED_SUFFIX")
+
+# R15: the replication state's mutating entry points.  `observe_fetch`
+# is additionally allowed in stream/kafka_wire.py (the wire server is
+# where follower fetch positions enter the system); everything else is
+# iotml/replication/-internal.  Same conservative name-matching as
+# R9/R11/R12 — a false positive justifies itself with a suppression.
+_ISR_MUTATION_CALLS = frozenset({
+    "register_follower", "unregister_follower", "evict_stale"})
+_ISR_INGRESS_CALLS = frozenset({"observe_fetch", "wait_replicated"})
 
 # R10: the cluster-internal collections whose per-instance subscripting
 # outside the package bypasses PartitionMap routing (and with it the
@@ -522,6 +548,13 @@ class _FileLinter(ast.NodeVisitor):
             == ("stream", "native.py"))
         # R11 scoping: the mlops package owns registry bytes
         self.in_mlops = "mlops" in parts
+        # R15 scoping: the replication package owns the ISR set and
+        # the quorum HWM; the wire server holds the ONE ingress where
+        # follower fetch positions are observed
+        self.in_replication = "replication" in parts
+        self.r15_ingress = self.in_replication or (
+            len(parts) >= 2 and (parts[-2], parts[-1])
+            == ("stream", "kafka_wire.py"))
         # R12 scoping: the twin package owns the CAR_TWIN changelog
         self.in_twin = "twin" in parts
         # R13 scoping: the registry machinery (mlops watchers/rollouts)
@@ -870,6 +903,26 @@ class _FileLinter(ast.NodeVisitor):
                        "encoding/decoding goes through the bound "
                        "NativeCodec/FrameDecoder or ops.framing "
                        "helpers")
+
+        # R15 — ISR / quorum-HWM mutation discipline: membership and
+        # the quorum mark have one owner (iotml/replication/), plus the
+        # wire server's observe_fetch ingress.  A drive-by eviction or
+        # admission would silently change what acks=all means.
+        if not self.in_replication and name in _ISR_MUTATION_CALLS \
+                and isinstance(node.func, ast.Attribute):
+            self._emit("R15", node,
+                       f"{name}() outside iotml/replication/: the ISR "
+                       "set and the quorum HWM are mutated in one "
+                       "place — acks=all durability is only as strong "
+                       "as the narrowest mutation path")
+        if not self.r15_ingress and name in _ISR_INGRESS_CALLS \
+                and isinstance(node.func, ast.Attribute):
+            self._emit("R15", node,
+                       f"{name}() outside iotml/replication/ + "
+                       "stream/kafka_wire.py: follower positions and "
+                       "quorum waits enter through the wire server's "
+                       "handlers only — a second ingress could admit "
+                       "a replica that never fetched")
 
         # R13 — model updates go through the registry: an in-place
         # .set_params(...) on a serving scorer outside the mlops/online

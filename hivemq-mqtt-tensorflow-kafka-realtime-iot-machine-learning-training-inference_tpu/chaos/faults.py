@@ -100,6 +100,10 @@ RUNNER_POINTS: Dict[str, str] = {
                                 "partitioned cluster -> its follower is "
                                 "promoted at a bumped epoch; one map "
                                 "entry moves, the rest keep serving",
+    "runner.kill_follower": "abrupt ISR follower death under acks=all "
+                            "load -> the ISR evicts it within the "
+                            "staleness window and the quorum re-forms "
+                            "without it",
 }
 
 #: actions each site actually interprets — validated at engine build so
@@ -123,6 +127,7 @@ POINT_ACTIONS: Dict[str, frozenset] = {
     "runner.crash_broker": frozenset({"crash_broker"}),
     "runner.kill_member": frozenset({"kill_member"}),
     "runner.kill_shard_leader": frozenset({"kill_shard_leader"}),
+    "runner.kill_follower": frozenset({"kill_follower"}),
 }
 
 _EXCEPTIONS = {"ConnectionError": ConnectionError, "OSError": OSError,

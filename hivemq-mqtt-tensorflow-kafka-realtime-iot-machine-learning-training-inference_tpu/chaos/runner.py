@@ -221,6 +221,8 @@ class ChaosRunner:
                 report = self._run_store(eng, span_path)
             elif self.schedule.topology == "cluster":
                 report = self._run_cluster(eng)
+            elif self.schedule.topology == "replication":
+                report = self._run_replication(eng)
             elif self.schedule.topology == "mlops":
                 report = self._run_mlops(eng)
             elif self.schedule.topology == "online":
@@ -1306,6 +1308,275 @@ class ChaosRunner:
             scenario=self.schedule.name, seed=self.schedule.seed,
             records=self.schedule.records, topology="cluster",
             published=published, scored=total_scored, rewinds=rewinds,
+            dropped_accounted=eng.dropped_count,
+            injected=dict(sorted(eng.injected.items())),
+            invariants=invariants, span_path=None)
+
+    # ------------------------------------------------------ replication
+    def _run_replication(self, eng: faults.ChaosEngine) -> ChaosReport:
+        """Double-fault under sustained acks=all load (ISSUE 14).
+
+        A leader + two ISR-tracked followers (quorum min_isr=2) serve a
+        2-partition topic; every produce is acks=all (the classic wire
+        client default against a quorum broker), issued from a worker
+        thread while this thread steps the followers' sync rounds —
+        the quorum wait resolves deterministically against stepped
+        replication.  Mid-epoch one FOLLOWER dies abruptly (ISR evicts
+        it within the staleness window; the quorum re-forms at width
+        2), then the LEADER dies with NO pre-kill drain.  The runner
+        promotes an ISR member at epoch+1 — election is ISR-restricted
+        — heals the set with a brand-new follower bootstrapped from the
+        promoted leader, and finishes the stream.
+
+        The proof: ZERO acked-record loss, byte-identically — every
+        (partition, offset, value) acked before the leader death reads
+        back identical from the promoted log (acked ⇒ below the quorum
+        HWM ⇒ on every ISR member); the consumer (bounded by the quorum
+        HWM, so it can never observe a record a failover could
+        un-write) scores the final log exactly once; commits stay
+        monotonic across the promotion; and the new leader provably sat
+        in the ISR at the kill."""
+        import threading
+        import time as _time
+
+        from ..replication import ReplicaSet
+        from ..stream.broker import Broker
+        from ..stream.consumer import StreamConsumer
+        from ..stream.kafka_wire import KafkaWireBroker, KafkaWireServer
+        from ..supervise.registry import register_thread
+
+        parts = 2
+        leader = Broker()
+        leader.create_topic(IN_TOPIC, partitions=parts)
+        commit_log: List[tuple] = []
+        _record_commits(leader, commit_log, "leader")
+        lsrv = KafkaWireServer(leader).start()
+        rs = ReplicaSet(leader_broker=leader, leader_server=lsrv,
+                        n_followers=2, min_isr=2, max_lag_s=0.25,
+                        topics=[IN_TOPIC], groups=(GROUP,))
+        for rid, rep in rs.followers.items():
+            _record_commits(rep.local, commit_log, f"follower-{rid}")
+        rs.start(sync="manual")  # stepped: determinism over realism
+        bootstrap = ",".join(
+            [f"127.0.0.1:{lsrv.port}"]
+            + [f"127.0.0.1:{rep.port}" for rep in rs.followers.values()])
+        producer = KafkaWireBroker(bootstrap, client_id="chaos-repl-prod")
+        consumer_client = KafkaWireBroker(bootstrap,
+                                          client_id="chaos-repl-scorer")
+        consumer = StreamConsumer(
+            consumer_client, [f"{IN_TOPIC}:{p}:0" for p in range(parts)],
+            group=GROUP)
+
+        published = rewinds = 0
+        acked: Dict[Tuple[int, int], bytes] = {}   # (part, offset) -> value
+        consumed: List[Tuple[int, int, bytes]] = []
+        killed_follower: Optional[int] = None
+        killed_leader = False
+        isr_at_kill: List[int] = []
+        promoted_rid: Optional[int] = None
+        healed_rid: Optional[int] = None
+
+        # ISR formation before load: acks=all refuses below min_isr by
+        # contract, and the drill is about LOSING quorum, not forming it
+        deadline = _time.monotonic() + 10.0
+        while _time.monotonic() < deadline and not all(
+                rs.state.isr_size(IN_TOPIC, p) == 3 for p in range(parts)):
+            rs.sync_once()
+
+        def produce_tick(tick: int) -> int:
+            """One tick of acks=all load: the produce blocks in the wire
+            server until the quorum HWM covers it, so it runs on a
+            worker thread while THIS thread steps replication."""
+            nonlocal published
+            n = 0
+            for p in range(parts):
+                values = [f"t{tick}r{i}p{p}".encode()
+                          for i in range(CARS_PER_TICK // parts)]
+                result: dict = {}
+
+                def attempt_produce(res=result, _p=p, _vals=values):
+                    try:
+                        res["last"] = producer.produce_many(
+                            IN_TOPIC,
+                            [(None, v, 0) for v in _vals],
+                            partition=_p, timeout_ms=8000)
+                    except Exception as e:  # noqa: BLE001 - verdict data
+                        res["err"] = e
+
+                for attempt in range(12):  # redelivery (caller-owns)
+                    result.clear()
+                    t = register_thread(threading.Thread(
+                        target=attempt_produce, daemon=True,
+                        name="iotml-chaos-repl-producer"))
+                    t.start()
+                    while t.is_alive():
+                        rs.sync_once()
+                        _time.sleep(0.002)
+                    t.join(1.0)
+                    if "last" in result:
+                        last = result["last"]
+                        for i, v in enumerate(values):
+                            acked[(p, last - len(values) + 1 + i)] = v
+                        n += len(values)
+                        break
+                    err = result.get("err")
+                    if err is not None and not isinstance(
+                            err, ConnectionError):
+                        raise err
+                    # ConnectionError family (incl. NotEnoughReplicas /
+                    # ProduceTimedOut): step replication and redeliver
+                    for _ in range(5):
+                        rs.sync_once()
+                    _time.sleep(0.05)
+                else:
+                    # NEVER give up silently: a dropped batch would
+                    # weaken the drill while the invariants pass
+                    # vacuously — the schedule promised this load
+                    raise RuntimeError(
+                        f"acks=all batch for partition {p} undeliverable "
+                        f"after 12 redelivery attempts: {result.get('err')}")
+            published += n
+            return n
+
+        def drain() -> int:
+            nonlocal rewinds
+            try:
+                batch = consumer.poll(4096)
+            except ConnectionError:
+                consumer.rewind_to_committed()
+                rewinds += 1
+                return 0
+            for m in batch:
+                consumed.append((m.partition, m.offset, m.value))
+            if batch:
+                consumer.commit()
+            return len(batch)
+
+        def run_due_events():
+            nonlocal killed_follower, killed_leader, promoted_rid, \
+                healed_rid, isr_at_kill
+            for ev in eng.due_runner_events(published):
+                if ev.action == "kill_follower" and \
+                        killed_follower is None:
+                    killed_follower = sorted(rs.followers)[0]
+                    rs.kill_follower(killed_follower)
+                    eng.note_runner_fired(ev)
+                elif ev.action == "kill_leader" and not killed_leader:
+                    # retire the dead follower BEFORE electing: if both
+                    # kills land in one event batch, no staleness
+                    # window has elapsed and the corpse would still sit
+                    # in the ISR — the election must never pick it
+                    if killed_follower is not None:
+                        rs.retire_follower(killed_follower)
+                    # NO pre-kill drain: the un-acked tail may die with
+                    # the leader — acks=all means the ACKED records
+                    # cannot (they are on every ISR member)
+                    isr_at_kill = sorted(rs.state.isr_follower_ids())
+                    lsrv.kill()
+                    killed_leader = True
+                    promoted_rid, _addr = rs.promote(epoch=1)
+                    # elastic heal: a fresh follower bootstraps the
+                    # whole log from the promoted leader over RAW_FETCH
+                    # and re-forms the 2-wide quorum so acks=all resumes
+                    healed_rid = rs.add_follower(sync="manual")
+                    _record_commits(rs.followers[healed_rid].local,
+                                    commit_log, "healed")
+                    deadline = _time.monotonic() + 10.0
+                    while _time.monotonic() < deadline and \
+                            healed_rid not in rs.state.isr_follower_ids():
+                        rs.sync_once()
+                    eng.note_runner_fired(ev)
+
+        ticks = max(1, -(-self.schedule.records // CARS_PER_TICK))
+        try:
+            for tick in range(ticks):
+                run_due_events()
+                produce_tick(tick)
+                rs.sync_once()
+                drain()
+            run_due_events()
+            # final drain to the quorum frontier (== log end once the
+            # healed follower is in sync)
+            for _ in range(200):
+                rs.sync_once()
+                if drain() == 0 and consumer.at_end():
+                    break
+        finally:
+            for client in (producer, consumer_client):
+                try:
+                    client.close()
+                except OSError:
+                    pass
+            rs.stop()
+            if not killed_leader:
+                lsrv.kill()
+
+        live = rs.leader  # the promoted broker serves the end state
+        # zero acked loss, byte-identical: every acked (p, off) -> value
+        # reads back identical from the promoted log
+        lost = []
+        mismatched = []
+        for (p, off), value in sorted(acked.items()):
+            got = {m.offset: m.value
+                   for m in live.fetch_tail(IN_TOPIC, p, off, 1)}
+            if off not in got:
+                lost.append((p, off))
+            elif got[off] != value:
+                mismatched.append((p, off))
+        # consumer exact-once over the final log
+        expected = set()
+        for p in range(parts):
+            expected.update((p, o)
+                            for o in range(live.end_offset(IN_TOPIC, p)))
+        seen = [(p, o) for p, o, _v in consumed]
+        dupes = len(seen) - len(set(seen))
+        missing = expected - set(seen)
+        invariants = [
+            _check_commits_monotonic(commit_log),
+            Invariant(
+                "zero_acked_loss",
+                killed_leader and not lost and not mismatched,
+                (f"all {len(acked)} acked records present "
+                 f"byte-identically at identical offsets after the "
+                 f"double fault" if killed_leader and not lost
+                 and not mismatched else
+                 "leader was never killed" if not killed_leader else
+                 f"{len(lost)} ACKED RECORDS LOST "
+                 f"(e.g. {lost[:3]}), {len(mismatched)} mismatched")),
+            Invariant(
+                "new_leader_in_isr",
+                promoted_rid is not None and promoted_rid in isr_at_kill,
+                f"promoted replica {promoted_rid} was in the ISR "
+                f"{isr_at_kill} at the kill" if promoted_rid is not None
+                else "no promotion happened"),
+            Invariant(
+                "double_fault_injected",
+                killed_follower is not None and killed_leader,
+                f"follower {killed_follower} and the leader both died"
+                if killed_follower is not None and killed_leader else
+                "both faults must fire"),
+            Invariant(
+                "consumer_exact_once",
+                not missing and dupes == 0,
+                f"{len(seen)} consumed rows cover all "
+                f"{len(expected)} log records exactly once"
+                if not missing and dupes == 0 else
+                f"{len(missing)} never consumed, {dupes} duplicated"),
+            Invariant(
+                "quorum_healed",
+                healed_rid is not None and
+                healed_rid in rs.state.isr_follower_ids(),
+                f"replica {healed_rid} bootstrapped from the promoted "
+                f"leader and re-joined the ISR (raw-mirrored "
+                f"{rs.followers[healed_rid].raw_mirrored} records)"
+                if healed_rid is not None and
+                healed_rid in rs.state.isr_follower_ids() else
+                "the elastic heal never completed"),
+        ]
+        return ChaosReport(
+            scenario=self.schedule.name, seed=self.schedule.seed,
+            records=self.schedule.records, topology="replication",
+            published=published, scored=len(consumed), rewinds=rewinds,
             dropped_accounted=eng.dropped_count,
             injected=dict(sorted(eng.injected.items())),
             invariants=invariants, span_path=None)

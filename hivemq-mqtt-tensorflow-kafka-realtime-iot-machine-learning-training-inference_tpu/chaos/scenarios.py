@@ -281,6 +281,33 @@ def _drift_storm(rng: random.Random, records: int) -> list:
     return events
 
 
+def _double_fault(rng: random.Random, records: int) -> list:
+    # the quorum-durability drill (ISSUE 14): under sustained acks=all
+    # load against a leader + two ISR followers, ONE FOLLOWER dies
+    # abruptly (the ISR must evict it within the staleness window and
+    # the quorum re-form at width 2), then the LEADER dies mid-epoch
+    # with no pre-kill drain — the runner promotes an ISR member at
+    # epoch+1 and proves ZERO acked-record loss byte-identically: every
+    # produce acked before the kill sits below the quorum HWM, so the
+    # surviving ISR member holds it at the identical offset.  A new
+    # follower then bootstraps from the promoted leader (the elastic
+    # heal) so acks=all resumes for the rest of the stream.  Wire recv
+    # delays ride along so failover retries run under an unquiet clock.
+    lo, hi = max(1, records // 3), max(2, (2 * records) // 3)
+    mid = (lo + hi) // 2
+    events = [
+        FaultEvent(rng.randint(lo, max(lo + 1, mid)),
+                   "runner.kill_follower", "kill_follower"),
+        FaultEvent(rng.randint(mid + 1, max(mid + 2, hi)),
+                   "runner.kill_leader", "kill_leader"),
+    ]
+    for _ in range(3):
+        events.append(FaultEvent(rng.randint(1, max(2, records // 20)),
+                                 "kafka_wire.recv", "delay",
+                                 params=(("seconds", 0.001),)))
+    return events
+
+
 def _loss_bug_fixture(rng: random.Random, records: int) -> list:
     # the seeded bug: one delivery silently lost — NOT ledgered, so the
     # scored-or-accounted invariant must fail (the checker's own test)
@@ -344,6 +371,12 @@ SCENARIOS: Dict[str, Tuple[Callable, str, str]] = {
         "a degraded candidate model is deployed to serving; the A/B "
         "quality gate must detect the regression live and roll serving "
         "back to the baseline within the drill budget"),
+    "double-fault": (
+        _double_fault, "replication",
+        "leader + one follower die mid-epoch under sustained acks=all "
+        "load: ISR evicts the dead follower, an ISR member is promoted "
+        "at epoch+1 with ZERO acked-record loss (byte-identical "
+        "offsets), a new follower heals the set and acks=all resumes"),
     "drift-storm": (
         _drift_storm, "online",
         "seeded regional drift + flapping links concurrently: the "

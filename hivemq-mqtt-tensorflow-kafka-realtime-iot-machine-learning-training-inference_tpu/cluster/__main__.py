@@ -28,6 +28,8 @@ def cmd_up(args) -> int:
         brokers=args.brokers, host=args.host,
         store_root=args.store_root,
         replicated=args.replicated,
+        replication_factor=args.replication_factor,
+        min_isr=args.min_isr,
         base_port=args.base_port,
         advertise_host=args.advertise_host,
         mirror_groups=tuple(args.mirror_groups.split(","))
@@ -41,7 +43,7 @@ def cmd_up(args) -> int:
 
         start_http_server(args.metrics_port)
     sup = None
-    if args.replicated:
+    if args.replicated or args.replication_factor:
         sup = ctl.supervised().start()
     if not args.quiet:
         print("iotml cluster up:")
@@ -65,6 +67,29 @@ def cmd_up(args) -> int:
         if not args.quiet:
             print("stopped.")
     return 0
+
+
+def cmd_admin(args) -> int:
+    """add-broker / drain-broker / status against a LIVE cluster: the
+    CLUSTER_ADMIN wire extension reaches the controller inside the `up`
+    process, which runs the online reassignment (new replica bootstraps
+    over zero-copy RAW_FETCH, joins the ISR, leadership moves through
+    the Topology cell, the old replica retires) and reports back."""
+    from ..stream.kafka_wire import KafkaWireBroker
+
+    client = KafkaWireBroker(args.bootstrap,
+                             client_id="iotml-cluster-admin")
+    try:
+        payload = {}
+        if args.cmd in ("add-broker", "drain-broker"):
+            payload["shard"] = args.shard
+        if getattr(args, "store_dir", None):
+            payload["store_dir"] = args.store_dir
+        doc = client.cluster_admin(args.cmd, payload)
+    finally:
+        client.close()
+    print(json.dumps(doc, indent=2, default=str))
+    return 0 if doc.get("state") in (None, "moved", "retired") else 1
 
 
 def cmd_drill(args) -> int:
@@ -103,6 +128,14 @@ def main(argv=None) -> int:
     up.add_argument("--replicated", action="store_true",
                     help="one follower per shard + supervised "
                          "per-shard failover")
+    up.add_argument("--replication-factor", type=int, default=None,
+                    help="quorum mode (iotml.replication): RF-1 "
+                         "ISR-tracked followers per shard, acks=all at "
+                         "the quorum HWM, ISR-restricted failover, and "
+                         "the add-broker/drain-broker admin verbs")
+    up.add_argument("--min-isr", type=int, default=2,
+                    help="min.insync.replicas for acks=all (quorum "
+                         "mode)")
     up.add_argument("--mirror-groups", default="iotml",
                     help="comma list of groups whose offsets followers "
                          "mirror")
@@ -134,6 +167,27 @@ def main(argv=None) -> int:
     drill.add_argument("--seed", type=int, default=7)
     drill.add_argument("--records", type=int, default=2000)
     drill.set_defaults(fn=cmd_drill)
+
+    for verb, help_ in (("add-broker",
+                         "online reassignment: a NEW broker node takes "
+                         "over --shard (bootstrap over RAW_FETCH, ISR "
+                         "join, leadership move, old replica retires)"),
+                        ("drain-broker",
+                         "move --shard's leadership onto an existing "
+                         "ISR follower and retire the drained leader"),
+                        ("status",
+                         "cluster + reassignment status (quorum mode)")):
+        p = sub.add_parser(verb, help=help_)
+        p.add_argument("--bootstrap", required=True,
+                       help="any live broker address (host:port[,...])")
+        if verb != "status":
+            p.add_argument("--shard", type=int, required=True)
+        if verb == "add-broker":
+            p.add_argument("--store-dir", default=None,
+                           help="the new node's store dir (durable "
+                                "clusters; default: auto under the "
+                                "cluster store root)")
+        p.set_defaults(fn=cmd_admin, cmd=verb)
 
     args = ap.parse_args(argv)
     knob_names = ("prefetch_depth", "decode_ring_buffers",

@@ -230,11 +230,15 @@ class ClusterClient:
         return self.produce_many(topic, [(key, v, 0) for v in values],
                                  partition=partition)
 
-    def produce_many(self, topic: str, entries, partition=None) -> int:
+    def produce_many(self, topic: str, entries, partition=None,
+                     acks: Optional[int] = None,
+                     timeout_ms: int = 10_000) -> int:
         """Route each record to its partition's owning shard.  ONE wire
         request per partition — never a multi-partition request, so a
         NOT_LEADER bounce is all-or-nothing for its entries and the
-        re-route after a refresh cannot double-append the rest."""
+        re-route after a refresh cannot double-append the rest.
+        ``acks``/``timeout_ms`` forward to the wire client (quorum
+        semantics on replicated shards — see KafkaWireBroker)."""
         by_part: Dict[int, list] = {}
         for entry in entries:
             key = entry[0]
@@ -245,14 +249,16 @@ class ClusterClient:
         for p, ents in sorted(by_part.items()):
             off = self._routed(
                 topic, p,
-                lambda c, _p=p, _e=ents: c.produce_many(topic, _e,
-                                                        partition=_p),
+                lambda c, _p=p, _e=ents: c.produce_many(
+                    topic, _e, partition=_p, acks=acks,
+                    timeout_ms=timeout_ms),
                 retry_connection=False)
             last = max(last, off)
         return last
 
     def produce_raw(self, topic: str, partition: int,
-                    frames: bytes) -> int:
+                    frames: bytes, acks: Optional[int] = None,
+                    timeout_ms: int = 10_000) -> int:
         """Route a pre-framed RAW_PRODUCE batch to the partition's
         owning shard (one request, all-or-nothing — a NOT_LEADER bounce
         re-routes with nothing appended).  NotImplementedError from an
@@ -263,7 +269,8 @@ class ClusterClient:
             if pr is None:
                 raise NotImplementedError(
                     "owning broker lacks raw-batch produce")
-            return pr(topic, partition, frames)
+            return pr(topic, partition, frames, acks=acks,
+                      timeout_ms=timeout_ms)
 
         return self._routed(topic, partition, op, retry_connection=False)
 

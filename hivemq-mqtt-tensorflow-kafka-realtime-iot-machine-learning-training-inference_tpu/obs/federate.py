@@ -310,6 +310,9 @@ class FleetCollector:
         lag: Dict[tuple, float] = {}       # (group, topic) → records
         replica_worst: Dict[str, float] = {}   # topic → records
         wm_worst: Dict[str, float] = {}        # stage → newest event ms
+        isr_worst: Dict[str, float] = {}       # topic → min |ISR|
+        qlag_worst: Dict[str, float] = {}      # topic → max hwm lag
+        under_replicated = 0.0                 # fleet-wide sum
         for s in snapshots.values():
             for mname, labels, value in s["samples"]:
                 if mname in self.SUM_FAMILIES:
@@ -322,6 +325,21 @@ class FleetCollector:
                     t = labels.get("topic", "")
                     replica_worst[t] = max(replica_worst.get(t, 0.0),
                                            value)
+                elif mname == "iotml_isr_size":
+                    # worst-of = the NARROWEST ISR across partitions
+                    # and processes: the fleet's durability margin is
+                    # its most under-replicated partition's
+                    t = labels.get("topic", "")
+                    cur = isr_worst.get(t)
+                    isr_worst[t] = value if cur is None \
+                        else min(cur, value)
+                elif mname == "iotml_quorum_hwm_lag_records":
+                    t = labels.get("topic", "")
+                    qlag_worst[t] = max(qlag_worst.get(t, 0.0), value)
+                elif mname == "iotml_under_replicated_partitions":
+                    # each leader process reports its own partitions:
+                    # the fleet total is the sum
+                    under_replicated += value
                 elif mname == "iotml_watermark_event_time_ms":
                     st = labels.get("stage", "")
                     # worst-of = the OLDEST frontier across processes:
@@ -346,6 +364,22 @@ class FleetCollector:
                 lines.append(
                     "iotml_cluster_replica_lag_worst_records"
                     f"{_fmt({'topic': t})} {replica_worst[t]}")
+        if isr_worst:
+            lines.append("# TYPE iotml_cluster_isr_size_worst gauge")
+            for t in sorted(isr_worst):
+                lines.append("iotml_cluster_isr_size_worst"
+                             f"{_fmt({'topic': t})} {isr_worst[t]}")
+            lines.append(
+                "# TYPE iotml_cluster_under_replicated_partitions gauge")
+            lines.append("iotml_cluster_under_replicated_partitions "
+                         f"{under_replicated}")
+        if qlag_worst:
+            lines.append(
+                "# TYPE iotml_cluster_quorum_hwm_lag_worst_records gauge")
+            for t in sorted(qlag_worst):
+                lines.append(
+                    "iotml_cluster_quorum_hwm_lag_worst_records"
+                    f"{_fmt({'topic': t})} {qlag_worst[t]}")
         if wm_worst:
             now_ms = time.time() * 1000.0  # wallclock-ok: event domain
             lines.append(

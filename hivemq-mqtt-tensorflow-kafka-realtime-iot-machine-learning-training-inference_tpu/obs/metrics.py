@@ -416,6 +416,25 @@ prefetch_occupancy = default_registry.gauge(
     "iotml_prefetch_occupancy",
     "DevicePrefetcher queue fill fraction (0 = device starving on the "
     "host pipeline, 1 = host running ahead)")
+# quorum replication (iotml.replication, ISSUE 14): the in-sync-replica
+# set and the quorum high-water mark as live gauges — |ISR| per
+# partition (leader included), how many covered partitions run below
+# their target replica count, and how far the un-replicated tail
+# (leader end - quorum HWM) currently reaches.  The federation
+# collector rolls these up worst-of across the fleet.
+isr_size = default_registry.gauge(
+    "iotml_isr_size",
+    "in-sync replica count per partition, leader included (acks=all "
+    "commits at min(ISR positions))")
+under_replicated = default_registry.gauge(
+    "iotml_under_replicated_partitions",
+    "replicated partitions whose ISR is below the configured replica "
+    "target (followers evicted for lag/staleness and not re-admitted)")
+quorum_hwm_lag = default_registry.gauge(
+    "iotml_quorum_hwm_lag_records",
+    "records between the leader log end and the quorum high-water mark "
+    "— the tail acks=all producers are still waiting on and consumers "
+    "cannot read yet, by topic/partition")
 
 
 #: the CLOSED label-key vocabulary every iotml metric must draw from.
@@ -515,6 +534,26 @@ def start_http_server(port: int = 9100, registry: Registry = default_registry):
         if lag_vals:
             doc["replica_lag_records"] = {
                 dict(k).get("topic", ""): v for k, v in lag_vals.items()}
+        # quorum replication (ISSUE 14): ISR width per partition, the
+        # under-replicated count, and the un-replicated tail — the
+        # acks=all durability state where probes already look
+        with isr_size._lock:
+            isr_vals = dict(isr_size._vals)
+        if isr_vals:
+            with quorum_hwm_lag._lock:
+                qlag = dict(quorum_hwm_lag._vals)
+            doc["replication"] = {
+                "under_replicated_partitions": int(
+                    under_replicated.value()),
+                "isr": {
+                    (f"{dict(k).get('topic', '')}"
+                     f":{dict(k).get('partition', '')}"): int(v)
+                    for k, v in sorted(isr_vals.items())},
+                "quorum_hwm_lag_records": {
+                    (f"{dict(k).get('topic', '')}"
+                     f":{dict(k).get('partition', '')}"): int(v)
+                    for k, v in sorted(qlag.items())},
+            }
         # event-time watermarks (ISSUE 13): per-stage event-time
         # frontier and its lag vs now — true e2e staleness on the
         # columnar paths where per-record spans cannot exist

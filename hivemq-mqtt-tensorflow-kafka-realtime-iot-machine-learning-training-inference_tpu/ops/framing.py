@@ -302,6 +302,26 @@ def validate_frame_batch(buf: bytes, start_offset: int = 0,
                 contiguous=count == 0 or last - first + 1 == count)
 
 
+def truncate_frame_batch(buf: bytes, max_offset_exclusive: int) -> bytes:
+    """The prefix of a raw frame batch whose record offsets are all
+    below ``max_offset_exclusive`` — the quorum read barrier's cut
+    (iotml.replication): a consumer-facing raw fetch must not ship
+    frames past the quorum high-water mark.  Cuts at a frame boundary
+    by construction; a torn/corrupt frame ends the walk exactly like
+    every other reader of the format."""
+    from ..store import segment as seg
+
+    end_pos = 0
+    try:
+        for _pos, end, off, _k, _v, _ts, _h in seg.scan_records(buf):
+            if off >= max_offset_exclusive:
+                break
+            end_pos = end
+    except ValueError:
+        pass  # corrupt frame: keep the clean prefix below the ceiling
+    return buf[:end_pos]
+
+
 def decode_frames_columnar_py(
         buf: bytes, start_offset: int, schema,
         pinned_id_limit: Optional[int] = None,

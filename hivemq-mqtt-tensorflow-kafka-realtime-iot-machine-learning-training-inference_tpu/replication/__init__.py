@@ -1,0 +1,42 @@
+"""iotml.replication — quorum ISR durability and elastic reassignment.
+
+The reference provisions every topic at replication factor 3 on a
+3-broker cluster (PAPER.md L3, ``01_installConfluentPlatform.sh``
+RF-3 topics); until this package the rebuild ran exactly one fenced
+follower per shard — ``acks=1`` semantics, where acked data is one
+crash away from loss.  This package generalises the PR 4/6
+epoch-fencing machinery into Kafka-shape replicated durability:
+
+- ``ReplicationState`` (`isr.py`): the leader-side in-sync-replica
+  tracker.  Followers stamp a replica id into their FETCH/RAW_FETCH
+  requests; the leader observes each fetch position, admits a follower
+  into the ISR when it reaches the log end, evicts it after the
+  staleness window, and advances a per-partition **quorum high-water
+  mark** at min(ISR positions).  ``acks=all`` produces commit only
+  below that mark, consumer fetches are bounded by it (no reads of the
+  un-replicated tail), and the mark persists across remount through a
+  store-owned checkpoint (`store/hwm.py`).
+- ``ReplicaSet`` (`manager.py`): a leader plus N followers as one
+  managed unit — construction, ISR formation, ISR-restricted leader
+  election at epoch+1, live follower add/retire.
+- ``ShardReassignment`` (`reassign.py`): the online reassignment state
+  machine behind ``python -m iotml.cluster add-broker/drain-broker`` —
+  a new replica bootstraps from the segment log over zero-copy
+  RAW_FETCH, catches up, joins the ISR, leadership moves through the
+  existing Topology cells, and the old replica retires, with zero
+  consumer disruption.
+- Live drills (`drill.py`, ``python -m iotml.replication drill``):
+  double-fault (leader + one follower killed mid-epoch under
+  sustained acks=all load; zero acked-record loss) and
+  reassign-under-load (catch-up SLO, zero consumer disruption).
+
+Lint R15 confines ISR-set and quorum-HWM mutation to this package
+(the wire server's ``observe_fetch`` ingress excepted), mirroring the
+R9/R11/R12 one-writer disciplines.
+"""
+
+from .isr import ReplicationState
+from .manager import ReplicaSet
+from .reassign import ShardReassignment
+
+__all__ = ["ReplicationState", "ReplicaSet", "ShardReassignment"]

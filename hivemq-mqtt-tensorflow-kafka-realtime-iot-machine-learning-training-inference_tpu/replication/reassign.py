@@ -1,0 +1,82 @@
+"""Online shard reassignment — the elastic half of ISSUE 14.
+
+The cluster's partition→shard policy is a pure function (``p % N``),
+so the reassignment unit is the SHARD: moving capacity means moving a
+shard's leadership (and its data) onto a different node, not renaming
+partitions.  That is exactly what ``add-broker`` / ``drain-broker``
+do, as a five-state machine an operator can watch:
+
+    BOOTSTRAPPING   a new replica mirrors the shard's segment log over
+                    zero-copy RAW_FETCH (batches append verbatim —
+                    catch-up runs at the data plane's raw rate, not
+                    per-record Python), OUT of the ISR
+    CATCHING_UP     the mirror is live and lag is shrinking; the
+                    replica earns ISR admission at its first catch-up
+    IN_SYNC         the replica is an ISR member: it now bounds the
+                    quorum HWM, so everything acked from here on is on
+                    the new node too
+    MOVED           leadership moved: the target was promoted at
+                    epoch+1 and the shard's Topology cell republished —
+                    clients re-resolve on their next reconnect/fence,
+                    consumers keep their cursors (offsets are identical
+                    by the mirror contract); remaining followers
+                    re-point through the same cell
+    RETIRED         the old replica retired: the previous leader's
+                    server is dead (it would answer FENCED anyway —
+                    its epoch is stale) and its broker closed
+
+No step disrupts consumers: reads keep flowing from the old leader
+until MOVED, and from the new one after — the only client-visible
+event is one reconnect, which every consumer already treats as a
+failover (rewind-to-committed redelivery, exact-once by offsets).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+#: state-machine vocabulary (ARCHITECTURE §23 diagram)
+BOOTSTRAPPING = "bootstrapping"
+CATCHING_UP = "catching_up"
+IN_SYNC = "in_sync"
+MOVED = "moved"
+RETIRED = "retired"
+FAILED = "failed"
+
+
+@dataclasses.dataclass
+class ShardReassignment:
+    """One shard's move, with the numbers the drill SLOs bind on."""
+
+    shard: int
+    target_rid: Optional[int] = None
+    state: str = BOOTSTRAPPING
+    started_mono: float = dataclasses.field(
+        default_factory=time.monotonic)
+    catch_up_s: Optional[float] = None       # bootstrap -> ISR admission
+    move_s: Optional[float] = None           # bootstrap -> cell publish
+    records_mirrored: int = 0
+    raw_mirrored: int = 0                    # via the zero-copy leg
+    old_leader: str = ""
+    new_leader: str = ""
+    epoch: Optional[int] = None
+    error: str = ""
+
+    def advance(self, state: str) -> None:
+        self.state = state
+        now = time.monotonic()
+        if state == IN_SYNC and self.catch_up_s is None:
+            self.catch_up_s = now - self.started_mono
+        if state == MOVED and self.move_s is None:
+            self.move_s = now - self.started_mono
+
+    def fail(self, error: str) -> None:
+        self.state = FAILED
+        self.error = error
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d.pop("started_mono", None)
+        return d

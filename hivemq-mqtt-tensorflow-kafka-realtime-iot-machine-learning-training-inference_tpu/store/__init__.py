@@ -19,6 +19,7 @@ package (`segment.SegmentWriter` owns the bytes and the fsync ledger).
 """
 
 from .compact import CompactionStats, StoreCompactor
+from .hwm import HwmFile, hwm_file_for
 from .log import SegmentedLog, StorePolicy
 from .mount import StoreMount
 from .offsets import OffsetsFile
@@ -26,4 +27,5 @@ from .segment import SegmentWriter, atomic_write, crc32c, fsync_dir
 
 __all__ = ["SegmentedLog", "StorePolicy", "StoreMount", "OffsetsFile",
            "SegmentWriter", "atomic_write", "crc32c", "fsync_dir",
-           "CompactionStats", "StoreCompactor"]
+           "CompactionStats", "StoreCompactor", "HwmFile",
+           "hwm_file_for"]

@@ -1,0 +1,72 @@
+"""Quorum high-water-mark checkpoint file — HWM persistence across
+remount (iotml.replication's durable half, kept store-side per R9).
+
+Kafka persists each partition's high water mark in a
+``replication-offset-checkpoint`` file so a restarted broker knows how
+far the quorum had committed before the crash.  The rebuild's analog:
+one small JSON document per store dir mapping ``"topic:partition"`` to
+the quorum HWM, written through the store's own ``atomic_write`` (R9:
+every byte under a store dir has one writer package).
+
+Semantics on remount: crash recovery may resurrect records PAST the
+persisted HWM (appended by the old leader, never quorum-acked).  They
+are not truncated — the log keeps them — but the replication layer
+re-anchors its fetch ceiling at the persisted mark, so consumers cannot
+read the un-replicated tail until followers have actually mirrored it
+and the quorum HWM advances past it again.  A torn/corrupt checkpoint
+degrades to "no checkpoint" (the ceiling re-anchors at the log end,
+the pre-replication behavior) rather than refusing to mount.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+from . import segment as seg
+
+_FILENAME = "replication-hwm.json"
+
+
+class HwmFile:
+    """Atomic-rewrite checkpoint of per-partition quorum HWMs.
+
+    Not thread-safe by itself: the one caller is the replication
+    state's persist path, which already serializes stores (and never
+    writes under its tracking lock — file I/O stays off the quorum
+    wait path)."""
+
+    def __init__(self, store_dir: str):
+        self.path = os.path.join(store_dir, _FILENAME)
+
+    def load(self) -> Dict[Tuple[str, int], int]:
+        """{(topic, partition): hwm} from the checkpoint; empty when
+        absent or torn (degrade to no-checkpoint, never refuse)."""
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return {}
+        out: Dict[Tuple[str, int], int] = {}
+        for key, v in doc.get("hwm", {}).items():
+            topic, _, part = key.rpartition(":")
+            try:
+                out[(topic, int(part))] = int(v)
+            except ValueError:
+                continue  # one malformed row never poisons the rest
+        return out
+
+    def store(self, hwms: Dict[Tuple[str, int], int]) -> None:
+        """Persist the full map (tmp + rename + fsync — the same
+        publication discipline as the topic manifest)."""
+        doc = {"hwm": {f"{t}:{p}": int(v)
+                       for (t, p), v in sorted(hwms.items())}}
+        blob = json.dumps(doc, sort_keys=True).encode()
+        seg.atomic_write(self.path, blob)
+
+
+def hwm_file_for(store_dir: Optional[str]) -> Optional[HwmFile]:
+    """The store-dir's HWM checkpoint handle (None for in-memory
+    brokers — nothing survives the process anyway)."""
+    return HwmFile(store_dir) if store_dir else None

@@ -399,6 +399,12 @@ class Broker:
         # serializes whole compaction PASSES (background compactor vs a
         # forced drill pass); the data lock above covers only the swaps
         self._compact_pass_lock = threading.Lock()
+        #: quorum replication state (iotml.replication.ReplicationState)
+        #: when this broker LEADS replicated partitions — consulted by
+        #: fetch/fetch_raw (consumer reads stop at the quorum high-water
+        #: mark) and by the wire server (acks=all waits, follower fetch
+        #: observations).  None = unreplicated, zero-cost.
+        self.replication = None
         self._topics: Dict[str, TopicSpec] = {}
         self._parts: Dict[str, List] = {}
         self._group_offsets: Dict[tuple, int] = {}  # (group, topic, part) → next offset
@@ -811,7 +817,25 @@ class Broker:
         """Read up to max_messages starting at offset (monotone, no
         blocking).  A fetch below the retained base raises
         OffsetOutOfRangeError — trimmed history is an explicit signal,
-        never a silent skip (consumers auto-reset to earliest)."""
+        never a silent skip (consumers auto-reset to earliest).
+
+        On a replicated leader, CONSUMER reads stop at the quorum
+        high-water mark — the un-replicated tail is invisible until
+        every ISR member holds it, so a record a failover could
+        un-write can never have been observed.  Replica mirror fetches
+        use ``fetch_tail`` (they exist to read that tail)."""
+        msgs = self.fetch_tail(topic, partition, offset, max_messages)
+        repl = self.replication
+        if repl is not None and msgs:
+            ceiling = repl.fetch_ceiling(topic, partition)
+            if ceiling is not None and msgs[-1].offset >= ceiling:
+                msgs = [m for m in msgs if m.offset < ceiling]
+        return msgs
+
+    def fetch_tail(self, topic: str, partition: int, offset: int,
+                   max_messages: int = 1024) -> List[Message]:
+        """`fetch` without the quorum read barrier — the replica mirror
+        leg (followers must read past the HWM to advance it)."""
         chaos.point("broker.fetch")  # before the lock: a chaos stall must
         # park this fetcher, never every thread contending the broker
         part = self._parts[topic][partition]
@@ -848,6 +872,31 @@ class Broker:
         slice through the one frame codec.  Returns None at/after the
         log end; raises OffsetOutOfRangeError below the retained base
         (same contract as `fetch`)."""
+        raw = self.fetch_raw_tail(topic, partition, offset, max_bytes)
+        repl = self.replication
+        if raw is not None and repl is not None:
+            ceiling = repl.fetch_ceiling(topic, partition)
+            if ceiling is not None and \
+                    self._parts[topic][partition].end() > ceiling:
+                # the batch may cross the quorum HWM: cut it at the
+                # frame boundary below the ceiling (rare — only while
+                # an un-replicated tail exists)
+                if offset >= ceiling:
+                    return None
+                from ..ops.framing import (RawFrameBatch,
+                                           truncate_frame_batch)
+
+                data = truncate_frame_batch(raw.data, ceiling)
+                if not data:
+                    return None
+                raw = RawFrameBatch(topic, partition, raw.start_offset,
+                                    data)
+        return raw
+
+    def fetch_raw_tail(self, topic: str, partition: int, offset: int,
+                       max_bytes: int = 1 << 20):
+        """`fetch_raw` without the quorum read barrier (the replica's
+        zero-copy mirror leg)."""
         from ..ops.framing import RawFrameBatch
 
         chaos.point("broker.fetch")  # the same faultpoint as fetch: a

@@ -65,6 +65,14 @@ RAW_FETCH = 64
 # without the extension answer UNSUPPORTED_VERSION so producing clients
 # pin back to classic PRODUCE.
 RAW_PRODUCE = 65
+# Emulator-family admin extension (ISSUE 14): elastic reassignment
+# verbs against a live cluster — `python -m iotml.cluster add-broker /
+# drain-broker` connect to any broker's wire port and drive the
+# controller's online reassignment (new replica bootstraps over
+# RAW_FETCH, joins the ISR, leadership moves, the old replica
+# retires).  Served only when the wire server carries an `admin` hook
+# (the ClusterController); everyone else answers UNSUPPORTED_VERSION.
+CLUSTER_ADMIN = 66
 
 # error codes
 ERR_NONE = 0
@@ -72,6 +80,9 @@ ERR_OFFSET_OUT_OF_RANGE = 1
 ERR_CORRUPT_MESSAGE = 2
 ERR_UNKNOWN_TOPIC = 3
 ERR_NOT_LEADER_FOR_PARTITION = 6
+ERR_REQUEST_TIMED_OUT = 7
+ERR_NOT_ENOUGH_REPLICAS = 19
+ERR_INVALID_REQUIRED_ACKS = 21
 ERR_NOT_COORDINATOR = 16
 ERR_ILLEGAL_GENERATION = 22
 ERR_UNKNOWN_MEMBER_ID = 25
@@ -89,7 +100,7 @@ _SUPPORTED = {PRODUCE: (2, 2), FETCH: (2, 2), LIST_OFFSETS: (1, 1),
               HEARTBEAT: (0, 0), LEAVE_GROUP: (0, 0), SYNC_GROUP: (0, 0),
               SASL_HANDSHAKE: (0, 0), API_VERSIONS: (0, 0),
               CREATE_TOPICS: (0, 0), RAW_FETCH: (0, 0),
-              RAW_PRODUCE: (0, 0)}
+              RAW_PRODUCE: (0, 0), CLUSTER_ADMIN: (0, 0)}
 
 # APIs the client may auto-retry after a reconnect (see _request): a
 # duplicate of any of these is invisible (pure reads) or a no-op
@@ -134,6 +145,26 @@ class CoordinatorMovedError(ConnectionError):
     coordinator (Kafka error 16, NOT_COORDINATOR).  The caller
     re-discovers the coordinator via FIND_COORDINATOR and retries —
     cluster group state is pinned to exactly one broker."""
+
+
+class NotEnoughReplicasError(ConnectionError):
+    """An ``acks=all`` produce was refused because the in-sync-replica
+    set is below ``min_isr`` — or the topic has no ISR configured at
+    all on a quorum-enabled broker (Kafka error 19,
+    NOT_ENOUGH_REPLICAS).  NOTHING was appended, so redelivery is safe;
+    it subclasses ConnectionError because the condition is retriable
+    (an evicted follower re-admits, a reassignment completes) and every
+    existing redelivery loop already treats ConnectionError as the
+    try-again signal."""
+
+
+class ProduceTimedOutError(ConnectionError):
+    """An ``acks=all`` produce was APPENDED on the leader but the
+    quorum high-water mark did not reach it within the request timeout
+    (Kafka error 7, REQUEST_TIMED_OUT).  The record is durable on the
+    leader yet unacked — the caller redelivers (at-least-once, exactly
+    Kafka's producer-timeout contract; consumers cannot have observed
+    the unacked copy, it sits above the quorum HWM)."""
 
 
 class FencedEpochError(ConnectionError):
@@ -461,8 +492,20 @@ class KafkaWireBroker(ProducePartitionMixin):
                  sasl_username: Optional[str] = None,
                  sasl_password: Optional[str] = None,
                  timeout_s: float = 30.0, topology=None,
-                 epoch: Optional[int] = None):
+                 epoch: Optional[int] = None,
+                 acks: Optional[int] = None,
+                 replica_id: int = -1):
         self.client_id = client_id
+        #: default required_acks for produce paths (None = -1, the
+        #: classic client default: quorum where the topic is
+        #: replicated, leader-ack otherwise — Kafka RF-1 semantics).
+        #: Per-call `acks=` overrides (the bench's acks=1 leg).
+        self._acks = -1 if acks is None else int(acks)
+        #: >= 0 marks this client as replica `replica_id`'s mirror leg:
+        #: FETCH/RAW_FETCH carry the id, the leader tracks the fetch
+        #: position in its ISR, and the quorum read barrier is bypassed
+        #: (a follower exists to read the un-replicated tail).
+        self._replica_id = int(replica_id)
         self._lock = threading.Lock()
         self._corr = 0
         # bootstrap list: try each server in order (a standard client's
@@ -503,6 +546,12 @@ class KafkaWireBroker(ProducePartitionMixin):
         """Stamp `epoch` into subsequent request headers (None = legacy
         unfenced client)."""
         self._epoch = epoch
+
+    def set_replica_id(self, replica_id: int) -> None:
+        """Mark this client as a replica's mirror leg: subsequent
+        FETCH/RAW_FETCH requests carry `replica_id` so the leader's ISR
+        tracker observes the fetch positions (and serves the tail)."""
+        self._replica_id = int(replica_id)
 
     def _refresh_topology(self) -> None:
         """Re-resolve (servers, epoch) from the published topology.
@@ -790,18 +839,30 @@ class KafkaWireBroker(ProducePartitionMixin):
             n = self._metadata([topic])["topics"].get(topic, 1)
         return n
 
-    def produce_many(self, topic: str, entries, partition=None) -> int:
+    def produce_many(self, topic: str, entries, partition=None,
+                     acks: Optional[int] = None,
+                     timeout_ms: int = 10_000) -> int:
         """entries: [(key, value, timestamp_ms[, headers])] → offset of the
         last one.  Record headers (the trace-context carrier on the
         in-process broker) are DROPPED here: MessageSet v1 has no header
-        slot, so traces end at a wire-broker boundary by design."""
+        slot, so traces end at a wire-broker boundary by design.
+
+        ``acks`` (default: the client's configured default, -1): -1
+        acks at the quorum high-water mark on replicated topics
+        (leader-only on unreplicated ones — Kafka RF-1), 1 acks at the
+        leader append, 0 is fire-and-forget (the response is immediate
+        and carries no delivery guarantee).  A quorum that cannot form
+        raises NotEnoughReplicasError (nothing appended); a quorum that
+        does not catch up within ``timeout_ms`` raises
+        ProduceTimedOutError (appended, unacked — redeliver)."""
         by_part: Dict[int, list] = {}
         for key, value, ts, *_hdrs in entries:
             p = self._partition_for(topic, key) if partition is None else partition
             by_part.setdefault(p, []).append((0, key, value, ts))
         last = -1
         w = _Writer()
-        w.i16(-1).i32(10_000)  # acks=all, timeout
+        w.i16(self._acks if acks is None else int(acks))
+        w.i32(int(timeout_ms))
 
         def part_entry(wr, item):
             p, ents = item
@@ -833,13 +894,25 @@ class KafkaWireBroker(ProducePartitionMixin):
                     # partition — nothing appended THERE; the routing
                     # client refreshes its map and redelivers
                     raise NotLeaderForPartitionError(topic, p)
+                if err == ERR_NOT_ENOUGH_REPLICAS:
+                    raise NotEnoughReplicasError(
+                        f"produce to {topic}:{p} refused: ISR below "
+                        f"min_isr (or no ISR configured for acks=all); "
+                        f"nothing appended — redeliver when the quorum "
+                        f"re-forms")
+                if err == ERR_REQUEST_TIMED_OUT:
+                    raise ProduceTimedOutError(
+                        f"produce to {topic}:{p} appended but the "
+                        f"quorum HWM did not reach it in time; unacked "
+                        f"— the caller redelivers (at-least-once)")
                 if err != ERR_NONE:
                     raise RuntimeError(f"produce to {topic}:{p} failed: {err}")
                 last = max(last, base + len(by_part[p]) - 1)
         return last
 
     def produce_raw(self, topic: str, partition: int,
-                    frames: bytes) -> int:
+                    frames: bytes, acks: Optional[int] = None,
+                    timeout_ms: int = 10_000) -> int:
         """RAW_PRODUCE over the wire: ship a pre-framed batch the broker
         appends segment-verbatim (CRC-validated whole, offsets stamped
         server-side).  Returns the batch's base offset.
@@ -853,6 +926,12 @@ class KafkaWireBroker(ProducePartitionMixin):
         caller owns redelivery exactly like produce."""
         w = _Writer()
         w.string(topic).i32(partition).bytes_(frames)
+        # trailing-optional required_acks + timeout (ISSUE 14): the
+        # RAW_PRODUCE mirror of classic produce's acks field.  Old
+        # servers never read past the frames blob; absent fields mean
+        # the client default (-1, like classic produce).
+        w.i16(self._acks if acks is None else int(acks))
+        w.i32(int(timeout_ms))
         # retry-ok: RAW_PRODUCE is NOT auto-retried (double-append risk,
         # same stance as produce); ConnectionError reaches the producer
         r = self._request(RAW_PRODUCE, 0, bytes(w.buf))
@@ -868,6 +947,15 @@ class KafkaWireBroker(ProducePartitionMixin):
             raise self._fenced(f"raw produce to {topic}:{partition}")
         if err == ERR_NOT_LEADER_FOR_PARTITION:
             raise NotLeaderForPartitionError(topic, partition)
+        if err == ERR_NOT_ENOUGH_REPLICAS:
+            raise NotEnoughReplicasError(
+                f"raw produce to {topic}:{partition} refused: ISR "
+                f"below min_isr; nothing appended — redeliver when the "
+                f"quorum re-forms")
+        if err == ERR_REQUEST_TIMED_OUT:
+            raise ProduceTimedOutError(
+                f"raw produce to {topic}:{partition} appended but "
+                f"unacked within the timeout — the caller redelivers")
         if err != ERR_NONE:
             raise RuntimeError(
                 f"raw produce to {topic}:{partition} failed: {err}")
@@ -877,7 +965,9 @@ class KafkaWireBroker(ProducePartitionMixin):
     def fetch(self, topic: str, partition: int, offset: int,
               max_messages: int = 1024) -> List[Message]:
         w = _Writer()
-        w.i32(-1).i32(0).i32(1)  # replica -1, max_wait 0ms, min_bytes 1
+        # replica id (-1 = consumer; >= 0 = a follower's mirror fetch,
+        # observed by the leader's ISR tracker), max_wait 0ms, min_bytes 1
+        w.i32(self._replica_id).i32(0).i32(1)
 
         def part(wr, _):
             wr.i32(partition).i64(offset).i32(4 << 20)
@@ -939,6 +1029,11 @@ class KafkaWireBroker(ProducePartitionMixin):
 
         w = _Writer()
         w.string(topic).i32(partition).i64(offset).i32(max_bytes)
+        # trailing-optional replica id (ISSUE 14): a follower's raw
+        # mirror fetch identifies itself so the leader's ISR tracker
+        # observes the position and serves past the quorum HWM.  Old
+        # servers never read past max_bytes.
+        w.i32(self._replica_id)
         r = self._request(RAW_FETCH, 0, bytes(w.buf))
         err = r.i16()
         if err == ERR_UNSUPPORTED_VERSION:
@@ -1236,6 +1331,37 @@ class KafkaWireBroker(ProducePartitionMixin):
         # the member); not worth retrying against a possibly-new leader
         self._request(LEAVE_GROUP, 0, bytes(w.buf)).i16()
 
+    # ----------------------------------------------------- cluster admin
+    def cluster_admin(self, command: str, args: Optional[dict] = None,
+                      ) -> dict:
+        """CLUSTER_ADMIN extension: drive a live controller's elastic
+        reassignment (`add-broker` / `drain-broker` / `status`) from
+        another process.  Returns the controller's JSON report; raises
+        NotImplementedError against a broker with no controller
+        attached, RuntimeError with the controller's error text
+        otherwise."""
+        import json as _json
+
+        w = _Writer()
+        w.string(command)
+        w.bytes_(_json.dumps(args or {}).encode())
+        # retry-ok: admin verbs MUTATE cluster membership (a replayed
+        # add-broker boots a second node); a ConnectionError surfaces
+        # and the operator re-checks `status` before re-issuing
+        r = self._request(CLUSTER_ADMIN, 0, bytes(w.buf))
+        err = r.i16()
+        if err == ERR_UNSUPPORTED_VERSION:
+            raise NotImplementedError(
+                "broker has no cluster controller attached "
+                "(CLUSTER_ADMIN unsupported)")
+        blob = r.bytes_() or b"{}"
+        doc = _json.loads(blob.decode() or "{}")
+        if err != ERR_NONE:
+            raise RuntimeError(
+                f"cluster admin {command!r} failed: "
+                f"{doc.get('error', f'error {err}')}")
+        return doc
+
     def close(self) -> None:
         # _sock is None when the last reconnect attempt found no
         # reachable server (_connect_any clears it before trying) — a
@@ -1429,6 +1555,19 @@ class _KafkaConn(socketserver.BaseRequestHandler):
         server_epoch = self.server.epoch     # type: ignore[attr-defined]
         return client_epoch is not None and client_epoch != server_epoch
 
+    @staticmethod
+    def _produce_error_resp(w: _Writer, tops, err: int) -> None:
+        """Serialize a classic PRODUCE response answering `err` for
+        every partition of every topic — the one writer behind the
+        retiring / invalid-acks / epoch-fence early returns (a future
+        response-shape change must land in exactly one place)."""
+        resp = [(tname, [(pid, err, -1) for pid, _ in parts])
+                for tname, parts in tops]
+        w.array(resp, lambda wr, t: (wr.string(t[0]), wr.array(
+            t[1], lambda pw, p: pw.i32(p[0]).i16(p[1]).i64(p[2])
+            .i64(-1))))
+        w.i32(0)  # throttle
+
     def _not_coordinator(self) -> bool:
         """True when this broker is part of a cluster whose group
         coordinator is pinned to a DIFFERENT node: group membership and
@@ -1481,25 +1620,36 @@ class _KafkaConn(socketserver.BaseRequestHandler):
 
             w.array(names, topic_entry)
         elif api_key == PRODUCE:
-            r.i16()  # acks
-            r.i32()  # timeout
+            # required_acks is PARSED AND HONORED (ISSUE 14; it was
+            # read-and-discarded before): 1 acks at the leader append,
+            # -1 (acks=all) acks only once the batch is below the
+            # quorum high-water mark, 0 answers immediately with no
+            # delivery guarantee (errors masked — fire-and-forget).
+            acks = r.i16()
+            timeout_ms = r.i32()
 
             def part(rd):
                 return (rd.i32(), rd.bytes_())
 
             tops = r.array(lambda rd: (rd.string(), rd.array(part)))
+            if self.server.retiring:       # type: ignore[attr-defined]
+                # reassignment step-down: leadership moved — answer
+                # NOT_LEADER so every producer (epoch-stamped or
+                # legacy) re-routes; nothing may land in a retired log
+                self._produce_error_resp(w, tops,
+                                         ERR_NOT_LEADER_FOR_PARTITION)
+                return
+            if acks not in (-1, 0, 1):
+                self._produce_error_resp(w, tops,
+                                         ERR_INVALID_REQUIRED_ACKS)
+                return
             if self._epoch_mismatch(client_epoch):
                 # fence BEFORE touching the broker: a stale-epoch produce
                 # must append nothing anywhere
-                resp = [(tname,
-                         [(pid, ERR_FENCED_LEADER_EPOCH, -1)
-                          for pid, _ in parts])
-                        for tname, parts in tops]
-                w.array(resp, lambda wr, t: (wr.string(t[0]), wr.array(
-                    t[1], lambda pw, p: pw.i32(p[0]).i16(p[1]).i64(p[2])
-                    .i64(-1))))
-                w.i32(0)  # throttle
+                self._produce_error_resp(w, tops,
+                                         ERR_FENCED_LEADER_EPOCH)
                 return
+            repl = getattr(broker, "replication", None)
             resp = []
             for tname, parts in tops:
                 presp = []
@@ -1513,18 +1663,39 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                     if not self._valid_part(broker, tname, pid):
                         presp.append((pid, ERR_UNKNOWN_TOPIC, -1))
                         continue
+                    quorum = acks == -1 and repl is not None
+                    if quorum:
+                        # acks=all durability checks BEFORE any append:
+                        # a topic with no ISR configured on a quorum-
+                        # enabled broker is an explicit error, and an
+                        # ISR below min_isr refuses (nothing appended —
+                        # redelivery is safe).  A broker with NO
+                        # replication state keeps Kafka's RF-1 shape:
+                        # ISR = {leader}, acks=all == acks=1.
+                        if not repl.covers(tname) or \
+                                repl.isr_size(tname, pid) < repl.min_isr:
+                            presp.append(
+                                (pid, ERR_NOT_ENOUGH_REPLICAS, -1))
+                            continue
                     try:
-                        base = broker.end_offset(tname, pid)
                         # bulk append under one broker lock — the
                         # per-message produce loop was a per-record cost
                         # in the server's hottest handler.  Null values
                         # pass through intact: a produced tombstone must
                         # land in the log as a tombstone, or compaction
-                        # could never delete a key written over the wire
-                        broker.produce_many(
+                        # could never delete a key written over the wire.
+                        # The returned LAST offset anchors both the
+                        # response base and the quorum target: a
+                        # re-read of end_offset could include a
+                        # concurrent producer's later batch and make
+                        # this request wait on (or time out over)
+                        # records that are not its own.
+                        last = broker.produce_many(
                             tname, [(key, value, ts)
                                     for _, key, value, ts in entries],
                             partition=pid)
+                        base = last - len(entries) + 1 if entries \
+                            else broker.end_offset(tname, pid)
                     except NotLeaderForPartitionError:
                         # sharded broker, unowned partition: Kafka error
                         # 6 — the client refreshes metadata and re-routes
@@ -1538,14 +1709,41 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                         presp.append(
                             (pid, ERR_TOPIC_AUTHORIZATION_FAILED, -1))
                         continue
+                    if quorum and entries:
+                        # block this handler thread until THIS batch is
+                        # below the quorum HWM (followers fetch on their
+                        # own connections/threads, so the wait starves
+                        # nothing).  A timeout means APPENDED-UNACKED:
+                        # the caller redelivers, Kafka's own contract.
+                        if not repl.wait_replicated(
+                                tname, pid, last + 1,
+                                timeout_s=min(max(timeout_ms, 0) / 1000.0,
+                                              30.0)):
+                            presp.append(
+                                (pid, ERR_REQUEST_TIMED_OUT, base))
+                            continue
                     presp.append((pid, ERR_NONE, base))
                 resp.append((tname, presp))
+            if acks == 0:
+                # fire-and-forget: the append already ran; the answer
+                # carries no delivery information by definition (real
+                # Kafka sends NO response at all for acks=0 — this
+                # family's strict request/response framing keeps the
+                # turn, masked)
+                resp = [(tname, [(pid, ERR_NONE, -1)
+                                 for pid, _err, _base in presp])
+                        for tname, presp in resp]
             w.array(resp, lambda wr, t: (wr.string(t[0]), wr.array(
                 t[1], lambda pw, p: pw.i32(p[0]).i16(p[1]).i64(p[2])
                 .i64(-1))))
             w.i32(0)  # throttle
         elif api_key == FETCH:
-            r.i32()  # replica
+            # replica id >= 0 marks a FOLLOWER's mirror fetch (Kafka's
+            # own field, finally load-bearing — ISSUE 14): the leader
+            # observes the fetch position into its ISR tracker and
+            # serves past the quorum HWM (a follower exists to read the
+            # un-replicated tail); consumers (-1) are bounded by it.
+            rid = r.i32()
             r.i32()  # max wait
             r.i32()  # min bytes
 
@@ -1553,6 +1751,7 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                 return (rd.i32(), rd.i64(), rd.i32())
 
             tops = r.array(lambda rd: (rd.string(), rd.array(part)))
+            repl = getattr(broker, "replication", None)
             resp = []
             for tname, parts in tops:
                 presp = []
@@ -1561,7 +1760,18 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                         presp.append((pid, ERR_UNKNOWN_TOPIC, -1, b""))
                         continue
                     try:
-                        msgs = broker.fetch(tname, pid, offset, 4096)
+                        if rid >= 0:
+                            if repl is not None:
+                                repl.observe_fetch(rid, tname, pid,
+                                                   offset)
+                            # relay brokers have no fetch_tail: they
+                            # carry no replication state either, so the
+                            # plain fetch is already unbounded there
+                            msgs = getattr(broker, "fetch_tail",
+                                           broker.fetch)(
+                                tname, pid, offset, 4096)
+                        else:
+                            msgs = broker.fetch(tname, pid, offset, 4096)
                     except NotLeaderForPartitionError:
                         presp.append((pid, ERR_NOT_LEADER_FOR_PARTITION,
                                       -1, b""))
@@ -1574,6 +1784,13 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                                       e.earliest, b""))
                         continue
                     hwm = broker.end_offset(tname, pid)
+                    if rid < 0 and repl is not None:
+                        # consumers see the QUORUM hwm (their readable
+                        # frontier), not the leader log end — consumer
+                        # lag measures against what they may read
+                        ceil = repl.fetch_ceiling(tname, pid)
+                        if ceil is not None:
+                            hwm = ceil
                     ms = encode_message_set(
                         [(m.offset, m.key, m.value, m.timestamp_ms)
                          for m in msgs])[:max(max_bytes, 0) or None]
@@ -1592,8 +1809,22 @@ class _KafkaConn(socketserver.BaseRequestHandler):
             pid = r.i32()
             offset = r.i64()
             max_bytes = r.i32()
+            # trailing-optional replica id (ISSUE 14): a follower's
+            # zero-copy mirror fetch — observed into the ISR, served
+            # past the quorum HWM.  Old clients simply end the request
+            # here and stay consumers.
+            rid = r.i32() if r.pos + 4 <= len(r.buf) else -1
+            repl = getattr(broker, "replication", None)
             fetch_raw = getattr(broker, "fetch_raw", None)
-            if not self._valid_part(broker, tname, pid):
+            valid = self._valid_part(broker, tname, pid)
+            if valid and rid >= 0 and fetch_raw is not None:
+                # observe only VALIDATED partitions (a replica with a
+                # stale topic view must not seed a garbage part state
+                # that poisons the every-partition ISR intersection)
+                if repl is not None:
+                    repl.observe_fetch(rid, tname, pid, offset)
+                fetch_raw = getattr(broker, "fetch_raw_tail", fetch_raw)
+            if not valid:
                 w.i16(ERR_UNKNOWN_TOPIC).i64(-1).bytes_(None)
             elif fetch_raw is None:  # relay broker without raw reads
                 w.i16(ERR_UNSUPPORTED_VERSION)
@@ -1624,6 +1855,11 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                         lh = getattr(broker, "last_hwm", None)
                         hwm = lh(tname, pid) if lh is not None else None
                         hwm = -1 if hwm is None else hwm
+                    elif rid < 0 and repl is not None and \
+                            repl.fetch_ceiling(tname, pid) is not None:
+                        # consumers' columnar lag measures against the
+                        # quorum hwm — their readable frontier
+                        hwm = repl.fetch_ceiling(tname, pid)
                     else:
                         hwm = broker.end_offset(tname, pid)
                     if raw is None:
@@ -1653,23 +1889,58 @@ class _KafkaConn(socketserver.BaseRequestHandler):
             tname = r.string()
             pid = r.i32()
             frames = r.bytes_() or b""
+            # trailing-optional required_acks + timeout (ISSUE 14): the
+            # RAW_PRODUCE mirror of classic produce's field.  Absent
+            # (old clients) means -1, the classic client default.
+            acks = r.i16() if r.pos + 2 <= len(r.buf) else -1
+            timeout_ms = r.i32() if r.pos + 4 <= len(r.buf) else 10_000
+            repl = getattr(broker, "replication", None)
+            quorum = acks == -1 and repl is not None
             produce_raw = getattr(broker, "produce_raw", None)
-            if self._epoch_mismatch(client_epoch):
+            if self.server.retiring:       # type: ignore[attr-defined]
+                # reassignment step-down, same answer as classic
+                w.i16(ERR_NOT_LEADER_FOR_PARTITION).i64(-1).i32(0)
+            elif self._epoch_mismatch(client_epoch):
                 # fence BEFORE touching the broker, like classic produce
                 w.i16(ERR_FENCED_LEADER_EPOCH).i64(-1).i32(0)
             elif produce_raw is None:
                 # relay broker without raw appends: same downgrade as a
                 # pre-extension server — clients pin back to classic
                 w.i16(ERR_UNSUPPORTED_VERSION)
+            elif acks not in (-1, 0, 1):
+                w.i16(ERR_INVALID_REQUIRED_ACKS).i64(-1).i32(0)
             else:
                 if tname not in broker.topics() and cluster is None:
                     broker.create_topic(tname, partitions=max(pid + 1, 1))
                 if not self._valid_part(broker, tname, pid):
                     w.i16(ERR_UNKNOWN_TOPIC).i64(-1).i32(0)
+                elif quorum and (not repl.covers(tname) or
+                                 repl.isr_size(tname, pid) <
+                                 repl.min_isr):
+                    # same pre-append refusal as classic acks=all:
+                    # nothing lands, redelivery is safe
+                    w.i16(ERR_NOT_ENOUGH_REPLICAS).i64(-1).i32(0)
                 else:
                     if _tracing.ENABLED:
                         self._mark_raw_batch(frames, "wire_raw_produce",
                                              tname, pid)
+                    nframes = None
+                    if quorum:
+                        # the quorum wait must target THIS batch's own
+                        # last offset, not an end_offset re-read that
+                        # may include a concurrent producer's later
+                        # batch (the same race fixed on classic
+                        # produce): count the frames before the append
+                        # — one validation walk, quorum path only; a
+                        # corrupt batch falls through to produce_raw's
+                        # own whole-batch rejection
+                        from ..ops import framing as _fr
+
+                        try:
+                            nframes = _fr.validate_frame_batch(
+                                frames)["count"]
+                        except _fr.CorruptFrameError:
+                            nframes = None
                     try:
                         base = produce_raw(tname, pid, frames)
                     except NotImplementedError:
@@ -1683,8 +1954,18 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                         w.i16(ERR_TOPIC_AUTHORIZATION_FAILED).i64(-1)
                         w.i32(0)
                     else:
-                        w.i16(ERR_NONE).i64(base)
-                        w.i32(broker.end_offset(tname, pid) - base)
+                        count = nframes if nframes is not None else \
+                            broker.end_offset(tname, pid) - base
+                        if quorum and count and not repl.wait_replicated(
+                                tname, pid, base + count,
+                                timeout_s=min(max(timeout_ms, 0)
+                                              / 1000.0, 30.0)):
+                            # appended-unacked: the producer redelivers
+                            w.i16(ERR_REQUEST_TIMED_OUT).i64(base)
+                            w.i32(count)
+                        else:
+                            w.i16(ERR_NONE).i64(base)
+                            w.i32(count)
         elif api_key == LIST_OFFSETS:
             r.i32()  # replica
 
@@ -1883,6 +2164,30 @@ class _KafkaConn(socketserver.BaseRequestHandler):
             member = r.string()
             self.server.group_coordinator(group).leave(member)
             w.i16(ERR_NONE)
+        elif api_key == CLUSTER_ADMIN:
+            # elastic reassignment verbs (ISSUE 14): served only when a
+            # controller is attached (`server.admin`); the verbs run IN
+            # this handler thread — the CLI waits for the reassignment
+            # report, other connections keep serving (threading server)
+            import json as _json
+
+            command = r.string()
+            blob = r.bytes_() or b"{}"
+            admin = getattr(self.server, "admin", None)
+            if admin is None:
+                w.i16(ERR_UNSUPPORTED_VERSION)
+            else:
+                try:
+                    doc = admin.admin_command(
+                        command or "",
+                        _json.loads(blob.decode() or "{}"))
+                    w.i16(ERR_NONE)
+                    w.bytes_(_json.dumps(doc, default=str).encode())
+                except Exception as e:  # noqa: BLE001 - the operator
+                    # gets the error text, the connection stays up
+                    w.i16(-1)  # UNKNOWN_SERVER_ERROR
+                    w.bytes_(_json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode())
         elif api_key == CREATE_TOPICS:
             def topic(rd):
                 name = rd.string()
@@ -1962,6 +2267,17 @@ class KafkaWireServer(socketserver.ThreadingTCPServer):
         #: NOT_LEADER_FOR_PARTITION, and group/offset APIs are pinned to
         #: the view's coordinator node.
         self.cluster = cluster
+        #: cluster admin hook (iotml.cluster.ClusterController duck-
+        #: type: admin_command(command, args) -> dict) — None answers
+        #: CLUSTER_ADMIN with UNSUPPORTED_VERSION.
+        self.admin = None
+        #: reassignment step-down (ISSUE 14): True once leadership has
+        #: moved off this server but its sockets are still draining —
+        #: every write answers NOT_LEADER_FOR_PARTITION (truthful: it
+        #: no longer leads) so even UNSTAMPED legacy producers re-route
+        #: instead of split-writing into a retired log; reads keep
+        #: serving through the grace window.
+        self.retiring = False
         #: leadership fencing epoch this server believes it serves at.
         #: Promotion bumps it (FollowerReplica.promote); a restarted old
         #: leader comes back with its stale value and fences itself

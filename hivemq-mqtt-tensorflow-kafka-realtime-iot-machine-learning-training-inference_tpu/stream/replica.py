@@ -78,7 +78,8 @@ class FollowerReplica:
                  commit_interval_s: float = 1.0,
                  store_dir: Optional[str] = None, store_policy=None,
                  partition_filter=None, local: Optional[Broker] = None,
-                 compacted_topics: Tuple[str, ...] = ()):
+                 compacted_topics: Tuple[str, ...] = (),
+                 replica_id: Optional[int] = None, topology=None):
         #: local log bound per mirrored topic.  The wire protocol does
         #: not carry the leader's retention config, so a follower of a
         #: retention-bounded leader must be given its own bound here or
@@ -108,8 +109,22 @@ class FollowerReplica:
         self.server = KafkaWireServer(self.local, host=host, port=port,
                                       epoch=FOLLOWER_EPOCH)
         user, pw = sasl if sasl is not None else (None, None)
-        self._leader = KafkaWireBroker(leader, client_id="iotml-replica",
-                                       sasl_username=user, sasl_password=pw)
+        #: replica_id (ISSUE 14): >= 0 stamps this follower's identity
+        #: into its FETCH/RAW_FETCH requests so a quorum leader's ISR
+        #: tracker observes the fetch positions — membership, eviction
+        #: and the quorum high-water mark all derive from them.  None
+        #: keeps the legacy anonymous mirror (no ISR participation).
+        self.replica_id = replica_id
+        #: topology (supervise.Topology duck-type): when given, the
+        #: leader connection re-resolves the CURRENT leader address on
+        #: every reconnect — a follower survives its leader being
+        #: reassigned (add-broker/drain-broker) by simply following the
+        #: published cell, cursor intact (offsets are identical across
+        #: the pair by contract).
+        self._leader = KafkaWireBroker(
+            leader, client_id="iotml-replica", topology=topology,
+            sasl_username=user, sasl_password=pw,
+            replica_id=-1 if replica_id is None else int(replica_id))
         self._topics = topics
         #: topics mirrored with COMPACTED semantics: fetched batches may
         #: carry offset holes (compaction punched out shadowed records),

@@ -158,8 +158,13 @@ class SupervisedUnit:
         self._thread = registry.register_thread(
             threading.Thread(target=body, daemon=True,
                              name=f"iotml-unit-{self.name}"))
-        self._thread.start()
+        # state flips BEFORE the thread starts: an observer that sees
+        # alive() true must never read a stale IDLE (a /healthz scrape
+        # landing between start() and a later assignment did exactly
+        # that under load).  If the body crashes instantly, the monitor
+        # sees RUNNING + dead thread — the normal restart path.
         self.state = RUNNING
+        self._thread.start()
 
     def _budget_exhausted(self, now: float) -> bool:
         while self._restart_times and \

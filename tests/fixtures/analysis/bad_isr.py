@@ -1,0 +1,50 @@
+"""R15 fixture: ISR / quorum-HWM mutation-discipline breaches — a
+drive-by eviction (1 finding), a foreign follower registration and
+retirement (2 findings), and a position/quorum-wait ingress outside
+the wire server (2 findings) — plus the clean shapes: reading ISR
+state, the ReplicaSet orchestration API, and a justified suppression
+(0 findings).
+"""
+
+
+def drive_by_eviction(state):
+    # flagged: eviction decides what acks=all MEANS — a foreign caller
+    # shrinking the ISR silently weakens every in-flight ack
+    state.evict_stale()
+
+
+def foreign_registration(state):
+    # flagged: membership changes are iotml/replication/'s alone
+    state.register_follower(99)
+    state.unregister_follower(99)
+
+
+def rogue_position_ingress(state, topic):
+    # flagged: follower positions enter through the wire server's fetch
+    # handlers only — a second ingress could admit a replica that
+    # never fetched (its "position" would be fiction)
+    state.observe_fetch(99, topic, 0, 10_000)
+
+
+def rogue_quorum_wait(state, topic):
+    # flagged: the acks=all wait (and the eviction scan inside it)
+    # belongs to the produce handlers
+    state.wait_replicated(topic, 0, 10_000)
+
+
+def reading_is_fine(state, topic):
+    # ISR state is everyone's to READ: gauges, drills, admin status
+    return (state.isr_size(topic, 0), state.quorum_hwm(topic, 0),
+            state.fetch_ceiling(topic, 0), state.positions(topic, 0))
+
+
+def orchestration_is_fine(rset):
+    # the ReplicaSet API (add_follower / retire_follower / promote) is
+    # the public elasticity surface — it delegates to the one owner
+    rid = rset.add_follower()
+    rset.retire_follower(rid)
+
+
+def justified(state):
+    # lint-ok: R15 test harness evicts on purpose to prove re-admission
+    state.evict_stale()

@@ -1,0 +1,571 @@
+"""Quorum ISR durability + elastic reassignment (iotml.replication).
+
+The reference runs every topic at RF 3 (01_installConfluentPlatform.sh);
+this suite pins the rebuild's Kafka-shape equivalent: leader-side ISR
+tracking from replica-stamped fetches, acks=all at the quorum
+high-water mark, the consumer read barrier (no reads of the
+un-replicated tail), staleness eviction / re-admission, ISR-restricted
+election, HWM persistence across remount, and online add/drain
+reassignment on the cluster.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from iotml.replication import ReplicaSet, ReplicationState
+from iotml.stream.broker import Broker
+from iotml.stream.kafka_wire import (KafkaWireBroker, KafkaWireServer,
+                                     NotEnoughReplicasError,
+                                     ProduceTimedOutError)
+
+T = "repl-topic"
+
+
+def _leader_with_set(n_followers=2, min_isr=2, max_lag_s=0.3,
+                     partitions=1, groups=(), hwm_file=None,
+                     store_dir=None):
+    leader = Broker(store_dir=store_dir)
+    leader.create_topic(T, partitions=partitions)
+    srv = KafkaWireServer(leader).start()
+    rs = ReplicaSet(leader_broker=leader, leader_server=srv,
+                    n_followers=n_followers, min_isr=min_isr,
+                    max_lag_s=max_lag_s, topics=[T], groups=groups,
+                    hwm_file=hwm_file)
+    rs.start(sync="manual")
+    return leader, srv, rs
+
+
+def _teardown(srv, rs, *clients):
+    for c in clients:
+        try:
+            c.close()
+        except OSError:
+            pass
+    rs.stop()
+    try:
+        srv.shutdown()
+        srv.server_close()
+    except OSError:
+        pass
+
+
+def _form_isr(rs, partitions=1, width=None):
+    want = width if width is not None else 1 + len(rs.followers)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        rs.sync_once()
+        if all(rs.state.isr_size(T, p) >= want
+               for p in range(partitions)):
+            return
+    raise AssertionError(
+        f"ISR never formed: {rs.state.isr_size(T, 0)} < {want}")
+
+
+def _quorum_produce(client, rs, values, partition=0, timeout_s=10.0):
+    """acks=all produce resolved against manually-stepped followers:
+    the wait blocks a server handler thread, so the produce runs on a
+    worker while the test thread steps replication."""
+    result = {}
+
+    def attempt():
+        try:
+            result["last"] = client.produce_many(
+                T, [(None, v, 0) for v in values], partition=partition)
+        except Exception as e:  # noqa: BLE001 - surfaced to the test
+            result["err"] = e
+
+    t = threading.Thread(target=attempt, daemon=True,
+                         name="iotml-test-quorum-produce")
+    t.start()
+    deadline = time.monotonic() + timeout_s
+    while t.is_alive() and time.monotonic() < deadline:
+        rs.sync_once()
+        time.sleep(0.002)
+    t.join(1.0)
+    if "err" in result:
+        raise result["err"]
+    assert "last" in result, "quorum produce never resolved"
+    return result["last"]
+
+
+# ----------------------------------------------------------- ISR unit
+def test_isr_admission_requires_catch_up():
+    broker = Broker()
+    broker.create_topic(T)
+    broker.produce_batch(T, [b"a", b"b", b"c"], partition=0)
+    state = ReplicationState(broker, follower_ids=(1,), min_isr=2)
+    # registered but never fetched: out of the ISR
+    assert state.isr_size(T, 0) == 1
+    # a mid-log fetch is progress, not membership
+    state.observe_fetch(1, T, 0, 1)
+    assert state.isr_size(T, 0) == 1
+    # reaching the log end admits
+    state.observe_fetch(1, T, 0, 3)
+    assert state.isr_size(T, 0) == 2
+    assert state.isr_follower_ids() == {1}
+
+
+def test_quorum_hwm_is_min_over_isr_and_monotone():
+    broker = Broker()
+    broker.create_topic(T)
+    state = ReplicationState(broker, follower_ids=(1, 2), min_isr=2,
+                             max_lag_s=30.0)
+    broker.produce_batch(T, [b"a", b"b"], partition=0)
+    # anchor: attaching replication must not un-commit history — the
+    # first touch anchors the hwm at the then-current end
+    assert state.quorum_hwm(T, 0) == 2
+    state.observe_fetch(1, T, 0, 2)
+    state.observe_fetch(2, T, 0, 2)
+    broker.produce_batch(T, [b"c", b"d"], partition=0)  # end=4
+    # follower 1 reaches 3, follower 2 reaches 4: quorum = min = 3
+    state.observe_fetch(1, T, 0, 3)
+    state.observe_fetch(2, T, 0, 4)
+    assert state.quorum_hwm(T, 0) == 3
+    assert state.fetch_ceiling(T, 0) == 3
+    # monotone: nothing can pull it back
+    state.observe_fetch(1, T, 0, 4)
+    assert state.quorum_hwm(T, 0) == 4
+
+
+def test_staleness_eviction_and_readmission():
+    broker = Broker()
+    broker.create_topic(T)
+    state = ReplicationState(broker, follower_ids=(1,), min_isr=1,
+                             max_lag_s=0.1)
+    broker.produce_batch(T, [b"a"], partition=0)
+    state.observe_fetch(1, T, 0, 1)
+    assert state.isr_size(T, 0) == 2
+    # the follower freezes while the log grows: evicted after the window
+    broker.produce_batch(T, [b"b"], partition=0)
+    time.sleep(0.15)
+    state.evict_stale()
+    assert state.isr_size(T, 0) == 1
+    # quorum advanced past the evicted laggard (leader-only ISR)
+    assert state.quorum_hwm(T, 0) == 2
+    # catch-up re-admits
+    state.observe_fetch(1, T, 0, 2)
+    assert state.isr_size(T, 0) == 2
+
+
+def test_unregister_advances_quorum():
+    broker = Broker()
+    broker.create_topic(T)
+    state = ReplicationState(broker, follower_ids=(1, 2), min_isr=1,
+                             max_lag_s=30.0)
+    state.observe_fetch(1, T, 0, 0)
+    state.observe_fetch(2, T, 0, 0)
+    broker.produce_batch(T, [b"a", b"b"], partition=0)
+    state.observe_fetch(2, T, 0, 2)
+    assert state.quorum_hwm(T, 0) == 0  # bounded by follower 1
+    state.unregister_follower(1)
+    assert state.quorum_hwm(T, 0) == 2
+    assert state.follower_ids == (2,)
+
+
+# ------------------------------------------------------ acks semantics
+def test_acks_all_without_replication_is_leader_ack():
+    """Kafka RF-1: ISR = {leader}, acks=all == acks=1 — the classic
+    client default keeps working against every unreplicated broker."""
+    broker = Broker()
+    broker.create_topic(T)
+    srv = KafkaWireServer(broker).start()
+    try:
+        client = KafkaWireBroker(f"127.0.0.1:{srv.port}")
+        assert client.produce_many(T, [(None, b"v", 0)],
+                                   partition=0) == 0  # default acks=-1
+        client.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_acks_all_rejected_below_min_isr_nothing_appended():
+    leader, srv, rs = _leader_with_set()
+    client = KafkaWireBroker(f"127.0.0.1:{srv.port}")
+    try:
+        with pytest.raises(NotEnoughReplicasError):
+            client.produce_many(T, [(None, b"v", 0)], partition=0)
+        assert leader.end_offset(T, 0) == 0  # NOTHING appended
+        # acks=1 and acks=0 still work while the ISR forms
+        assert client.produce_many(T, [(None, b"v1", 0)],
+                                   partition=0, acks=1) == 0
+        assert client.produce_many(T, [(None, b"v0", 0)],
+                                   partition=0, acks=0) == -1  # masked
+        assert leader.end_offset(T, 0) == 2
+    finally:
+        _teardown(srv, rs, client)
+
+
+def test_invalid_required_acks_is_error_21():
+    leader, srv, rs = _leader_with_set()
+    client = KafkaWireBroker(f"127.0.0.1:{srv.port}")
+    try:
+        with pytest.raises(RuntimeError, match="21"):
+            client.produce_many(T, [(None, b"v", 0)], partition=0,
+                                acks=5)
+        assert leader.end_offset(T, 0) == 0
+    finally:
+        _teardown(srv, rs, client)
+
+
+def test_acks_all_commits_at_quorum_and_times_out_honestly():
+    leader, srv, rs = _leader_with_set(max_lag_s=30.0)  # no eviction
+    client = KafkaWireBroker(f"127.0.0.1:{srv.port}")
+    try:
+        _form_isr(rs)
+        assert _quorum_produce(client, rs, [b"q0", b"q1"]) == 1
+        # a frozen follower (no eviction in this window) stalls the
+        # quorum: the produce APPENDS but times out un-acked
+        rid = sorted(rs.followers)[0]
+        rs.kill_follower(rid)
+        with pytest.raises(ProduceTimedOutError):
+            client.produce_many(T, [(None, b"stall", 0)], partition=0,
+                                timeout_ms=300)
+        assert leader.end_offset(T, 0) == 3  # appended, above the hwm
+        assert rs.state.quorum_hwm(T, 0) == 2
+    finally:
+        _teardown(srv, rs, client)
+
+
+def test_raw_produce_acks_all_quorum_and_rejection():
+    from iotml.ops.framing import frame_entries
+
+    leader, srv, rs = _leader_with_set()
+    client = KafkaWireBroker(f"127.0.0.1:{srv.port}")
+    try:
+        frames = frame_entries([(None, b"raw0", 0), (None, b"raw1", 0)],
+                               0)
+        with pytest.raises(NotEnoughReplicasError):
+            client.produce_raw(T, 0, frames)  # ISR not formed yet
+        assert leader.end_offset(T, 0) == 0
+        _form_isr(rs)
+        result = {}
+
+        def attempt():
+            try:
+                result["base"] = client.produce_raw(T, 0, frames)
+            except Exception as e:  # noqa: BLE001
+                result["err"] = e
+
+        t = threading.Thread(target=attempt, daemon=True,
+                             name="iotml-test-raw-quorum")
+        t.start()
+        deadline = time.monotonic() + 10
+        while t.is_alive() and time.monotonic() < deadline:
+            rs.sync_once()
+            time.sleep(0.002)
+        t.join(1.0)
+        assert result.get("base") == 0, result
+        # and the raw acks=1 leg skips the quorum wait entirely
+        more = frame_entries([(None, b"raw2", 0)], 0)
+        assert client.produce_raw(T, 0, more, acks=1) == 2
+    finally:
+        _teardown(srv, rs, client)
+
+
+# --------------------------------------------------- the read barrier
+def test_consumer_fetch_bounded_by_quorum_hwm():
+    leader, srv, rs = _leader_with_set(max_lag_s=30.0)
+    client = KafkaWireBroker(f"127.0.0.1:{srv.port}")
+    try:
+        _form_isr(rs)
+        _quorum_produce(client, rs, [b"v0", b"v1"])
+        # an acks=1 tail past the quorum: invisible to consumers on
+        # every read path until the followers mirror it
+        client.produce_many(T, [(None, b"tail", 0)], partition=0,
+                            acks=1)
+        assert leader.end_offset(T, 0) == 3
+        assert rs.state.quorum_hwm(T, 0) == 2
+        # wire fetch: clamped, and the reported hwm IS the quorum hwm
+        msgs = client.fetch(T, 0, 0, 100)
+        assert [m.value for m in msgs] == [b"v0", b"v1"]
+        assert client.last_hwm(T, 0) == 2
+        # raw fetch: the frame batch is cut at the barrier
+        raw = client.fetch_raw(T, 0, 0)
+        from iotml.ops.framing import iter_frame_entries
+
+        offs = [off for off, *_ in iter_frame_entries(raw.data)]
+        assert offs == [0, 1]
+        # in-process fetch on the leader broker: same barrier
+        assert [m.value for m in leader.fetch(T, 0, 0, 100)] == \
+            [b"v0", b"v1"]
+        assert leader.fetch_raw(T, 0, 2) is None
+        # the REPLICA path reads the tail (that is how it advances)
+        assert [m.value for m in leader.fetch_tail(T, 0, 0, 100)] == \
+            [b"v0", b"v1", b"tail"]
+        # followers mirror -> the barrier advances -> tail readable
+        for _ in range(10):
+            rs.sync_once()
+        assert [m.value for m in client.fetch(T, 0, 0, 100)] == \
+            [b"v0", b"v1", b"tail"]
+    finally:
+        _teardown(srv, rs, client)
+
+
+def test_truncate_frame_batch_cuts_at_frame_boundary():
+    from iotml.ops.framing import frame_entries, truncate_frame_batch
+
+    blob = frame_entries([(None, b"a", 0), (None, b"bb", 0),
+                          (None, b"ccc", 0)], 10)
+    cut = truncate_frame_batch(blob, 12)
+    from iotml.ops.framing import iter_frame_entries
+
+    assert [(off, v) for off, _k, v, _ts, _h
+            in iter_frame_entries(cut)] == [(10, b"a"), (11, b"bb")]
+    assert truncate_frame_batch(blob, 10) == b""
+    assert truncate_frame_batch(blob, 99) == blob
+
+
+# ------------------------------------------------ election + failover
+def test_election_is_isr_restricted():
+    leader, srv, rs = _leader_with_set(max_lag_s=0.2)
+    client = KafkaWireBroker(f"127.0.0.1:{srv.port}")
+    try:
+        _form_isr(rs)
+        _quorum_produce(client, rs, [b"v0", b"v1", b"v2"])
+        dead = sorted(rs.followers)[0]
+        survivor = sorted(rs.followers)[1]
+        rs.kill_follower(dead)
+        # the log must GROW for the frozen follower to become stale —
+        # a caught-up follower with nothing new to fetch stays in the
+        # ISR legitimately (Kafka's rule too)
+        client.produce_many(T, [(None, b"tail", 0)], partition=0,
+                            acks=1)
+        time.sleep(0.3)
+        rs.sync_once()
+        rs.state.evict_stale()
+        assert rs.state.isr_follower_ids() == {survivor}
+        # promoting the evicted follower is REFUSED
+        with pytest.raises(RuntimeError, match="not in the ISR"):
+            rs.promote(epoch=1, rid=dead)
+        rid, addr = rs.promote(epoch=1)
+        assert rid == survivor
+        promoted = KafkaWireBroker(addr)
+        assert [m.value for m in promoted.fetch(T, 0, 0, 100)] == \
+            [b"v0", b"v1", b"v2", b"tail"]
+        promoted.close()
+    finally:
+        _teardown(srv, rs, client)
+
+
+def test_survivors_rejoin_isr_after_promotion():
+    """A standalone ReplicaSet owns a private topology cell: after a
+    promotion the NON-promoted survivors re-resolve the new leader
+    through it and re-join the ISR — acks=all keeps working without
+    any external wiring (the reviewed bug: they reconnect-looped
+    against the dead leader's address forever)."""
+    leader, srv, rs = _leader_with_set(n_followers=3, min_isr=2,
+                                       max_lag_s=30.0)
+    client = KafkaWireBroker(f"127.0.0.1:{srv.port}")
+    try:
+        _form_isr(rs, width=4)
+        _quorum_produce(client, rs, [b"v0", b"v1"])
+        srv.kill()
+        rid, addr = rs.promote(epoch=1)
+        # two healthy survivors remain; they must re-point and re-join
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and \
+                len(rs.state.isr_follower_ids()) < 2:
+            rs.sync_once()
+        assert len(rs.state.isr_follower_ids()) == 2, \
+            rs.state.isr_follower_ids()
+        # and acks=all works against the promoted leader
+        c2 = KafkaWireBroker(addr)
+        assert _quorum_produce(c2, rs, [b"v2"]) == 2
+        c2.close()
+    finally:
+        _teardown(srv, rs, client)
+
+
+def test_cluster_topics_created_after_move_reach_the_new_leader():
+    """create_topic after a failover/reassignment must land on the
+    PROMOTED serving broker too (the reviewed bug: it answered
+    UNKNOWN_TOPIC for every topic created after its shard moved)."""
+    from iotml.cluster import ClusterController
+
+    ctl = ClusterController(brokers=3, replication_factor=3, min_isr=2,
+                            replica_sync="thread", max_lag_s=0.4)
+    ctl.start()
+    client = None
+    try:
+        ctl.create_topic(T, partitions=3)
+        for i in range(3):
+            assert ctl.replica_sets[i].await_isr(3, T, i, timeout_s=15)
+        ctl.drain_broker(shard=1)
+        ctl.create_topic("late-topic", partitions=3)
+        client = ctl.client(client_id="late-topic-client")
+        for attempt in range(5):
+            try:
+                client.produce("late-topic", b"x", partition=1)
+                break
+            except ConnectionError:
+                time.sleep(0.2)
+        assert len(client.fetch("late-topic", 1, 0, 10)) == 1
+    finally:
+        if client is not None:
+            client.close()
+        ctl.stop()
+
+
+def test_no_isr_member_refuses_promotion():
+    leader, srv, rs = _leader_with_set(max_lag_s=0.2)
+    try:
+        # nobody ever synced: promoting would serve a log with acked
+        # records missing — refused outright
+        with pytest.raises(RuntimeError, match="no in-sync replica"):
+            rs.elect()
+    finally:
+        _teardown(srv, rs)
+
+
+# ------------------------------------------------------- persistence
+def test_hwm_persists_across_remount(tmp_path):
+    from iotml.store.hwm import HwmFile
+
+    store = str(tmp_path / "leader")
+    leader, srv, rs = _leader_with_set(max_lag_s=30.0,
+                                       hwm_file=HwmFile(store),
+                                       store_dir=store)
+    client = KafkaWireBroker(f"127.0.0.1:{srv.port}")
+    try:
+        _form_isr(rs)
+        _quorum_produce(client, rs, [b"v0", b"v1"])
+        # an acks=1 tail the quorum never covered
+        client.produce_many(T, [(None, b"unreplicated", 0)],
+                            partition=0, acks=1)
+        leader.flush()  # on disk but above the quorum mark
+        rs.state.flush()
+    finally:
+        _teardown(srv, rs, client)
+    # remount: crash recovery resurrects the whole log, but the read
+    # barrier re-anchors at the persisted quorum HWM — consumers cannot
+    # see the tail that was never replicated
+    leader2 = Broker(store_dir=store)
+    assert leader2.end_offset(T, 0) == 3
+    state2 = ReplicationState(leader2, follower_ids=(999,),
+                              min_isr=2, hwm_file=HwmFile(store))
+    leader2.replication = state2
+    assert state2.quorum_hwm(T, 0) == 2
+    assert [m.value for m in leader2.fetch(T, 0, 0, 100)] == \
+        [b"v0", b"v1"]
+    # a re-formed quorum re-covers the tail and it becomes readable
+    state2.observe_fetch(999, T, 0, 3)
+    assert [m.value for m in leader2.fetch(T, 0, 0, 100)] == \
+        [b"v0", b"v1", b"unreplicated"]
+    leader2.close()
+
+
+# -------------------------------------------------------- elasticity
+def test_add_follower_bootstraps_via_raw_fetch_and_joins_isr():
+    leader, srv, rs = _leader_with_set(n_followers=1, min_isr=1,
+                                       max_lag_s=30.0)
+    client = KafkaWireBroker(f"127.0.0.1:{srv.port}")
+    try:
+        _form_isr(rs, width=2)
+        client.produce_many(T, [(None, f"r{i}".encode(), 0)
+                                for i in range(50)], partition=0,
+                            acks=1)
+        rid = rs.add_follower(sync="manual")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and \
+                rid not in rs.state.isr_follower_ids():
+            rs.sync_once()
+        assert rid in rs.state.isr_follower_ids()
+        rep = rs.followers[rid]
+        # the bootstrap rode the zero-copy mirror, byte-identical log
+        assert rep.raw_mirrored == 50
+        assert [m.value for m in rep.local.fetch(T, 0, 0, 100)] == \
+            [m.value for m in leader.fetch(T, 0, 0, 100)]
+        # retirement leaves the ISR first, quorum re-forms without it
+        rs.retire_follower(rid)
+        assert rid not in rs.state.isr_follower_ids()
+        assert rid not in rs.followers
+    finally:
+        _teardown(srv, rs, client)
+
+
+@pytest.mark.slow
+def test_cluster_quorum_mode_add_and_drain_under_writes():
+    """The cluster-level elasticity e2e (the drill runs it under
+    sustained threaded load; this is the deterministic version)."""
+    from iotml.cluster import ClusterController
+
+    ctl = ClusterController(brokers=3, replication_factor=3, min_isr=2,
+                            replica_sync="thread", max_lag_s=0.4)
+    ctl.start()
+    client = None
+    try:
+        ctl.create_topic(T, partitions=6)
+        for i in range(3):
+            assert ctl.replica_sets[i].await_isr(3, T, i, timeout_s=15)
+        client = ctl.client(client_id="test-elastic")
+        for p in range(6):
+            client.produce(T, f"pre-{p}".encode(), partition=p)
+        rep = ctl.add_broker(shard=1)
+        assert rep["state"] == "retired"
+        assert rep["raw_mirrored"] > 0  # zero-copy catch-up
+        assert ctl.pmap.epoch(1) == 1
+        # drain THROUGH the drained shard's own leader connection: the
+        # deferred retirement must flush the admin response before the
+        # old server dies
+        wire = KafkaWireBroker(ctl.pmap.leader(2))
+        drain = wire.cluster_admin("drain-broker", {"shard": 2})
+        wire.close()
+        assert drain["state"] == "retired"
+        # the remaining followers re-point at each promoted leader
+        # through the topology cell and RE-FORM the ISR — acks=all
+        # (the default) is refused until min_isr holds again
+        for i in (1, 2):
+            assert ctl.replica_sets[i].state.await_isr(
+                2, T, i, timeout_s=15), f"shard {i} ISR never re-formed"
+        # the cluster serves reads and writes after both moves
+        for p in range(6):
+            for attempt in range(5):
+                try:
+                    client.produce(T, f"post-{p}".encode(), partition=p)
+                    break
+                except ConnectionError:
+                    if attempt == 4:
+                        raise
+                    time.sleep(0.1)
+        total = sum(len(client.fetch(T, p, 0, 100)) for p in range(6))
+        assert total == 12
+    finally:
+        if client is not None:
+            client.close()
+        ctl.stop()
+
+
+def test_cluster_admin_unsupported_without_controller():
+    broker = Broker()
+    srv = KafkaWireServer(broker).start()
+    try:
+        client = KafkaWireBroker(f"127.0.0.1:{srv.port}")
+        with pytest.raises(NotImplementedError):
+            client.cluster_admin("status")
+        client.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# ------------------------------------------------------------- gauges
+def test_replication_gauges_and_healthz_section():
+    from iotml.obs import metrics as obs_metrics
+
+    leader, srv, rs = _leader_with_set(max_lag_s=30.0)
+    client = KafkaWireBroker(f"127.0.0.1:{srv.port}")
+    try:
+        _form_isr(rs)
+        assert obs_metrics.isr_size.value(topic=T, partition=0) == 3
+        client.produce_many(T, [(None, b"v", 0)], partition=0, acks=1)
+        rs.state.evict_stale()
+        rendered = obs_metrics.default_registry.render()
+        assert "iotml_isr_size" in rendered
+        assert "iotml_under_replicated_partitions" in rendered
+        assert "iotml_quorum_hwm_lag_records" in rendered
+    finally:
+        _teardown(srv, rs, client)
