@@ -124,10 +124,24 @@ def run_streaming_app(argv, *, prog: str, usage: str, make_model: Callable,
               f"{n_parts}-partition topic {topic}; idle")
     model = make_model()
 
-    # an explicitly-configured mesh (IOTML_MESH_* / --mesh.*) means the
-    # operator reserved multiple chips: train sharded over a ('data',
-    # 'model') mesh instead of single-device
+    # an explicitly-configured mesh (--mesh.* flags / config file, or the
+    # IOTML_MESH_DATA process knob) means the operator reserved multiple
+    # chips: train sharded over a ('data', 'model') mesh instead of
+    # single-device
     use_mesh = bool({"mesh.data", "mesh.model"} & applied)
+    # IOTML_MESH_DATA moved into the process-knob family (ISSUE 15,
+    # data/pipeline.py non_config) and no longer reaches cfg through the
+    # env resolver — but the deploy manifests' contract (that env var =
+    # data-axis chip count, deploy/model-training*.yaml) must keep
+    # holding, so the knob feeds the same decision here
+    from ..data.pipeline import mesh_data as _mesh_data_knob
+
+    knob = _mesh_data_knob()
+    if knob >= 2 and "mesh.data" not in applied:
+        # >= 2, matching the knob's contract ("1 behaves like 0") and
+        # cli.live's threshold — one env var, one meaning everywhere
+        cfg.mesh.data = knob
+        use_mesh = True
     if use_mesh:
         import jax
 

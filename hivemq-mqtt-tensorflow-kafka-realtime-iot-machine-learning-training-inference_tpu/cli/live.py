@@ -152,8 +152,23 @@ def main(argv=None) -> int:
                             "(0 = off); with IOTML_OBS_ENDPOINTS set "
                             "the endpoint auto-joins the fleet's "
                             "federation manifest (iotml.obs fleet)")
+        p.add_argument("--mesh-data", type=int, default=None,
+                       help="multi-chip streaming training: data-axis "
+                            "size of the device mesh (sets "
+                            "IOTML_MESH_DATA; 0/absent = single-chip). "
+                            "Each device consumes its own partition "
+                            "subset and the jitted step all-reduces "
+                            "gradients over the mesh (train only)")
+        p.add_argument("--device-normalize", default=None,
+                       choices=("0", "1"),
+                       help="fold the affine normalization into the "
+                            "jitted step so the host ships raw columns "
+                            "(sets IOTML_DEVICE_NORMALIZE; needs "
+                            "--mesh-data >= 2)")
 
     args = ap.parse_args(argv)
+    from ..data.pipeline import device_normalize as _dev_norm_knob
+    from ..data.pipeline import mesh_data as _mesh_knob
     from ..data.pipeline import set_knobs
 
     try:
@@ -161,9 +176,17 @@ def main(argv=None) -> int:
                   decode_ring_buffers=args.decode_ring_buffers,
                   raw_batch_bytes=args.raw_batch_bytes,
                   produce_batch_bytes=args.produce_batch_bytes,
-                  raw_produce=args.raw_produce)
+                  raw_produce=args.raw_produce,
+                  mesh_data=args.mesh_data,
+                  device_normalize=None if args.device_normalize is None
+                  else args.device_normalize == "1")
+        mesh_devices = _mesh_knob()
+        dev_norm = _dev_norm_knob()
     except ValueError as e:
         ap.error(str(e))
+    if dev_norm and mesh_devices < 2:
+        ap.error("IOTML_DEVICE_NORMALIZE=1 needs IOTML_MESH_DATA >= 2 "
+                 "(the affine fold lives in the sharded step)")
     if args.metrics_port:
         from ..obs.metrics import start_http_server
 
@@ -222,6 +245,20 @@ def main(argv=None) -> int:
     if args.cmd == "train":
         from ..train.live import ContinuousTrainer
 
+        mesh = None
+        if mesh_devices >= 2:
+            # the multi-chip path (IOTML_MESH_DATA): one data-axis mesh
+            # over the first N local devices, partition-parallel feeds,
+            # sharded jitted step — ARCHITECTURE §24
+            import jax
+
+            from ..parallel.mesh import make_mesh
+
+            if mesh_devices > len(jax.devices()):
+                ap.error(f"IOTML_MESH_DATA={mesh_devices} but only "
+                         f"{len(jax.devices())} local devices")
+            mesh = make_mesh((mesh_devices,), ("data",),
+                             devices=jax.devices()[:mesh_devices])
         svc = ContinuousTrainer(broker, args.topic, store,
                                 model_name=args.model_name, group=args.group,
                                 batch_size=args.batch_size,
@@ -230,11 +267,15 @@ def main(argv=None) -> int:
                                 normalizer=normalizer,
                                 backfill_since_ms=args.backfill_since_ms,
                                 registry=registry,
-                                checkpointer=checkpointer)
+                                checkpointer=checkpointer,
+                                mesh=mesh, device_normalize=dev_norm)
         print(f"live train: {args.topic} rounds of "
               f"{args.take_batches}x{args.batch_size} -> "
               f"{args.artifact_root}/{args.model_name}"
-              + (f" + registry {args.registry}" if registry else ""),
+              + (f" + registry {args.registry}" if registry else "")
+              + (f" [mesh data={mesh_devices}"
+                 f"{', device-normalize' if dev_norm else ''}]"
+                 if mesh is not None else ""),
               flush=True)
         rounds = svc.run(stop=stop, on_round=emit)
         svc.close()  # flush pending checkpoints, stop the writer

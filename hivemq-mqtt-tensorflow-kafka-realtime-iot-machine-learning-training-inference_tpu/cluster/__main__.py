@@ -155,6 +155,17 @@ def main(argv=None) -> int:
     up.add_argument("--produce-batch-bytes", type=int, default=None,
                     help="max frame bytes per RAW_PRODUCE request "
                          "(sets IOTML_PRODUCE_BATCH_BYTES)")
+    up.add_argument("--mesh-data", type=int, default=None,
+                    help="multi-chip streaming training for trainers "
+                         "launched from this process env (sets "
+                         "IOTML_MESH_DATA: data-axis devices; 0 = "
+                         "single-chip)")
+    up.add_argument("--device-normalize", default=None,
+                    choices=("0", "1"),
+                    help="fold normalization into the sharded train "
+                         "step — host pipelines ship raw columns (sets "
+                         "IOTML_DEVICE_NORMALIZE; needs --mesh-data "
+                         ">= 2)")
     up.add_argument("--metrics-port", type=int, default=0,
                     help="serve /metrics + /healthz (0 = off); with "
                          "IOTML_OBS_ENDPOINTS set the endpoint auto-"
@@ -192,12 +203,16 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     knob_names = ("prefetch_depth", "decode_ring_buffers",
                   "raw_batch_bytes", "raw_produce",
-                  "produce_batch_bytes")
-    if any(getattr(args, k, None) is not None for k in knob_names):
+                  "produce_batch_bytes", "mesh_data")
+    dev_norm = getattr(args, "device_normalize", None)
+    if dev_norm is not None or \
+            any(getattr(args, k, None) is not None for k in knob_names):
         from ..data.pipeline import set_knobs
 
         try:
-            set_knobs(**{k: getattr(args, k, None) for k in knob_names})
+            set_knobs(device_normalize=None if dev_norm is None
+                      else dev_norm == "1",
+                      **{k: getattr(args, k, None) for k in knob_names})
         except ValueError as e:
             ap.error(str(e))
     return args.fn(args)

@@ -88,7 +88,12 @@ class ScenarioConfig:
 
 @dataclasses.dataclass
 class MeshConfig:
-    """Device-mesh shape for pjit (data/model/sequence axes)."""
+    """Device-mesh shape for pjit (data/model/sequence axes).
+
+    NOTE: the env key ``IOTML_MESH_DATA`` is claimed by the multichip
+    streaming PROCESS knob (data/pipeline.py, non_config below) — the
+    ``data`` field here stays settable via ``--mesh.data`` and config
+    files."""
 
     data: int = -1      # -1 = all devices on the data axis
     model: int = 1
@@ -300,7 +305,12 @@ def load_config(argv: Optional[Sequence[str]] = None,
                   # and the metrics-endpoint manifest path the
                   # federation collector scrapes
                   "IOTML_WATERMARK", "IOTML_PROC",
-                  "IOTML_OBS_ENDPOINTS"}
+                  "IOTML_OBS_ENDPOINTS",
+                  # multi-chip streaming training (ISSUE 15): the data-
+                  # mesh size and the device-side normalization toggle
+                  # select the process's training machinery, same
+                  # family as the decode/prefetch knobs above
+                  "IOTML_MESH_DATA", "IOTML_DEVICE_NORMALIZE"}
     for key, value in env.items():
         if not key.startswith("IOTML_") or key in non_config:
             continue

@@ -88,6 +88,33 @@ class Normalizer:
                                    else jnp.dtype(self.dtype).name))
 
 
+class IdentityNormalizer:
+    """Raw-columns pass-through for DEVICE-side normalization (ISSUE 15).
+
+    When the affine map is folded into the jitted train step
+    (``parallel.data_parallel.ShardedTrainer(normalizer=...)``), the host
+    pipeline must ship the decoder's raw float32 columns untouched — this
+    is the batcher-side half of that contract.  ``np`` is a cast-only
+    view (no arithmetic, no copy when already float32): the last
+    per-element host work disappears, exactly what the multichip data
+    plane wants.  The device-side fold uses the REAL normalizer's
+    ``scale``/``shift``/``mask`` constants, so the two halves cannot
+    drift."""
+
+    def __init__(self, dtype=jnp.float32):
+        self.dtype = dtype
+        self._np_dtype = np.dtype(jnp.dtype(dtype).name)
+
+    def __call__(self, x):
+        return jnp.asarray(x, self.dtype)
+
+    def np(self, x: np.ndarray) -> np.ndarray:
+        return np.asarray(x).astype(self._np_dtype, copy=False)
+
+
+#: The one raw-columns instance the streaming pipelines share.
+RAW_COLUMNS = IdentityNormalizer()
+
 # The default normalizer used across the framework (reference parity mode).
 CAR_NORMALIZER = Normalizer(CAR_SCHEMA, parity=True)
 

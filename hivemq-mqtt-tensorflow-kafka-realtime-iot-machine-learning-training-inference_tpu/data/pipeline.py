@@ -35,6 +35,16 @@ contract):
   IOTML_PRODUCE_BATCH_BYTES  max frame bytes per RAW_PRODUCE request
                              (default 1 MiB); bigger accumulations are
                              split at frame boundaries
+  IOTML_MESH_DATA            data-axis size for the multi-chip streaming
+                             trainer (parallel.streaming): 0 (default) =
+                             single-chip legacy path; N >= 2 builds an
+                             N-device data mesh with partition-parallel
+                             feeds (cli.live train / cluster up)
+  IOTML_DEVICE_NORMALIZE     1 = fold the affine normalization into the
+                             jitted step (host ships raw columns);
+                             0 (default) = host-side normalization.
+                             Needs a mesh (the fold lives in the
+                             sharded step)
 """
 
 from __future__ import annotations
@@ -49,6 +59,7 @@ _DEFAULTS = {
     "IOTML_DECODE_RING_BUFFERS": (4, 2),
     "IOTML_RAW_BATCH_BYTES": (1 << 20, 4096),
     "IOTML_PRODUCE_BATCH_BYTES": (1 << 20, 4096),
+    "IOTML_MESH_DATA": (0, 0),
 }
 
 _RAW_PRODUCE_MODES = ("auto", "on", "off")
@@ -92,6 +103,25 @@ def produce_batch_bytes() -> int:
     return _env_int("IOTML_PRODUCE_BATCH_BYTES")
 
 
+def mesh_data() -> int:
+    """Multi-chip data-axis size (IOTML_MESH_DATA, default 0 = off).
+    1 behaves like 0 (a one-device mesh is the legacy path with extra
+    machinery); >= 2 engages partition-parallel sharded training."""
+    return _env_int("IOTML_MESH_DATA")
+
+
+def device_normalize() -> bool:
+    """Device-side normalization toggle (IOTML_DEVICE_NORMALIZE,
+    default off).  A malformed value fails loudly, like every knob."""
+    raw = os.environ.get("IOTML_DEVICE_NORMALIZE", "0").strip().lower()
+    if raw in ("", "0", "false", "off", "no"):
+        return False
+    if raw in ("1", "true", "on", "yes"):
+        return True
+    raise ValueError(f"env IOTML_DEVICE_NORMALIZE={raw!r}: expected a "
+                     f"boolean (0|1|true|false|on|off)")
+
+
 def raw_produce_mode() -> str:
     """Write-path plane selector (IOTML_RAW_PRODUCE): auto|on|off.
     A malformed value fails loudly, like every pipeline knob."""
@@ -108,7 +138,9 @@ def set_knobs(prefetch_depth: Optional[int] = None,
               decode_ring_buffers: Optional[int] = None,
               raw_batch_bytes: Optional[int] = None,
               produce_batch_bytes: Optional[int] = None,
-              raw_produce: Optional[str] = None) -> None:
+              raw_produce: Optional[str] = None,
+              mesh_data: Optional[int] = None,
+              device_normalize: Optional[bool] = None) -> None:
     """CLI → env bridge: publish the given knobs into this process's
     environment (validated; None = leave as-is) so every pipeline built
     afterwards — and every supervised component thread — reads them.
@@ -123,7 +155,8 @@ def set_knobs(prefetch_depth: Optional[int] = None,
                         ("IOTML_DECODE_RING_BUFFERS", decode_ring_buffers),
                         ("IOTML_RAW_BATCH_BYTES", raw_batch_bytes),
                         ("IOTML_PRODUCE_BATCH_BYTES",
-                         produce_batch_bytes)):
+                         produce_batch_bytes),
+                        ("IOTML_MESH_DATA", mesh_data)):
         if value is None:
             continue
         _default, lo = _DEFAULTS[name]
@@ -135,6 +168,9 @@ def set_knobs(prefetch_depth: Optional[int] = None,
         os.environ[name] = str(value)
     if raw_produce is not None:
         os.environ["IOTML_RAW_PRODUCE"] = mode
+    if device_normalize is not None:
+        os.environ["IOTML_DEVICE_NORMALIZE"] = \
+            "1" if bool(device_normalize) else "0"
 
 
 class _Slot:

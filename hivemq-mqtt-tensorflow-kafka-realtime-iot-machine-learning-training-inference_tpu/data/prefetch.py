@@ -34,8 +34,12 @@ class DevicePrefetcher:
     Args:
       batches: host batch iterable.
       to_device: maps a host batch to device arrays; defaults to
-        `jax.device_put` of `batch.x` (and `batch.y` when present), returning
-        (arrays, batch) so callers keep metadata (n_valid, first_index).
+        `jax.device_put` of `batch.x`, `batch.y` (when present) AND
+        `batch.mask` — the WHOLE batch follows `sharding` (ISSUE 15: a
+        sharded x paired with a default-device mask forces a resharding
+        copy inside the first jitted op that pairs them), returning
+        ((x, y, mask), batch) so callers keep metadata (n_valid,
+        first_index).
       depth: queue depth; 2 = classic double buffering.  None reads the
         process knob IOTML_PREFETCH_DEPTH (data/pipeline.py, default 2).
       sharding: optional `jax.sharding.Sharding` for direct sharded puts.
@@ -73,8 +77,12 @@ class DevicePrefetcher:
 
     def _default_to_device(self, batch):
         x = jax.device_put(batch.x, self.sharding)
-        y = jax.device_put(batch.y, self.sharding) if getattr(batch, "y", None) is not None else None
-        return (x, y), batch
+        y = jax.device_put(batch.y, self.sharding) \
+            if getattr(batch, "y", None) is not None else None
+        mask = getattr(batch, "mask", None)
+        if mask is not None:
+            mask = jax.device_put(mask, self.sharding)
+        return (x, y, mask), batch
 
     def _put(self, item) -> bool:
         """put that gives up when the consumer closed; never blocks forever."""

@@ -355,6 +355,22 @@ class DriftMonitor:
             self._episode_peak = self.ewma
         return fired
 
+    def begin_episode(self, signal: str) -> None:
+        """Externally-signalled drift: enter the ADAPTING episode
+        exactly as an internal fire would, handing the convergence /
+        re-anchor machinery the episode.  The mesh learner's PER-CHIP
+        detectors (ISSUE 15) come through here — each chip watches its
+        own shard's loss, but the data-parallel model is ONE model, so
+        any chip's drift is the fleet's drift and a no-op while already
+        adapting keeps N chips tripping on one drift to ONE episode."""
+        if self.state == ADAPTING:
+            return
+        self.drifts += 1
+        self.last_signal = signal
+        self.state = ADAPTING
+        self._adapting_for = 0
+        self._episode_peak = self.ewma if self.ewma is not None else 0.0
+
     # ----------------------------------------------------- transitions
     def _stabilize(self) -> None:
         """Adaptation over: re-anchor the baseline to the new normal

@@ -47,6 +47,17 @@ from ..train.loop import (Trainer, adam_injectable_cached,
 from .detectors import ADAPTING, DriftMonitor
 
 
+def _padded_shard_counts(mask: np.ndarray, n_shards: int) -> list:
+    """Per-chip valid-row counts under `ShardedTrainer.put_batch`'s
+    padding: rows pad up to a multiple of the data axis, shards are
+    contiguous row blocks, padding rows carry mask 0."""
+    b = len(mask)
+    r = b if b % n_shards == 0 else b + (n_shards - b % n_shards)
+    m = np.zeros((r,), np.float32)
+    m[:b] = mask
+    return [int(c) for c in m.reshape(n_shards, -1).sum(axis=1)]
+
+
 @dataclasses.dataclass
 class AdaptationPolicy:
     """Which adaptation a drift episode triggers.
@@ -110,7 +121,8 @@ class OnlineLearner:
                  normalizer=None, only_normal: bool = True,
                  publish_every: int = 20, buffer_batches: int = 32,
                  warm_start: bool = True, keep_versions: int = 0,
-                 fuse: int = 8):
+                 fuse: int = 8, mesh=None, device_normalize: bool = False,
+                 chip_monitors: Optional[list] = None):
         if model is None:
             from ..models.autoencoder import CAR_AUTOENCODER
 
@@ -136,6 +148,46 @@ class OnlineLearner:
         self._tx = adam_injectable_cached(learning_rate)
         self.trainer = Trainer(model, learning_rate=learning_rate,
                                tx=self._tx)
+        # mesh mode (ISSUE 15): the window step runs SHARDED over the
+        # data axis (rows → chips, gradient all-reduce over the mesh)
+        # and each chip gets its OWN DriftMonitor fed from its shard's
+        # per-row pre-update losses — a cohort drift that only one
+        # chip's rows carry trips that chip's detector even when the
+        # fleet-mean signal stays calm.  Coordination is ONE model +
+        # ONE registry: any chip's drift begins a single global episode
+        # (monitor.begin_episode) whose adaptation/publication rides
+        # the exact machinery below.  device_normalize ships raw
+        # columns and folds the affine map into the sharded step.
+        self.mesh = mesh
+        self._sharded = None
+        self.chip_monitors: list = []
+        self.last_chip_losses = None
+        self._chip_signal: Optional[tuple] = None
+        if mesh is not None:
+            from ..core.normalize import CAR_NORMALIZER
+            from ..parallel.data_parallel import ShardedTrainer
+            from ..parallel.streaming import data_axis_devices
+
+            n_dev = len(data_axis_devices(mesh))
+            self._sharded = ShardedTrainer(
+                model, mesh, tx=self._tx,
+                normalizer=(normalizer or CAR_NORMALIZER)
+                if device_normalize else None,
+                row_loss=True)
+            self.chip_monitors = list(chip_monitors) if chip_monitors \
+                else [DriftMonitor() for _ in range(n_dev)]
+            if len(self.chip_monitors) != n_dev:
+                raise ValueError(f"{len(self.chip_monitors)} chip "
+                                 f"monitors for a {n_dev}-device mesh")
+        elif chip_monitors:
+            raise ValueError("chip_monitors need a mesh")
+        if device_normalize:
+            if mesh is None:
+                raise ValueError("device_normalize needs a mesh (the "
+                                 "affine fold lives in the sharded step)")
+            from ..core.normalize import RAW_COLUMNS
+
+            normalizer = RAW_COLUMNS  # batcher ships raw columns
         self.checkpointer = checkpointer
         self.registry = registry
         if registry is not None and checkpointer is None:
@@ -234,12 +286,19 @@ class OnlineLearner:
         hp["learning_rate"] = jnp.asarray(lr, jnp.float32)
         self.trainer.state = st.replace(
             opt_state=st.opt_state._replace(hyperparams=hp))
+        if self._sharded is not None and self._sharded.state is not None:
+            # the sharded step trains from _sharded.state: the edit must
+            # land there too (the scalar re-places as replicated on the
+            # next dispatch — no recompile, same contract)
+            self._sharded.state = self.trainer.state
         obs_metrics.online_lr.set(float(lr))
 
     # ----------------------------------------------------------- update
     def _update(self, b) -> float:
         """One incremental step on one window; returns the pre-update
         loss (the drift signal)."""
+        if self._sharded is not None:
+            return self._update_mesh(b)
         self.trainer._ensure_state(b.x)
         with obs_metrics.train_step_seconds.time(), \
                 obs_metrics.step_seconds.time(loop="online",
@@ -247,6 +306,44 @@ class OnlineLearner:
             self.trainer.state, m = self.trainer._step(
                 self.trainer.state, b.x, b.x, b.mask)
         loss = float(m["loss"])
+        self.updates += 1
+        self.records_trained += b.n_valid
+        self.last_loss = loss
+        obs_metrics.online_updates.inc()
+        obs_metrics.records_trained.inc(b.n_valid)
+        self.buffer.append((b.x, b.mask))
+        return loss
+
+    def _update_mesh(self, b) -> float:
+        """The sharded window step: rows shard over chips, the global
+        loss is the drift signal as ever, and each chip's shard-mean
+        pre-update loss additionally feeds its own monitor — a chip
+        whose detector fires stages a coordinated episode that
+        `_after_update` opens on the global monitor."""
+        from ..parallel.streaming import shard_mean_losses
+
+        if self._sharded.state is None:
+            # adopt the (possibly warm-started) host state once
+            self.trainer._ensure_state(b.x)
+            self._sharded.init(b.x, from_state=self.trainer.state)
+        with obs_metrics.train_step_seconds.time(), \
+                obs_metrics.step_seconds.time(loop="online",
+                                              phase="device_compute"):
+            m = self._sharded.step(b.x, b.x, b.mask)
+        loss = float(m["loss"])
+        # mirror the CURRENT state: snapshot/publish and current_lr read
+        # self.trainer.state, and it must never be a stale donated buffer
+        self.trainer.state = self._sharded.state
+        counts = _padded_shard_counts(b.mask, len(self.chip_monitors))
+        chip = shard_mean_losses(m["row_loss"], counts)
+        self.last_chip_losses = chip
+        for i, (mon, cl, cnt) in enumerate(
+                zip(self.chip_monitors, chip, counts)):
+            if cnt <= 0:
+                continue  # all-padding shard: no signal to judge
+            sig = mon.update(float(cl))
+            if sig is not None:
+                self._chip_signal = (i, sig)
         self.updates += 1
         self.records_trained += b.n_valid
         self.last_loss = loss
@@ -304,8 +401,18 @@ class OnlineLearner:
         conv_before = self.monitor.converged
         signal = self.monitor.update(loss)
         obs_metrics.online_drift_stat.set(self.monitor.ph.stat)
+        chip_signal, self._chip_signal = self._chip_signal, None
         if signal is not None:
             self._adapt(signal)
+        elif chip_signal is not None and not was_adapting \
+                and self.monitor.state != ADAPTING:
+            # per-chip coordination (mesh mode): a chip-local drift the
+            # fleet mean diluted — open ONE global episode (the model
+            # is one model) and adapt at the tripping chip's severity
+            i, sig = chip_signal
+            tag = f"chip{i}-{sig}"
+            self.monitor.begin_episode(tag)
+            self._adapt(tag, severity=self.chip_monitors[i].severity())
         elif was_adapting and self.monitor.state != ADAPTING:
             # adaptation episode ended (converged or timed out):
             # restore the base LR and publish the adapted model — THIS
@@ -348,8 +455,12 @@ class OnlineLearner:
             if not group:
                 break
             while group:
-                # largest power-of-two chunk: bounded compile variants
-                k = 1 << (len(group).bit_length() - 1)
+                # largest power-of-two chunk: bounded compile variants.
+                # Mesh mode dispatches per window — the sharded step
+                # already amortizes over chips, and per-chip detectors
+                # want window-granular shard losses
+                k = 1 if self._sharded is not None \
+                    else 1 << (len(group).bit_length() - 1)
                 chunk, group = group[:k], group[k:]
                 losses = [self._update(chunk[0])] if k == 1 \
                     else self._update_group(chunk)
@@ -370,8 +481,9 @@ class OnlineLearner:
         return n
 
     # ------------------------------------------------------- adaptation
-    def _adapt(self, signal: str) -> None:
-        severity = self.monitor.severity()
+    def _adapt(self, signal: str, severity: Optional[float] = None) -> None:
+        if severity is None:
+            severity = self.monitor.severity()
         action = self.policy.choose(severity, len(self.buffer))
         self.adaptations.append((self.updates, signal, action))
         obs_metrics.online_drifts.inc(detector=signal)
@@ -396,8 +508,12 @@ class OnlineLearner:
         last = None
         for _ in range(self.policy.refit_epochs):
             for x, mask in list(self.buffer):
-                self.trainer.state, last = self.trainer._step(
-                    self.trainer.state, x, x, mask)
+                if self._sharded is not None:
+                    last = self._sharded.step(x, x, mask)
+                    self.trainer.state = self._sharded.state
+                else:
+                    self.trainer.state, last = self.trainer._step(
+                        self.trainer.state, x, x, mask)
                 self.records_trained += int(mask.sum())
         if last is not None:
             self.last_loss = float(last["loss"])
@@ -467,9 +583,12 @@ class OnlineLearner:
             self.checkpointer.stop(flush=True, timeout_s=timeout_s)
 
     def describe(self) -> dict:
-        return {"updates": self.updates,
-                "records_trained": self.records_trained,
-                "loss": self.last_loss, "lr": self.current_lr,
-                "adaptations": list(self.adaptations),
-                "monitor": self.monitor.describe(),
-                "published": list(self.published_versions)}
+        out = {"updates": self.updates,
+               "records_trained": self.records_trained,
+               "loss": self.last_loss, "lr": self.current_lr,
+               "adaptations": list(self.adaptations),
+               "monitor": self.monitor.describe(),
+               "published": list(self.published_versions)}
+        if self.chip_monitors:
+            out["chips"] = [m.describe() for m in self.chip_monitors]
+        return out

@@ -59,13 +59,44 @@ def shard_params(params, mesh: Mesh, model_axis: Optional[str] = "model"):
         lambda x, s: put_global(x, NamedSharding(mesh, s)), params, specs)
 
 
+def make_device_normalized_step(raw, normalizer):
+    """Fold the affine normalization map INTO the step (ISSUE 15).
+
+    The host pipeline ships RAW float32 columns (the batcher runs
+    ``core.normalize.RAW_COLUMNS``) and the map — one fused
+    ``(x * scale + shift) * mask`` XLA folds into whatever consumes it —
+    runs on each device over its own shard.  The constants come from the
+    SAME ``Normalizer`` the host path would use, so the two modes agree
+    to float32 rounding (~1 ulp: the host twin rounds once from float64,
+    the device computes in float32 — pinned by test).  Unsupervised
+    streams pass y=x, so the target is normalized identically."""
+    import numpy as np
+
+    scale = np.asarray(normalizer.scale, np.float32)
+    shift = np.asarray(normalizer.shift, np.float32)
+    maskv = np.asarray(normalizer.mask, np.float32)
+
+    def step(state, x, y, mask):
+        xn = (x * scale + shift) * maskv
+        yn = (y * scale + shift) * maskv
+        return raw(state, xn, yn, mask)
+
+    return step
+
+
 class ShardedTrainer:
     """Mesh-parallel twin of `train.Trainer`: same step math, jitted with
     explicit in/out shardings so batches land sharded and the gradient
-    all-reduce is compiled over the mesh."""
+    all-reduce is compiled over the mesh.
+
+    ``normalizer=`` folds the affine normalization onto the device (the
+    host ships raw columns — see `make_device_normalized_step`);
+    ``row_loss=True`` keeps the per-row pre-update loss sharded over
+    'data' in the metrics (the per-chip drift signal)."""
 
     def __init__(self, model, mesh: Mesh, rng=None, learning_rate: float = 1e-3,
-                 supervised: bool = False, tx=None, model_axis: str = "model"):
+                 supervised: bool = False, tx=None, model_axis: str = "model",
+                 normalizer=None, row_loss: bool = False):
         import optax
 
         self.model = model
@@ -74,6 +105,8 @@ class ShardedTrainer:
         self.tx = tx or optax.adam(learning_rate)
         self.supervised = supervised
         self.model_axis = model_axis
+        self.normalizer = normalizer
+        self.row_loss = row_loss
         self.state: Optional[TrainState] = None
         self._step = None
         self._data_sharding = batch_sharding(mesh)
@@ -85,28 +118,41 @@ class ShardedTrainer:
     def data_sharding(self) -> NamedSharding:
         return self._data_sharding
 
-    def init(self, sample_x):
-        state = TrainState.create(self.model, self.rng, sample_x, tx=self.tx)
+    def init(self, sample_x, from_state: Optional[TrainState] = None):
+        """Build (or adopt — warm start) the state and compile the step.
+
+        ``from_state`` shards an existing HOST TrainState instead of a
+        fresh init: the registry warm-start path (`mlops.restore_trainer`
+        fills a host state, the mesh adopts it)."""
+        state = from_state if from_state is not None else \
+            TrainState.create(self.model, self.rng, sample_x, tx=self.tx)
         pspecs = param_specs(state.params, self.mesh, self.model_axis)
         params = shard_params(state.params, self.mesh, self.model_axis)
         opt_state = jax.tree.map(
             lambda a: put_global(a, replicated(self.mesh)), state.opt_state)
         self.state = state.replace(params=params, opt_state=opt_state)
 
-        raw = make_raw_train_step(self.model, self.tx, self.supervised)
+        raw = make_raw_train_step(self.model, self.tx, self.supervised,
+                                  row_loss=self.row_loss)
+        if self.normalizer is not None:
+            raw = make_device_normalized_step(raw, self.normalizer)
         state_shardings = TrainState(
             step=replicated(self.mesh),
             params=jax.tree.map(lambda s: NamedSharding(self.mesh, s), pspecs),
             opt_state=jax.tree.map(lambda _: replicated(self.mesh),
                                    self.state.opt_state),
             apply_fn=self.model.apply, tx=self.tx)
+        metric_shardings = {"loss": replicated(self.mesh),
+                            "accuracy": replicated(self.mesh)}
+        if self.row_loss:
+            # each device's rows stay on their chip: no collective, and
+            # the host reads per-chip means from addressable shards
+            metric_shardings["row_loss"] = self._data_sharding
         self._step = jax.jit(
             raw,
             in_shardings=(state_shardings, self._data_sharding,
                           self._data_sharding, self._data_sharding),
-            out_shardings=(state_shardings,
-                           {"loss": replicated(self.mesh),
-                            "accuracy": replicated(self.mesh)}),
+            out_shardings=(state_shardings, metric_shardings),
             donate_argnums=(0,))
         return self.state
 

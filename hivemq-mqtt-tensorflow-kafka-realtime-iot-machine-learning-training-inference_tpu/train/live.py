@@ -78,7 +78,8 @@ class ContinuousTrainer:
                  learning_rate: float = 1e-3, normalizer=None,
                  backfill_since_ms: Optional[int] = None,
                  registry=None, checkpointer=None, warm_start: bool = True,
-                 checkpoint_interval_s: float = 0.0):
+                 checkpoint_interval_s: float = 0.0,
+                 mesh=None, device_normalize: bool = False):
         if model is None:
             from ..models.autoencoder import CAR_AUTOENCODER
 
@@ -95,7 +96,43 @@ class ContinuousTrainer:
         self.batch_size = batch_size
         self.take_batches = take_batches
         self.epochs_per_round = epochs_per_round
-        self.trainer = Trainer(model, learning_rate=learning_rate)
+        # mesh mode (ISSUE 15): partition-parallel columnar feeds into a
+        # sharded train step — each data-axis device owns a partition
+        # subset and a take_batches round trains D× the records of the
+        # single-chip shape.  device_normalize additionally folds the
+        # affine normalization into the jitted step (feeds ship raw
+        # columns).  Checkpoints/restore ride the SAME surface: the
+        # sharded state gathers host-side at snapshot, so a manifest
+        # stamps every device's cursors as one atomic unit.
+        self.mesh = mesh
+        if device_normalize and mesh is None:
+            # same contract as OnlineLearner: the affine fold lives in
+            # the sharded step — silently falling back to host
+            # normalization would mask a misconfiguration
+            raise ValueError("device_normalize needs a mesh (the affine "
+                             "fold lives in the sharded step)")
+        if mesh is not None:
+            if epochs_per_round != 1:
+                raise ValueError("mesh streaming rounds are single-epoch "
+                                 "(the cursor is the slice)")
+            from ..core.normalize import CAR_NORMALIZER
+            from ..parallel.streaming import (MeshFeeds,
+                                              ShardedStreamTrainer)
+
+            n_dev = mesh.shape["data"]
+            feeds = MeshFeeds(broker, topic, n_dev, group=group,
+                              batch_size=batch_size,
+                              take_batches=take_batches,
+                              only_normal=only_normal,
+                              normalizer=normalizer,
+                              device_normalize=device_normalize,
+                              poll_chunk=8192)
+            self.trainer = ShardedStreamTrainer(
+                model, mesh, feeds, learning_rate=learning_rate,
+                normalizer=(normalizer or CAR_NORMALIZER)
+                if device_normalize else None)
+        else:
+            self.trainer = Trainer(model, learning_rate=learning_rate)
         # versioned-registry mode (iotml.mlops): checkpoints publish
         # async into the registry, each stamped with the cursors it was
         # trained through, and the GROUP COMMIT trails checkpoint
@@ -116,9 +153,15 @@ class ContinuousTrainer:
         self._parts = list(parts)
         # ONE persistent cursor for the process lifetime: rebuilding a
         # consumer per round (and re-reading committed offsets) was the
-        # dominant cost of the naive loop
-        self.consumer = StreamConsumer.from_committed(broker, topic, parts,
-                                                      group=group)
+        # dominant cost of the naive loop.  Mesh mode: the feeds ARE the
+        # cursor — one facade over every device's consumer, positions()
+        # spanning all partitions so offsets-as-checkpoint still names
+        # the whole trained frontier.
+        if mesh is not None:
+            self.consumer = self.trainer.feeds
+        else:
+            self.consumer = StreamConsumer.from_committed(
+                broker, topic, parts, group=group)
         # registry warm start: reload the newest committed version's
         # weights (+ optimizer moments when archived) and its stamped
         # offsets — the manifest beats BOTH offset 0 and backfill for
@@ -164,17 +207,28 @@ class ContinuousTrainer:
         # broker process (expensive when that process is busy), and the
         # batcher's poll budgeting (_need_rows) guarantees a bounded
         # iteration never over-polls past the `take` boundary
-        batch_kw = {} if normalizer is None else dict(normalizer=normalizer)
-        self.batches = SensorBatches(self.consumer, batch_size=batch_size,
-                                     take=take_batches,
-                                     only_normal=only_normal,
-                                     poll_chunk=8192, **batch_kw)
+        if mesh is None:
+            batch_kw = {} if normalizer is None \
+                else dict(normalizer=normalizer)
+            self.batches = SensorBatches(self.consumer,
+                                         batch_size=batch_size,
+                                         take=take_batches,
+                                         only_normal=only_normal,
+                                         poll_chunk=8192, **batch_kw)
+        else:
+            # the per-device batchers live inside the feeds; rounds are
+            # driven through the sharded trainer's fit_compiled shim
+            self.batches = None
         self.rounds = 0
         self.records_trained = 0
         self.last_loss: Optional[float] = None
         #: new records required before a round starts — padded ~10% over
-        #: the round size so the label filter cannot starve the last batch
-        self.min_available = int(take_batches * batch_size * 1.1) + 1
+        #: the round size so the label filter cannot starve the last
+        #: batch; a mesh round consumes one take_batches budget PER
+        #: device
+        round_records = take_batches * batch_size * \
+            (mesh.shape["data"] if mesh is not None else 1)
+        self.min_available = int(round_records * 1.1) + 1
 
     # ------------------------------------------------------------ rounds
     def available(self) -> int:

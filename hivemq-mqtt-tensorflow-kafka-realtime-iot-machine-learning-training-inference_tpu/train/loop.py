@@ -91,9 +91,17 @@ def make_loss_fn(model, supervised: bool = False):
     return loss_fn
 
 
-def make_raw_train_step(model, tx, supervised: bool = False):
+def make_raw_train_step(model, tx, supervised: bool = False,
+                        row_loss: bool = False):
     """Un-jitted step — `parallel.data_parallel` re-jits it with mesh
-    shardings; single-chip callers use `make_train_step`."""
+    shardings; single-chip callers use `make_train_step`.
+
+    ``row_loss=True`` adds ``metrics["row_loss"]``: the per-row masked
+    pre-update MSE ([B], padding rows 0).  Under a mesh the vector stays
+    sharded over 'data', so each device's rows land back on their own
+    chip — the per-chip drift-detector signal (iotml.online) at zero
+    collective cost; scan paths that ignore it have it dead-code
+    eliminated."""
     loss_fn = make_loss_fn(model, supervised)
 
     def step(state: TrainState, x, y, mask):
@@ -102,6 +110,10 @@ def make_raw_train_step(model, tx, supervised: bool = False):
         updates, opt_state = state.tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss, "accuracy": _keras_accuracy(pred, target, mask)}
+        if row_loss:
+            per_elem = jnp.square(pred - target)
+            metrics["row_loss"] = jnp.mean(
+                per_elem.reshape(per_elem.shape[0], -1), axis=-1) * mask
         return state.replace(step=state.step + 1, params=params,
                              opt_state=opt_state), metrics
 

@@ -59,11 +59,16 @@ def test_bool_coercion_and_errors():
 
 
 def test_applied_keys_tracked():
+    # IOTML_MESH_DATA is claimed by the multichip PROCESS knob since
+    # ISSUE 15 (data/pipeline.py, non_config) — mesh.data stays
+    # settable via flags/file; the env probe uses mesh.model instead
     cfg, _ = load_config(["--train.epochs=7"],
-                         env={"IOTML_MESH_DATA": "4"})
+                         env={"IOTML_MESH_MODEL": "4"})
     assert "train.epochs" in cfg.applied
-    assert "mesh.data" in cfg.applied
+    assert "mesh.model" in cfg.applied
     assert "train.batch_size" not in cfg.applied
+    cfg2, _ = load_config(["--mesh.data=4"], env={})
+    assert "mesh.data" in cfg2.applied  # the flag path still works
 
 
 def test_dumps_roundtrip(tmp_path):

@@ -15,8 +15,9 @@ def _batches(n=6, b=16):
 
 def test_prefetch_delivers_all_in_order():
     got = []
-    for (x, y), b in DevicePrefetcher(_batches(6)):
+    for (x, y, mask), b in DevicePrefetcher(_batches(6)):
         assert y is None
+        assert int(np.asarray(mask).sum()) == b.n_valid
         got.append((float(np.asarray(x)[0, 0]), b.first_index))
     assert got == [(float(i), i * 16) for i in range(6)]
 
