@@ -9,8 +9,11 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 0/5 concurrency & protocol-invariant lint (iotml.analysis)"
-python -m iotml.analysis lint
+echo "== 0/5 whole-program contract analysis (iotml.analysis: lint +"
+echo "        protocol conformance + trace discipline + registry drift,"
+echo "        one shared parse; then the static lock-order extraction)"
+python -m iotml.analysis all
+python -m iotml.analysis lockorder
 
 echo "== 1/5 chaos drills: seeded failure scenarios, invariant-checked"
 JAX_PLATFORMS=cpu python -m iotml.chaos run --scenario mqtt-flap \

@@ -93,6 +93,8 @@ import os
 import re
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
+from .program import FileUnit, Program, iter_py_files
+
 # APIs the wire client may auto-retry after a reconnect: a duplicate of
 # any of these is invisible (reads) or a no-op (liveness signal).  Kept
 # in sync with kafka_wire.IDEMPOTENT_APIS by tests/test_analysis.py.
@@ -272,10 +274,13 @@ _REGISTRY_PATH_NAME_RE = re.compile(
     r"registry_dir|registry_root|version_dir|artifact_path"
     r"|manifest\.json|model_registry", re.IGNORECASE)
 
-# R\d+ not R\d: two-digit rules exist since R10, and the single-digit
-# form silently failed to parse their suppressions (the lint-ok line
-# then neither suppressed nor flagged-as-reasonless — it just lied)
-_SUPPRESS_RE = re.compile(r"#\s*lint-ok:\s*(R\d+)\b[ \t]*(.*)")
+# [A-Z]\d+ not R\d: two-digit rules exist since R10, and the
+# single-digit form silently failed to parse their suppressions (the
+# lint-ok line then neither suppressed nor flagged-as-reasonless — it
+# just lied); the letter class covers the whole-program passes' finding
+# families too (P* protocol, T* tracecheck, D* drift) so one
+# suppression mechanism serves every pass
+_SUPPRESS_RE = re.compile(r"#\s*lint-ok:\s*([A-Z]\d+)\b[ \t]*(.*)")
 _RETRY_OK_RE = re.compile(r"#\s*retry-ok:[ \t]*(.*)")
 _WALLCLOCK_RE = re.compile(r"#\s*wallclock-ok:[ \t]*(.*)")
 
@@ -505,14 +510,16 @@ class _ModuleCallGraph:
 # ----------------------------------------------------------------- checker
 class _FileLinter(ast.NodeVisitor):
     def __init__(self, path: str, rel: str, tree: ast.Module,
-                 sup: _Suppressions, rules: Set[str]):
+                 sup: _Suppressions, rules: Set[str],
+                 graph: Optional[_ModuleCallGraph] = None):
         self.path = path
         self.rel = rel
         self.sup = sup
         self.rules = rules
         self.findings: List[Finding] = list(sup.findings)
-        self.graph = _ModuleCallGraph(tree) \
-            if rules & {"R4", "R6"} else None
+        if graph is None and rules & {"R4", "R6"}:
+            graph = _ModuleCallGraph(tree)
+        self.graph = graph
         parts = rel.replace(os.sep, "/").split("/")
         self.r1_scoped = any(seg in parts for seg in R1_PATH_SEGMENTS)
         self.in_streamproc = "streamproc" in parts
@@ -971,44 +978,57 @@ class _FileLinter(ast.NodeVisitor):
 
 
 # --------------------------------------------------------------- driver
-def _iter_py_files(paths: Iterable[str]) -> Iterable[Tuple[str, str]]:
-    """Yield (abs_path, display_rel_path) for every .py under `paths`."""
-    skip_dirs = {"__pycache__", "build", ".git", ".venv", "node_modules"}
-    for root in paths:
-        root = os.path.abspath(root)
-        if os.path.isfile(root):
-            yield root, os.path.basename(root)
-            continue
-        base = os.path.dirname(root)
-        for dirpath, dirnames, filenames in os.walk(root):
-            dirnames[:] = sorted(d for d in dirnames if d not in skip_dirs)
-            for fn in sorted(filenames):
-                if fn.endswith(".py"):
-                    p = os.path.join(dirpath, fn)
-                    yield p, os.path.relpath(p, base)
+# directory walk relocated to program.py (shared with the whole-program
+# passes); the old private name stays importable for callers/tests
+_iter_py_files = iter_py_files
 
 
-def lint_file(path: str, rel: Optional[str] = None,
+def suppressions_for(unit: FileUnit) -> _Suppressions:
+    """The unit's suppression table — parsed once, shared across lint
+    and the whole-program passes (one `# lint-ok:` mechanism)."""
+    return unit.cached(
+        "suppressions", lambda u: _Suppressions(u.path, u.source))
+
+
+def call_graph_for(unit: FileUnit) -> Optional[_ModuleCallGraph]:
+    """The unit's module-local call graph (R4's walker) — built once,
+    shared with tracecheck/protocol/lockorder reachability walks."""
+    if unit.tree is None:
+        return None
+    return unit.cached("callgraph", lambda u: _ModuleCallGraph(u.tree))
+
+
+def lint_unit(unit: FileUnit,
               rules: Optional[Set[str]] = None) -> List[Finding]:
-    rel = rel if rel is not None else path
+    """Lint one pre-parsed unit (the parse-once entry point)."""
     rules = rules or set(RULES)
-    with open(path, "r", encoding="utf-8") as f:
-        source = f.read()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return [Finding(path, e.lineno or 0, "PARSE", f"syntax error: {e.msg}")]
-    sup = _Suppressions(path, source)
-    linter = _FileLinter(path, rel, tree, sup, rules)
-    linter.visit(tree)
+    if unit.tree is None:
+        e = unit.parse_error
+        return [Finding(unit.path, (e.lineno or 0) if e else 0, "PARSE",
+                        f"syntax error: {e.msg if e else 'unparseable'}")]
+    sup = suppressions_for(unit)
+    graph = call_graph_for(unit) if rules & {"R4", "R6"} else None
+    linter = _FileLinter(unit.path, unit.rel, unit.tree, sup, rules,
+                         graph=graph)
+    linter.visit(unit.tree)
     return sorted(linter.findings, key=lambda f: (f.path, f.line, f.rule))
 
 
+def lint_file(path: str, rel: Optional[str] = None,
+              rules: Optional[Set[str]] = None,
+              program: Optional[Program] = None) -> List[Finding]:
+    program = program if program is not None else Program()
+    return lint_unit(program.unit(path, rel=rel if rel is not None
+                                  else path), rules)
+
+
 def lint_paths(paths: Iterable[str],
-               rules: Optional[Set[str]] = None) -> List[Finding]:
+               rules: Optional[Set[str]] = None,
+               program: Optional[Program] = None) -> List[Finding]:
+    program = program if program is not None else Program()
     out: List[Finding] = []
-    for path, rel in _iter_py_files(paths):
-        out.extend(lint_file(path, rel, rules))
+    for unit in program.units(paths):
+        out.extend(lint_unit(unit, rules))
     return out
 
 

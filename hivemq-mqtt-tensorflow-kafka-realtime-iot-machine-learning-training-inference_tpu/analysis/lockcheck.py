@@ -90,6 +90,34 @@ class State:
                      f"{acquire_at}); opposite-order acquisition can "
                      f"deadlock", key=f"cycle:{held_site}|{new_site}")
 
+    def preseed_static(self, edges) -> int:
+        """Insert statically-derived acquire-order edges (analysis
+        .lockorder) so runtime acquisitions are checked against orders
+        the code can express even when this run never executes them.
+        A cycle already present among the seeded edges is reported as
+        kind 'static-cycle' (a warning unless strict mode promotes it);
+        a RUNTIME edge that later closes a cycle through seeded edges
+        fails via the ordinary ``record_edge`` detection."""
+        n = 0
+        for a, b, where in edges:
+            if a == b:
+                continue
+            with self._mu:
+                if (a, b) in self.edges:
+                    continue
+                path = self._path(b, a)
+                self.edges[(a, b)] = f"static:{where}"
+                self.graph.setdefault(a, set()).add(b)
+            n += 1
+            if path is not None:
+                cycle = " -> ".join([a, b] + path[1:])
+                self.add("static-cycle",
+                         f"statically-derived lock-order cycle: {cycle} "
+                         f"(edge from source at {where}); opposite-order "
+                         f"acquisition paths both exist in the code",
+                         key=f"static-cycle:{a}|{b}")
+        return n
+
     def _path(self, src: str, dst: str) -> Optional[List[str]]:
         """DFS path src→dst in the order graph (caller holds _mu)."""
         stack = [(src, [src])]

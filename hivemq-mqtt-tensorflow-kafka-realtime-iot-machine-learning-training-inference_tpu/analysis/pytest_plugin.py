@@ -1,14 +1,28 @@
-"""pytest plugin: run the suite under the runtime lock-order detector.
+"""pytest plugin: runtime halves of the analysis passes.
 
-Activate with ``-p iotml.analysis.pytest_plugin`` or ``IOTML_LOCKCHECK=1``
-(tests/conftest.py registers this module when the env var is set).  The
-detector is installed at configure time — before any test constructs a
-broker/server — so every lock the stream stack creates is checked.
+Two independently-gated detectors:
 
-At session end the collected report is printed; **lock-order cycles fail
-the run** (exit status 3).  I/O-under-lock and unguarded-mutation
-findings are reported as warnings only, unless ``IOTML_LOCKCHECK_STRICT=1``
-promotes them to failures too.
+- **lockcheck** (``IOTML_LOCKCHECK=1`` or
+  ``-p iotml.analysis.pytest_plugin``): the suite runs under the
+  runtime lock-order & race detector, installed at configure time —
+  before any test constructs a broker/server — so every lock the
+  stream stack creates is checked.  The acquisition graph is
+  PRE-SEEDED with the statically-extracted acquire-order edges
+  (analysis.lockorder), so a runtime acquisition that inverts an order
+  the code merely *can* express still closes a cycle and fails the
+  run, even when this session never executed the opposite path.  At
+  session end the collected report is printed; **lock-order cycles
+  fail the run** (exit status 3).  I/O-under-lock, unguarded-mutation
+  and static-only-cycle findings are warnings unless
+  ``IOTML_LOCKCHECK_STRICT=1`` promotes them to failures too.
+
+- **trace guard** (``IOTML_TRACECHECK=1``): the known JAX hot loops
+  (``Trainer.fit_compiled``, ``ShardedStreamTrainer.fit_round``,
+  ``OnlineLearner._update``) are wrapped with the recompile guard
+  (analysis.tracecheck): after a loop's warm-up call, any call with an
+  identical shape/dtype signature that triggers a fresh XLA backend
+  compile raises ``RecompileError`` and fails that test.  Warm state
+  resets per test so one test's warm-up cannot mask another's retrace.
 """
 
 from __future__ import annotations
@@ -18,17 +32,43 @@ import os
 from . import lockcheck
 
 
+def _env_on(name: str) -> bool:
+    return os.environ.get(name, "") not in ("", "0")
+
+
 def pytest_configure(config):
-    lockcheck.install()
+    trace_on = _env_on("IOTML_TRACECHECK")
+    # legacy `-p iotml.analysis.pytest_plugin` means lockcheck; only a
+    # tracecheck-only session skips installing it
+    if lockcheck.enabled_by_env() or not trace_on:
+        st = lockcheck.install()
+        from . import lockorder
+
+        lockorder.preseed(st)
+    if trace_on:
+        from . import tracecheck
+
+        config._iotml_traceguard = tracecheck.install_runtime_guard()
+
+
+def pytest_runtest_setup(item):
+    if _env_on("IOTML_TRACECHECK"):
+        from . import tracecheck
+
+        tracecheck.reset_warm()
 
 
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
-    st = lockcheck.state()
-    if st is None:
-        return
     tw = terminalreporter
-    tw.section("iotml lockcheck")
-    tw.write_line(st.report())
+    st = lockcheck.state()
+    if st is not None:
+        tw.section("iotml lockcheck")
+        tw.write_line(st.report())
+    patched = getattr(config, "_iotml_traceguard", None)
+    if patched is not None:
+        tw.section("iotml tracecheck")
+        tw.write_line(
+            f"recompile guard armed on: {', '.join(patched) or 'nothing'}")
 
 
 def pytest_sessionfinish(session, exitstatus):

@@ -284,6 +284,7 @@ def load_config(argv: Optional[Sequence[str]] = None,
     # around the process, not the pipeline inside it
     non_config = {"IOTML_CONFIG", "IOTML_TEST_PLATFORM",
                   "IOTML_LOCKCHECK", "IOTML_LOCKCHECK_STRICT",
+                  "IOTML_TRACECHECK",
                   "IOTML_TRACE", "IOTML_TRACE_SAMPLE", "IOTML_TRACE_PATH",
                   "IOTML_CHAOS", "IOTML_CHAOS_SEED",
                   "IOTML_CHAOS_SCENARIO", "IOTML_CHAOS_RECORDS",

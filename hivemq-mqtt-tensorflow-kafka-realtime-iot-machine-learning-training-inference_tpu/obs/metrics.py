@@ -453,6 +453,37 @@ ALLOWED_LABEL_KEYS = frozenset({
 #: below what one runaway per-entity label produces in seconds.
 MAX_LABEL_SERIES = 256
 
+#: per-metric label DECLARATIONS: the exact label keys each labeled
+#: metric may be recorded with.  ALLOWED_LABEL_KEYS bounds the
+#: vocabulary; this table bounds each metric's dimensions — a record
+#: site using a key missing from its row is registry drift (analysis
+#: rule D2), caught before the new dimension multiplies series in
+#: production.  Metrics absent from the table take no labels.
+DECLARED_METRIC_LABELS = {
+    "chaos_injected": ("fault",),
+    "checkpoint_seconds": ("phase",),
+    "consumer_autoresets": ("topic",),
+    "consumer_lag_records": ("group", "partition", "topic"),
+    "dlq_total": ("source",),
+    "isr_size": ("partition", "topic"),
+    "model_offsets_lag": ("component",),
+    "model_version": ("component",),
+    "online_adaptations": ("action",),
+    "online_drifts": ("detector",),
+    "prefetch_occupancy": ("loop",),
+    "quorum_hwm_lag": ("partition", "topic"),
+    "replica_lag": ("topic",),
+    "rollouts": ("outcome",),
+    "step_seconds": ("loop", "phase"),
+    "supervisor_degraded": ("unit",),
+    "supervisor_failovers": ("unit",),
+    "supervisor_restarts": ("unit",),
+    "supervisor_unit_up": ("unit",),
+    "supervisor_wedged": ("unit",),
+    "watermark_event_ms": ("group", "partition", "stage", "topic"),
+    "watermark_lag_seconds": ("group", "partition", "stage", "topic"),
+}
+
 
 def cardinality_violations(registry: "Registry" = None,
                            max_series: int = MAX_LABEL_SERIES):

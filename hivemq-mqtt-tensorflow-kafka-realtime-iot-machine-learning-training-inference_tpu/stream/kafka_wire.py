@@ -93,6 +93,10 @@ ERR_TOPIC_EXISTS = 36
 ERR_SASL_AUTH_FAILED = 58
 ERR_INVALID_CONFIG = 40
 ERR_FENCED_LEADER_EPOCH = 74  # Kafka's own fencing error code
+# Kafka's UNKNOWN_SERVER_ERROR: the CLUSTER_ADMIN handler answers it
+# when a reassignment verb raises — named so clients can map it typed
+# (the protocol-conformance pass rejects bare numeric codes)
+ERR_UNKNOWN_SERVER = -1
 
 _SUPPORTED = {PRODUCE: (2, 2), FETCH: (2, 2), LIST_OFFSETS: (1, 1),
               METADATA: (1, 1), OFFSET_COMMIT: (2, 2), OFFSET_FETCH: (1, 1),
@@ -710,6 +714,12 @@ class KafkaWireBroker(ProducePartitionMixin):
             raise ConnectionError("correlation id mismatch in handshake")
         err = r.i16()
         mechanisms = r.array(lambda rd: rd.string())
+        if err == ERR_SASL_AUTH_FAILED:
+            # the server rejected the MECHANISM (not the credentials —
+            # those are checked on the raw token exchange below)
+            raise SaslAuthError(
+                f"server rejected SASL mechanism PLAIN; offers "
+                f"{mechanisms}")
         if err != ERR_NONE:
             raise SaslAuthError(
                 f"SASL handshake failed ({err}); server offers {mechanisms}")
@@ -725,6 +735,10 @@ class KafkaWireBroker(ProducePartitionMixin):
             w.i32(-1)
         else:
             w.array(topics, lambda wr, t: wr.string(t))
+        # lint-ok: P3 metadata reports existence per topic: unknown
+        # topics carry ERR_UNKNOWN_TOPIC in their row and are simply
+        # left out of the leaders map — absence IS the answer, not an
+        # error to raise
         r = self._request(METADATA, 1, bytes(w.buf))
 
         def broker(rd):
@@ -905,6 +919,17 @@ class KafkaWireBroker(ProducePartitionMixin):
                         f"produce to {topic}:{p} appended but the "
                         f"quorum HWM did not reach it in time; unacked "
                         f"— the caller redelivers (at-least-once)")
+                if err == ERR_INVALID_REQUIRED_ACKS:
+                    raise ValueError(
+                        f"produce to {topic}:{p} refused: required_acks "
+                        f"must be -1, 0 or 1; nothing appended")
+                if err == ERR_UNKNOWN_TOPIC:
+                    raise KeyError(topic)
+                if err == ERR_TOPIC_AUTHORIZATION_FAILED:
+                    raise PermissionError(
+                        f"produce to {topic}:{p} refused: the topic is "
+                        f"restricted to its owning engine "
+                        f"(Broker.restrict_topic); nothing appended")
                 if err != ERR_NONE:
                     raise RuntimeError(f"produce to {topic}:{p} failed: {err}")
                 last = max(last, base + len(by_part[p]) - 1)
@@ -956,6 +981,17 @@ class KafkaWireBroker(ProducePartitionMixin):
             raise ProduceTimedOutError(
                 f"raw produce to {topic}:{partition} appended but "
                 f"unacked within the timeout — the caller redelivers")
+        if err == ERR_INVALID_REQUIRED_ACKS:
+            raise ValueError(
+                f"raw produce to {topic}:{partition} refused: "
+                f"required_acks must be -1, 0 or 1; nothing appended")
+        if err == ERR_UNKNOWN_TOPIC:
+            raise KeyError(topic)
+        if err == ERR_TOPIC_AUTHORIZATION_FAILED:
+            raise PermissionError(
+                f"raw produce to {topic}:{partition} refused: the "
+                f"topic is restricted to its owning engine "
+                f"(Broker.restrict_topic); nothing appended")
         if err != ERR_NONE:
             raise RuntimeError(
                 f"raw produce to {topic}:{partition} failed: {err}")
@@ -1086,6 +1122,8 @@ class KafkaWireBroker(ProducePartitionMixin):
             for pid, err, ts, off in parts:
                 if err == ERR_NOT_LEADER_FOR_PARTITION:
                     raise NotLeaderForPartitionError(topic, pid)
+                if err == ERR_UNKNOWN_TOPIC:
+                    raise KeyError(topic)
                 if err != ERR_NONE:
                     raise RuntimeError(f"list_offsets {topic}:{pid}: {err}")
                 return off
@@ -1301,6 +1339,14 @@ class KafkaWireBroker(ProducePartitionMixin):
         if err == ERR_NOT_COORDINATOR:
             raise CoordinatorMovedError(
                 f"sync group {group}: broker is not the coordinator")
+        if err == ERR_UNKNOWN_MEMBER_ID:
+            raise RuntimeError(
+                f"sync group {group}: member {member_id!r} unknown to "
+                f"the coordinator — rejoin the group")
+        if err == ERR_ILLEGAL_GENERATION:
+            raise RuntimeError(
+                f"sync group {group}: generation {generation} fenced by "
+                f"a newer rebalance — rejoin the group")
         if err != ERR_NONE:
             raise RuntimeError(f"sync group {group}: error {err}")
         if not blob:
@@ -1322,6 +1368,10 @@ class KafkaWireBroker(ProducePartitionMixin):
         if err == ERR_NOT_COORDINATOR:
             raise CoordinatorMovedError(
                 f"heartbeat {group}: broker is not the coordinator")
+        if err in (ERR_UNKNOWN_MEMBER_ID, ERR_REBALANCE_IN_PROGRESS):
+            # both mean "this generation is over": the caller rejoins —
+            # same False signal either way, not worth distinct raises
+            return False
         return err == ERR_NONE
 
     def leave_group(self, group: str, member_id: str) -> None:
@@ -1329,7 +1379,13 @@ class KafkaWireBroker(ProducePartitionMixin):
         w.string(group).string(member_id)
         # retry-ok: a lost leave is self-healing (session timeout expires
         # the member); not worth retrying against a possibly-new leader
-        self._request(LEAVE_GROUP, 0, bytes(w.buf)).i16()
+        err = self._request(LEAVE_GROUP, 0, bytes(w.buf)).i16()
+        if err == ERR_NOT_COORDINATOR:
+            # surfaced typed so the cluster router's _coordinated wrapper
+            # re-finds the coordinator instead of silently dropping the
+            # leave (the session would only expire by timeout)
+            raise CoordinatorMovedError(
+                f"leave group {group}: broker is not the coordinator")
 
     # ----------------------------------------------------- cluster admin
     def cluster_admin(self, command: str, args: Optional[dict] = None,
@@ -1356,11 +1412,29 @@ class KafkaWireBroker(ProducePartitionMixin):
                 "(CLUSTER_ADMIN unsupported)")
         blob = r.bytes_() or b"{}"
         doc = _json.loads(blob.decode() or "{}")
+        if err == ERR_UNKNOWN_SERVER:
+            # the verb itself raised controller-side; the response body
+            # carries the operator-facing error text
+            raise RuntimeError(
+                f"cluster admin {command!r} failed: "
+                f"{doc.get('error', 'unknown server error')}")
         if err != ERR_NONE:
             raise RuntimeError(
                 f"cluster admin {command!r} failed: "
                 f"{doc.get('error', f'error {err}')}")
         return doc
+
+    # --------------------------------------------------- api versions
+    def api_versions(self) -> Dict[int, Tuple[int, int]]:
+        """ApiVersions v0 → {api_key: (min_version, max_version)} — the
+        server's supported-api table, the wire-level capability probe
+        (a client can ask before using the raw columnar apis)."""
+        r = self._request(API_VERSIONS, 0, b"")
+        err = r.i16()
+        ranges = r.array(lambda rd: (rd.i16(), rd.i16(), rd.i16()))
+        if err != ERR_NONE:
+            raise RuntimeError(f"api_versions failed: error {err}")
+        return {k: (lo, hi) for k, lo, hi in ranges}
 
     def close(self) -> None:
         # _sock is None when the last reconnect attempt found no
@@ -2185,7 +2259,7 @@ class _KafkaConn(socketserver.BaseRequestHandler):
                     w.bytes_(_json.dumps(doc, default=str).encode())
                 except Exception as e:  # noqa: BLE001 - the operator
                     # gets the error text, the connection stays up
-                    w.i16(-1)  # UNKNOWN_SERVER_ERROR
+                    w.i16(ERR_UNKNOWN_SERVER)
                     w.bytes_(_json.dumps(
                         {"error": f"{type(e).__name__}: {e}"}).encode())
         elif api_key == CREATE_TOPICS:

@@ -48,10 +48,15 @@ requires_reference = pytest.mark.skipif(
 # stack creates is instrumented; the registered plugin reports at session
 # end and FAILS the run on lock-order cycles.  Equivalent to
 # `pytest -p iotml.analysis.pytest_plugin`.
-if os.environ.get("IOTML_LOCKCHECK", "") not in ("", "0"):
-    from iotml.analysis import lockcheck as _lockcheck
+# IOTML_TRACECHECK=1: arm the JAX recompile guard over the known hot
+# loops — a warmed loop that re-traces fails its test (same plugin,
+# independently gated; see iotml.analysis.pytest_plugin).
+if os.environ.get("IOTML_LOCKCHECK", "") not in ("", "0") \
+        or os.environ.get("IOTML_TRACECHECK", "") not in ("", "0"):
+    if os.environ.get("IOTML_LOCKCHECK", "") not in ("", "0"):
+        from iotml.analysis import lockcheck as _lockcheck
 
-    _lockcheck.install()
+        _lockcheck.install()
 
     def pytest_configure(config):
         if not config.pluginmanager.has_plugin("iotml-lockcheck"):

@@ -1,0 +1,53 @@
+"""Seeded registry drift (D1/D2/D3) the drift pass must fully convict
+when run fixture-scoped (``drift.analyze(paths=[this file])`` — the
+registries it drifts FROM are the real tree's).
+
+Expected findings: 2×D1, 2×D2, 1×D3 — and the suppressed knob read
+staying SUPPRESSED (the round-trip check).
+"""
+
+import os
+
+
+class _Reg:
+    def counter(self, name):
+        return self
+
+    def inc(self, **labels):
+        return None
+
+
+class _FaultShim:
+    @staticmethod
+    def point(name):
+        return None
+
+
+reg = _Reg()
+metrics = reg
+fp = _FaultShim()
+
+# declared here so the label check binds; its (absent)
+# DECLARED_METRIC_LABELS row budgets no label keys at all
+fixture_total = reg.counter("iotml_fixture_total")
+
+
+def read_knobs():
+    a = os.environ.get("IOTML_BOGUS_KNOB")  # D1: no config field
+    b = os.getenv("IOTML_PHANTOM")  # D1: no non_config entry either
+    return a, b
+
+
+def record():
+    metrics.fixture_total.inc(topic="t")  # D2: undeclared label key
+    metrics.ghost_total.inc()  # D2: no declaration anywhere
+
+
+def inject():
+    fp.point("fixture.bogus_fault")  # D3: unregistered faultpoint
+
+
+def suppressed_knob():
+    # lint-ok: D1 fixture: the suppression round-trip — knob is
+    # consumed by the harness alone, never by the config ladder
+    return os.environ.get("IOTML_SUPPRESSED_KNOB")

@@ -1,0 +1,85 @@
+"""Seeded wire-protocol drift: a self-contained mini wire module the
+protocol pass (``protocol.check_wire``) must fully convict.
+
+Expected findings:
+  P1  API_ORPHAN supported but unhandled; API_GHOST handled but
+      disowned; the PRODUCE handler's bare numeric code 41.
+  P2  probe() requesting the undefined API_MYSTERY; API_ORPHAN with no
+      encoder.
+  P3  produce() never typing ERR_MESSAGE_TOO_LARGE.
+  P5  IDEMPOTENT_APIS classifying the unsupported API_GHOST.
+  P6  fetch() reaching no chaos faultpoint (and suppressed_probe()'s
+      identical shape staying SUPPRESSED — the round-trip check).
+"""
+
+API_PRODUCE = 0
+API_FETCH = 1
+API_ORPHAN = 7
+API_GHOST = 9
+
+ERR_NONE = 0
+ERR_UNKNOWN_TOPIC = 3
+ERR_MESSAGE_TOO_LARGE = 10
+
+_SUPPORTED = {API_PRODUCE: (0, 0), API_FETCH: (0, 0), API_ORPHAN: (0, 0)}
+
+IDEMPOTENT_APIS = frozenset({API_FETCH, API_GHOST})
+
+
+class _FaultShim:
+    @staticmethod
+    def point(name):
+        return None
+
+
+fp = _FaultShim()
+
+
+class _MiniServer:
+    def handle(self, api_key, rd, w):
+        if api_key == API_PRODUCE:
+            w.i16(ERR_MESSAGE_TOO_LARGE)
+            w.i16(ERR_UNKNOWN_TOPIC)
+            w.i16(41)
+            w.i16(ERR_NONE)
+        elif api_key == API_FETCH:
+            w.i16(ERR_NONE)
+        elif api_key == API_GHOST:
+            w.i16(ERR_NONE)
+
+
+class _MiniClient:
+    def _request(self, api, version, payload):
+        raise NotImplementedError
+
+    def produce(self, topic, value):
+        fp.point("wire.send")
+        # retry-ok: fixture stub — the mini client never executes
+        r = self._request(API_PRODUCE, 0, value)
+        err = r.i16()
+        if err == ERR_UNKNOWN_TOPIC:
+            raise KeyError(topic)
+        if err != ERR_NONE:
+            raise RuntimeError("produce failed")
+        return r
+
+    def fetch(self, topic):
+        # retry-ok: fixture stub — the mini client never executes
+        r = self._request(API_FETCH, 0, topic.encode())
+        err = r.i16()
+        if err != ERR_NONE:
+            raise RuntimeError("fetch failed")
+        return r
+
+    def probe(self):
+        fp.point("wire.send")
+        # retry-ok: fixture stub — the mini client never executes
+        r = self._request(API_MYSTERY, 0, b"")  # noqa: F821
+        return r.i16() == ERR_NONE
+
+    def suppressed_probe(self):
+        # retry-ok: fixture stub — the mini client never executes
+        # lint-ok: P6 fixture: the suppression round-trip — this
+        # exchange is covered by produce()'s injected socket path
+        r = self._request(API_FETCH, 0, b"")
+        return r.i16() == ERR_NONE

@@ -1,0 +1,100 @@
+"""Seeded JAX trace-discipline hazards the tracecheck pass must fully
+convict — plus the static idioms that must stay CLEAN (shape branches,
+factories, module-level jit, the suppression round-trip).
+
+Expected findings: 1×T1, 4×T2, 2×T3, 2×T4.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def branch_on_traced(x, flag):
+    if flag:  # T1: python branch on a traced value
+        return x + 1.0
+    return x
+
+
+@jax.jit
+def float_sync(x):
+    total = float(x.sum())  # T2: host sync via float()
+    return x * total
+
+
+@jax.jit
+def item_sync(x):
+    return x.mean().item()  # T2: host sync via .item()
+
+
+@jax.jit
+def asarray_sync(x):
+    return np.asarray(x)  # T2: host pull via np.asarray
+
+
+@jax.jit
+def tolist_sync(x):
+    return x.tolist()  # T2: host sync via .tolist()
+
+
+def per_call_jit(x):
+    return jax.jit(lambda y: y * 2.0)(x)  # T3: invoked immediately
+
+
+def _double(y):
+    return y * 2.0
+
+
+def leaked_jit(x):
+    f = jax.jit(_double)  # T3: neither returned, stored, nor a factory
+    return f(x)
+
+
+@jax.jit
+def traced_shape(x, n):
+    return jnp.zeros(n) + x  # T4: traced value as a shape
+
+
+@jax.jit
+def traced_reshape(x, n):
+    return x.reshape(n)  # T4: traced reshape target
+
+
+# ---- clean shapes: none of these may fire ---------------------------
+@jax.jit
+def static_branches(x, mode=None):
+    if mode is None:  # `is` compare: resolved at trace time
+        mode = "raw"
+    if x.shape[0] > 4:  # attribute access: static under trace
+        return x[:4]
+    return x
+
+
+@jax.jit
+def static_arg_branch(x, scale, *, debug=False):
+    del debug
+    return x * scale
+
+
+def make_step(scale):
+    @jax.jit
+    def step(x):
+        return x * scale
+
+    return step  # factory: the caller owns the compiled callable
+
+
+normalize = jax.jit(lambda v: (v - v.mean()) / (v.std() + 1e-6))
+
+
+class _Loop:
+    def __init__(self):
+        self._step = jax.jit(_double)  # stored on self: compiled once
+
+
+@jax.jit
+def suppressed_sync(x):
+    # lint-ok: T2 fixture: the suppression round-trip — this sync is
+    # the deliberate epoch-boundary readback
+    return x.mean().item()

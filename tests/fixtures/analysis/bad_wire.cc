@@ -1,0 +1,18 @@
+// Seeded drifted C++ wire snippet: swapped in for cpp/kafka_client.cc
+// by the P4 conformance test (protocol.analyze(cpp=<this file>)).
+//
+// Expected findings: 3×P4 — API_FETCH value skew (41 vs python's 1),
+// ERR_UNKNOWN_TOPIC value skew (77 vs 3), and a request() claim on
+// API_LIST_OFFSETS with no constant defining it.  API_PRODUCE = 0
+// matches python and must stay clean.
+
+#include <cstdint>
+
+constexpr int16_t API_PRODUCE = 0, API_FETCH = 41;
+constexpr int16_t ERR_UNKNOWN_TOPIC = 77;
+
+static bool poll_once(Conn &c, const Buf &body, Resp &resp) {
+  if (!request(c, API_FETCH, 2, body, resp)) return false;
+  if (!request(c, API_LIST_OFFSETS, 1, body, resp)) return false;
+  return true;
+}

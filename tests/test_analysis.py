@@ -1,7 +1,10 @@
-"""The checker checked: iotml.analysis lint rules R1-R5 against seeded
+"""The checker checked: iotml.analysis lint rules against seeded
 violation fixtures (tests/fixtures/analysis/) and a clean tree, the
-runtime lock-order/race detector against a seeded cycle, and the
-allowlist the R2 lint enforces pinned to the client that implements it."""
+whole-program passes (protocol P1-P7, tracecheck T1-T4, drift D1-D4)
+against their fixture corpora plus surface-removal sensitivity, the
+static lock-order extractor and its runtime preseed, the recompile
+guard's warm/retrace semantics, and the runtime lock-order/race
+detector against a seeded cycle."""
 
 import os
 import subprocess
@@ -286,3 +289,303 @@ def test_lockcheck_condition_integration(fresh_lockcheck):
     threading.Thread(target=ev.set).start()
     assert ev.wait(5)
     assert fresh_lockcheck.cycles() == []
+
+
+# ----------------------------------------------- whole-program passes
+from iotml.analysis import drift as drift_mod  # noqa: E402
+from iotml.analysis import lockorder  # noqa: E402
+from iotml.analysis import protocol as protocol_mod  # noqa: E402
+from iotml.analysis import tracecheck as trace_mod  # noqa: E402
+
+
+def _rule_counts(findings):
+    out = {}
+    for f in findings:
+        out[f.rule] = out.get(f.rule, 0) + 1
+    return out
+
+
+def test_protocol_clean_on_the_tree():
+    findings = protocol_mod.analyze()
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_tracecheck_clean_on_the_tree():
+    findings = trace_mod.analyze()
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_drift_clean_on_the_tree():
+    findings = drift_mod.analyze()
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_protocol_fixture_catches_every_seeded_skew():
+    findings = protocol_mod.check_wire(
+        os.path.join(FIXTURES, "bad_protocol.py"))
+    assert _rule_counts(findings) == {"P1": 3, "P2": 2, "P3": 1,
+                                      "P5": 1, "P6": 1}
+    msgs = " ".join(f.message for f in findings)
+    assert "API_ORPHAN" in msgs          # P1 unhandled + P2 unencoded
+    assert "API_GHOST" in msgs           # P1 disowned branch + P5
+    assert "API_MYSTERY" in msgs         # P2 unknown constant
+    assert "ERR_MESSAGE_TOO_LARGE" in msgs   # P3 untyped code
+    assert "bare error code 41" in msgs  # P1 unnamed numeric code
+    # the justified '# lint-ok: P6' site round-trips as suppressed
+    assert "suppressed_probe" not in msgs
+
+
+def test_tracecheck_fixture_catches_every_seeded_hazard():
+    findings = trace_mod.analyze(
+        paths=[os.path.join(FIXTURES, "bad_trace.py")])
+    assert _rule_counts(findings) == {"T1": 1, "T2": 4, "T3": 2, "T4": 2}
+    msgs = " ".join(f.message for f in findings)
+    assert "'flag'" in msgs                       # T1 names the value
+    assert "float()" in msgs and ".item()" in msgs
+    assert "np.asarray" in msgs and ".tolist()" in msgs
+    assert "invoked immediately" in msgs          # T3 per-call jit
+    assert "'leaked_jit'" in msgs                 # T3 leaked closure
+    assert "zeros()" in msgs and "reshape()" in msgs
+    # the clean idioms stayed clean: factory, module-level jit,
+    # self-stored jit, shape/is-None branches, and the suppressed sync
+    flagged = {f.line for f in findings}
+    lines = open(os.path.join(FIXTURES, "bad_trace.py")).read().splitlines()
+    clean_from = next(i for i, ln in enumerate(lines, start=1)
+                      if "clean shapes" in ln)
+    assert not [ln for ln in flagged if ln > clean_from]
+
+
+def test_drift_fixture_catches_every_seeded_drift():
+    findings = drift_mod.analyze(
+        paths=[os.path.join(FIXTURES, "bad_drift.py")])
+    assert _rule_counts(findings) == {"D1": 2, "D2": 2, "D3": 1}
+    msgs = " ".join(f.message for f in findings)
+    assert "IOTML_BOGUS_KNOB" in msgs and "IOTML_PHANTOM" in msgs
+    assert "fixture_total" in msgs and "ghost_total" in msgs
+    assert "fixture.bogus_fault" in msgs
+    # the justified '# lint-ok: D1' knob read stayed suppressed
+    assert "IOTML_SUPPRESSED_KNOB" not in msgs
+
+
+def test_protocol_cpp_skew_fixture():
+    """The skewed C++ snippet against the REAL python wire: value
+    drift both ways plus a claim with no constant, all P4."""
+    findings = protocol_mod.analyze(
+        cpp=os.path.join(FIXTURES, "bad_wire.cc"))
+    assert _rule_counts(findings) == {"P4": 3}
+    msgs = " ".join(f.message for f in findings)
+    assert "API_FETCH = 41" in msgs
+    assert "ERR_UNKNOWN_TOPIC = 77" in msgs
+    assert "API_LIST_OFFSETS" in msgs
+
+
+def _mutated(tmp_path, src_path, old, new, name):
+    src = open(src_path).read()
+    assert old in src, f"mutation anchor vanished from {src_path}"
+    p = tmp_path / name
+    p.write_text(src.replace(old, new, 1))
+    return str(p)
+
+
+def test_protocol_is_four_surface_sensitive(tmp_path):
+    """Removing any ONE api mapping from any surface makes the pass
+    fail — the cross-check provably covers server, client, cluster
+    router, C++ client, and the lint mirror."""
+    root = lint_mod.default_root()
+    wire = os.path.join(root, "stream", "kafka_wire.py")
+    cluster = os.path.join(root, "cluster", "client.py")
+
+    # server surface: drop FETCH from _SUPPORTED -> its dispatch
+    # branch is orphaned (P1)
+    skewed = _mutated(tmp_path, wire, "FETCH: (2, 2),", "", "w1.py")
+    rules = {f.rule for f in protocol_mod.analyze(wire=skewed)}
+    assert "P1" in rules
+
+    # client surface: neuter produce_many's typed compare against
+    # INVALID_REQUIRED_ACKS -> the server-emittable code loses its
+    # mapping (P3)
+    skewed = _mutated(tmp_path, wire,
+                      "err == ERR_INVALID_REQUIRED_ACKS",
+                      "err == ERR_NONE", "w2.py")
+    findings = protocol_mod.analyze(wire=skewed)
+    assert any(f.rule == "P3"
+               and "ERR_INVALID_REQUIRED_ACKS" in f.message
+               for f in findings)
+
+    # cluster surface: point a delegation at a method the wire client
+    # does not define (P2)
+    skewed = _mutated(tmp_path, cluster, "c.heartbeat_group(",
+                      "c.heartbeat_missing(", "c1.py")
+    findings = protocol_mod.analyze(cluster=skewed)
+    assert any(f.rule == "P2" and "heartbeat_missing" in f.message
+               for f in findings)
+
+    # lint-mirror surface: drop FETCH from the idempotency mirror (P5)
+    trimmed = [n for n in lint_mod.IDEMPOTENT_API_NAMES if n != "FETCH"]
+    findings = protocol_mod.analyze(lint_idempotent=trimmed)
+    assert any(f.rule == "P5" and "FETCH" in f.message
+               for f in findings)
+
+    # (C++ surface sensitivity: test_protocol_cpp_skew_fixture above)
+
+
+def test_drift_d4_flags_missing_doc_rows(tmp_path):
+    """A doc with only P1's row: every other rule id is a D4."""
+    doc = tmp_path / "ARCH.md"
+    doc.write_text("| Rule | Contract |\n|---|---|\n| P1 | covered |\n")
+    findings = drift_mod.analyze(paths=[], architecture=str(doc))
+    assert findings and {f.rule for f in findings} == {"D4"}
+    missing = " ".join(f.message for f in findings)
+    for rid in ("P2", "T1", "T4", "D1", "D4", "R1"):
+        assert f"rule {rid} " in missing
+    assert "rule P1 " not in missing
+
+
+def test_recompile_guard_counts_and_hot_loop_wrap():
+    import jax
+    import jax.numpy as jnp
+
+    trace_mod.reset_warm()
+    x = jnp.ones((4,), jnp.float32)
+    f = jax.jit(lambda v: v * 2.0)
+    f(x)  # warm-up trace
+    with trace_mod.expect_no_recompile("warmed jit"):
+        f(x)
+    with pytest.raises(trace_mod.RecompileError):
+        with trace_mod.expect_no_recompile("cold jit"):
+            jax.jit(lambda v: v * 3.0)(x)  # fresh closure: compiles
+
+    class Good:
+        def __init__(self):
+            self._step = jax.jit(lambda v: v + 1.0)
+
+        def step(self, v):
+            return self._step(v)
+
+    Good.step = trace_mod.guard_hot_loop(Good.step, "Good.step")
+    g = Good()
+    g.step(x)   # warm-up
+    g.step(x)   # cached: no compile, no error
+    g.step(jnp.ones((8,), jnp.float32))  # new signature: legal compile
+
+    class Bad:
+        def step(self, v):
+            return jax.jit(lambda q: q * 2.0)(v)  # fresh jit per call
+
+    Bad.step = trace_mod.guard_hot_loop(Bad.step, "Bad.step")
+    b = Bad()
+    b.step(x)   # warm-up call is allowed to compile
+    with pytest.raises(trace_mod.RecompileError):
+        b.step(x)  # identical signature retraced: the guard fails it
+    trace_mod.reset_warm()
+
+
+def test_runtime_guard_targets_exist():
+    """Every _GUARD_TARGETS row resolves to a real method — a rename
+    would silently disarm the IOTML_TRACECHECK=1 guard."""
+    import importlib
+
+    for mod_name, cls_name, meth in trace_mod._GUARD_TARGETS:
+        cls = getattr(importlib.import_module(mod_name), cls_name)
+        assert meth in cls.__dict__, (cls_name, meth)
+    if os.environ.get("IOTML_TRACECHECK"):
+        pytest.skip("session-level traceguard active")
+    patched = trace_mod.install_runtime_guard()
+    try:
+        assert set(patched) == {f"{c}.{m}"
+                                for _, c, m in trace_mod._GUARD_TARGETS}
+        # idempotent: a second install patches nothing new
+        assert trace_mod.install_runtime_guard() == []
+    finally:
+        # unwrap so the guard doesn't leak into unrelated tests (no
+        # per-test reset_warm runs outside IOTML_TRACECHECK sessions)
+        for mod_name, cls_name, meth in trace_mod._GUARD_TARGETS:
+            cls = getattr(importlib.import_module(mod_name), cls_name)
+            fn = cls.__dict__[meth]
+            if getattr(fn, "__iotml_traceguard__", False):
+                setattr(cls, meth, fn.__wrapped__)
+        trace_mod.reset_warm()
+
+
+def test_lockorder_extracts_real_edges():
+    edges = lockorder.analyze()
+    assert any("stream/broker.py" in a and "stream/broker.py" in b
+               for a, b, _ in edges), edges
+    # the live tree must stay acyclic
+    assert lockorder.cycles_among(edges) == []
+
+
+_LOCK_CYCLE_SRC = '''\
+import threading
+
+
+class T:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def _inner_b(self):
+        with self._b:
+            pass
+
+    def ab(self):
+        with self._a:
+            self._inner_b()
+
+    def ba(self):
+        with self._b, self._a:
+            pass
+'''
+
+
+def test_lockorder_static_cycle_detected(tmp_path):
+    p = tmp_path / "cycle_mod.py"
+    p.write_text(_LOCK_CYCLE_SRC)
+    edges = lockorder.analyze(paths=[str(p)])
+    # a->b through the called method, b->a through the multi-item with
+    assert len(edges) == 2, edges
+    cycles = lockorder.cycles_among(edges)
+    assert len(cycles) == 1, cycles
+
+
+def test_lockorder_preseed_static(fresh_lockcheck):
+    n = lockorder.preseed(state=fresh_lockcheck,
+                          edges=[("f.py:1", "f.py:2", "f.py:10")])
+    assert n == 1
+    assert fresh_lockcheck.violations == []
+    # the opposite static edge closes a cycle: surfaced as a warning
+    # kind, NOT a hard 'cycle' (strict mode promotes it)
+    n = lockorder.preseed(state=fresh_lockcheck,
+                          edges=[("f.py:2", "f.py:1", "f.py:20")])
+    assert n == 1
+    kinds = [v.kind for v in fresh_lockcheck.violations]
+    assert kinds == ["static-cycle"]
+    assert fresh_lockcheck.cycles() == []
+    # re-seeding the same edge is a no-op
+    assert lockorder.preseed(state=fresh_lockcheck,
+                             edges=[("f.py:1", "f.py:2", "f.py:10")]) == 0
+
+
+def test_analysis_cli_all_shares_one_parse():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "iotml.analysis", "all"],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(lint_mod.default_root()))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "parsed once" in r.stderr
+    # one parse per file: the summary's file count equals the walk,
+    # not rules x files
+    assert "0 finding(s)" in r.stderr
+
+
+def test_rule_tables_are_disjoint_and_documented():
+    families = [lint_mod.RULES, protocol_mod.PASS_RULES,
+                trace_mod.PASS_RULES, drift_mod.PASS_RULES]
+    seen = set()
+    for table in families:
+        assert not (set(table) & seen)
+        seen |= set(table)
+    assert {"P1", "P7", "T1", "T4", "D1", "D4"} <= seen
+    # and the tree's own doc carries every row (D4 clean = tested above
+    # via test_drift_clean_on_the_tree)

@@ -200,10 +200,13 @@ def test_acks_all_rejected_below_min_isr_nothing_appended():
 
 
 def test_invalid_required_acks_is_error_21():
+    # wire error 21 (INVALID_REQUIRED_ACKS) surfaces TYPED — a
+    # ValueError naming the legal acks values, not the generic
+    # RuntimeError fallback (the protocol pass's P3 contract)
     leader, srv, rs = _leader_with_set()
     client = KafkaWireBroker(f"127.0.0.1:{srv.port}")
     try:
-        with pytest.raises(RuntimeError, match="21"):
+        with pytest.raises(ValueError, match="required_acks"):
             client.produce_many(T, [(None, b"v", 0)], partition=0,
                                 acks=5)
         assert leader.end_offset(T, 0) == 0
