@@ -523,6 +523,166 @@ def bench_pipeline():
         shutil.rmtree(d, ignore_errors=True)
 
 
+def bench_tsdb():
+    """Telemetry-plane overhead gate (ISSUE 17): the columnar consume
+    leg with the WHOLE self-hosted telemetry plane armed — federated
+    scrape (render → parse) → TsdbAppender into the same durable broker
+    → SloEngine burn-rate evaluation over the incremental TsdbTail —
+    vs the plane off, as paired interleaved passes.  The acceptance
+    gate pins armed within 5% of off (the r12 obs-gate protocol:
+    MINIMA of interleaved passes, because on a noisy shared box
+    run-to-run drift exceeds the armed delta).
+
+    Micro legs alongside: scrape-append ingest rate, cold read_series +
+    rate() query wall, incremental-tail evaluation wall, and the
+    compaction-boundedness record counts."""
+    import shutil
+    import tempfile
+
+    from iotml.data.dataset import SensorBatches
+    from iotml.obs import federate as _federate
+    from iotml.obs import metrics as _obs_metrics
+    from iotml.obs import slo as _slo
+    from iotml.obs import tsdb as _tsdb
+    from iotml.stream.broker import Broker
+    from iotml.stream.consumer import StreamConsumer
+
+    n_records = int(os.environ.get("IOTML_BENCH_TSDB_RECORDS", "20000"))
+    scrape_interval_s = 0.25  # the drill/fleet-server production cadence
+    d = tempfile.mkdtemp(prefix="iotml_bench_tsdb_")
+    try:
+        broker = Broker(store_dir=d)
+        _fill_broker(broker, n_records, num_cars=100)
+        total = broker.end_offset("SENSOR_DATA_S_AVRO", 0)
+
+        appender = _tsdb.TsdbAppender(broker, chunk_ms=2_000)
+        # a rule over a family the drain actually grows, threshold high
+        # enough to never fire: realistic evaluation cost, no alert spam
+        engine = _slo.SloEngine(
+            broker,
+            [{"name": "bench-consume", "objective": 0.99,
+              "indicator": {"kind": "ratio",
+                            "bad": "iotml_records_consumed_total",
+                            "total": "iotml_records_consumed_total"},
+              "windows": (("fast", 5_000, 30_000, 1e12),)}],
+            interval_s=scrape_interval_s)
+
+        def scrape_once():
+            _t, samples = _federate.parse_prom_text(
+                _obs_metrics.default_registry.render())
+            appender.append(samples, process="bench")
+            engine.evaluate()
+
+        def one_drain() -> int:
+            # ONE group for every drain: per-group consumer metrics mean
+            # a fresh group per pass would snowball the registry (and
+            # the scrape cost with it) far past any production shape —
+            # a real scorer keeps its group for life
+            consumer = StreamConsumer(
+                broker, ["SENSOR_DATA_S_AVRO:0:0"], group="bench-tsdb")
+            sb = SensorBatches(consumer, batch_size=100, poll_chunk=4096)
+            return sum(b.n_valid for b in sb)
+
+        # size the timed pass to span several scrape ticks: a ~30 ms
+        # drain would see at most one tick and measure nothing
+        t0 = time.perf_counter()
+        assert one_drain() == total
+        repeats = max(3, int(round(
+            1.5 / max(time.perf_counter() - t0, 1e-3))))
+
+        def timed_pass(armed: bool) -> float:
+            stop = threading.Event()
+            th = None
+            if armed:
+                def plane():
+                    while not stop.is_set():
+                        scrape_once()
+                        stop.wait(scrape_interval_s)
+                th = threading.Thread(target=plane, daemon=True,
+                                      name="bench-tsdb-plane")
+                th.start()
+            t0 = time.perf_counter()
+            rows = 0
+            for _ in range(repeats):
+                rows += one_drain()
+            wall = time.perf_counter() - t0
+            if armed:
+                stop.set()
+                th.join()
+            assert rows == repeats * total, (rows, repeats, total)
+            return wall
+
+        timed_pass(False)
+        timed_pass(True)  # warm both paths (ring alloc, tail cursor)
+        off, on = [], []
+        for _ in range(max(4, PASSES // 2)):
+            off.append(timed_pass(False))
+            on.append(timed_pass(True))
+        t_off, t_on = min(off), min(on)
+
+        # ---- micro legs over the TSDB the armed passes just populated
+        n_scrapes = 25
+        t0 = time.perf_counter()
+        n_samples = 0
+        for _ in range(n_scrapes):
+            _t, samples = _federate.parse_prom_text(
+                _obs_metrics.default_registry.render())
+            appender.append(samples, process="bench")
+            n_samples += len(samples)
+        scrape_wall = time.perf_counter() - t0
+
+        q_walls = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            series = _tsdb.read_series(broker)
+            _tsdb.query(series,
+                        "rate(iotml_records_consumed_total[30s])")
+            q_walls.append(time.perf_counter() - t0)
+        query_ms, _p95 = _percentiles(q_walls)
+
+        e_walls = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            engine.evaluate()
+            e_walls.append(time.perf_counter() - t0)
+        eval_ms, _p95 = _percentiles(e_walls)
+
+        pre_records = (broker.end_offset(_tsdb.TSDB_TOPIC, 0)
+                       - broker.begin_offset(_tsdb.TSDB_TOPIC, 0))
+        broker.store.log_for(_tsdb.TSDB_TOPIC, 0).roll()
+        broker.run_compaction(force=True)
+        post = 0
+        off_c = broker.begin_offset(_tsdb.TSDB_TOPIC, 0)
+        end_c = broker.end_offset(_tsdb.TSDB_TOPIC, 0)
+        while off_c < end_c:
+            batch = broker.fetch(_tsdb.TSDB_TOPIC, 0, off_c, 4096)
+            if not batch:
+                break
+            for m in batch:
+                off_c = m.offset + 1
+                post += 1
+
+        n_drained = repeats * total
+        broker.close()
+        return dict(
+            value=n_drained / t_on,
+            tsdb_off_records_per_sec=round(n_drained / t_off, 1),
+            tsdb_armed_records_per_sec=round(n_drained / t_on, 1),
+            tsdb_overhead_pct=round((t_on - t_off) / t_off * 100.0, 2),
+            scrape_append_samples_per_sec=round(
+                n_samples / scrape_wall, 1),
+            scrape_append_ms=round(scrape_wall / n_scrapes * 1e3, 3),
+            query_rate_p50_ms=round(query_ms * 1e3, 3),
+            slo_eval_p50_ms=round(eval_ms * 1e3, 3),
+            n_series=len(series),
+            tsdb_records_precompact=pre_records,
+            tsdb_records_postcompact=post,
+            scrape_interval_s=scrape_interval_s,
+            n_records=n_drained)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def _bench_produce_legs(broker, n_records):
     """The WRITE-path legs of the zero-copy plane (ISSUE 12), measured
     over the same durable broker as the consume legs:
@@ -3237,6 +3397,12 @@ METRIC_ORDER = [
     # Baseline: the reference's measured train-consume rate
     ("pipeline_columnar_records_per_sec", "records/s",
      TRAIN_BASELINE_RPS),
+    # self-hosted telemetry plane (ISSUE 17): the columnar consume
+    # leg with scrape → TSDB-append → SLO burn-rate evaluation armed
+    # vs off (acceptance: armed within 5% of off), plus the TSDB's
+    # own ingest/query/eval walls and compaction boundedness
+    ("tsdb_pipeline_records_per_sec", "records/s",
+     TRAIN_BASELINE_RPS),
     # digital-twin materialisation (iotml.twin): fold rate into the
     # per-car feature store, changelog-compaction MB/s reclaimed,
     # and GET /twin/<id> REST latency; the reference's twin lived
@@ -3312,6 +3478,7 @@ SINGLE_BENCH = {
     "bench_ksql_pipeline": "ksql_pipeline_records_per_sec",
     "bench_store_log": "store_append_mb_per_sec",
     "bench_pipeline": "pipeline_columnar_records_per_sec",
+    "bench_tsdb": "tsdb_pipeline_records_per_sec",
     "bench_twin": "twin_apply_records_per_sec",
     "bench_checkpoint": "train_ckpt_async_records_per_sec",
     "bench_online": "online_adapt_records",
@@ -3352,6 +3519,7 @@ def main():
         run("ksql_pipeline_records_per_sec", bench_ksql_pipeline)
         run("store_append_mb_per_sec", bench_store_log)
         run("pipeline_columnar_records_per_sec", bench_pipeline)
+        run("tsdb_pipeline_records_per_sec", bench_tsdb)
         run("twin_apply_records_per_sec", bench_twin)
         run("train_ckpt_async_records_per_sec", bench_checkpoint)
         run("online_adapt_records", bench_online)

@@ -28,6 +28,11 @@ JAX_PLATFORMS=cpu python -m iotml.chaos run --scenario drift-storm \
   --seed 7 --records 2000
 JAX_PLATFORMS=cpu python -m iotml.chaos run --scenario double-fault \
   --seed 7 --records 500
+echo "==      alert-burn drill (iotml.obs): sustained delivery delay"
+echo "        must FIRE the fast burn-rate pair onto _IOTML_ALERTS +"
+echo "        /healthz within budget, then RESOLVE on recovery"
+JAX_PLATFORMS=cpu python -m iotml.chaos run --scenario alert-burn \
+  --seed 7 --records 600
 
 echo "== 2/5 supervised restart: live scorer-crash drill (the scorer"
 echo "        thread dies twice; the supervisor must heal the pipeline)"

@@ -139,6 +139,7 @@ CHAOS_HARNESS_MODULES = frozenset({
     ("twin", "drill.py"), ("twin", "__main__.py"),
     ("online", "drill.py"), ("online", "__main__.py"),
     ("replication", "drill.py"), ("replication", "__main__.py"),
+    ("obs", "drill.py"), ("obs", "__main__.py"),
 })
 
 # R6 (naming): metric families and span/stage names are lowercase
@@ -164,7 +165,7 @@ _METRIC_RECORD_CALLS = frozenset({"inc", "observe", "set", "time"})
 _ALLOWED_METRIC_LABELS = frozenset({
     "stage", "topic", "partition", "group", "phase", "loop", "process",
     "component", "detector", "action", "fault", "source", "outcome",
-    "unit", "le",
+    "unit", "le", "slo", "window", "shard",
 })
 
 RULES: Dict[str, str] = {
@@ -242,6 +243,17 @@ _STRUCT_CALLS = frozenset({"Struct", "pack", "unpack", "unpack_from",
 # iotml/twin/, the store-internal compaction entry points, and the
 # rewrite-tmp path marker (same conservative name-matching as R9/R11).
 _TWIN_CHANGELOG_TOPICS = frozenset({"CAR_TWIN"})
+# R12 extension (ISSUE 17): the telemetry plane's log topics have one
+# writer family too — the obs package (FleetCollector's snapshot
+# changelog, TsdbAppender's chunk stream, SloEngine's alert
+# transitions).  A foreign producer forks the very history the SLO
+# engine alerts FROM.
+_OBS_TELEMETRY_TOPICS = frozenset({
+    "_IOTML_METRICS", "_IOTML_TSDB", "_IOTML_ALERTS"})
+_OBS_TOPIC_BY_NAME = {
+    "METRICS_TOPIC": "_IOTML_METRICS",
+    "TSDB_TOPIC": "_IOTML_TSDB",
+    "ALERTS_TOPIC": "_IOTML_ALERTS"}
 _COMPACT_WRITE_CALLS = frozenset({"compact_log", "sweep_cleaned"})
 _CLEANED_PATH_RE = re.compile(r"\.cleaned|CLEANED_SUFFIX")
 
@@ -562,8 +574,11 @@ class _FileLinter(ast.NodeVisitor):
         self.r15_ingress = self.in_replication or (
             len(parts) >= 2 and (parts[-2], parts[-1])
             == ("stream", "kafka_wire.py"))
-        # R12 scoping: the twin package owns the CAR_TWIN changelog
+        # R12 scoping: the twin package owns the CAR_TWIN changelog;
+        # the obs package owns the telemetry-plane topics
+        # (_IOTML_METRICS / _IOTML_TSDB / _IOTML_ALERTS)
         self.in_twin = "twin" in parts
+        self.in_obs = "obs" in parts
         # R13 scoping: the registry machinery (mlops watchers/rollouts)
         # and the online learner's adaptation path are the two places a
         # scorer's weights may legally be set in place — everything
@@ -834,8 +849,7 @@ class _FileLinter(ast.NodeVisitor):
         # half: CAR_TWIN (the twin's compacted changelog) has ONE
         # writer, TwinService — a foreign producer corrupts every
         # rebuild the changelog exists to make possible.
-        if not self.in_twin and name in ("produce", "produce_many",
-                                         "produce_batch"):
+        if name in ("produce", "produce_many", "produce_batch"):
             topic = None
             topic_nodes = list(node.args)[:1] + [
                 kw.value for kw in node.keywords if kw.arg == "topic"]
@@ -843,18 +857,28 @@ class _FileLinter(ast.NodeVisitor):
                 if isinstance(a, ast.Constant) and \
                         isinstance(a.value, str):
                     topic = a.value
-                elif isinstance(a, ast.Name) and \
-                        a.id == "CHANGELOG_TOPIC":
-                    topic = "CAR_TWIN"
-                elif isinstance(a, ast.Attribute) and \
-                        a.attr == "CHANGELOG_TOPIC":
-                    topic = "CAR_TWIN"
-            if topic in _TWIN_CHANGELOG_TOPICS:
+                elif isinstance(a, (ast.Name, ast.Attribute)):
+                    const = a.id if isinstance(a, ast.Name) else a.attr
+                    if const == "CHANGELOG_TOPIC":
+                        topic = "CAR_TWIN"
+                    elif const in _OBS_TOPIC_BY_NAME:
+                        topic = _OBS_TOPIC_BY_NAME[const]
+            if not self.in_twin and topic in _TWIN_CHANGELOG_TOPICS:
                 self._emit("R12", node,
                            f"produce to twin changelog {topic!r} outside "
                            "iotml/twin/: the changelog has one writer "
                            "(TwinService) — a foreign record corrupts "
                            "every rebuild that replays it")
+            # telemetry-plane one-writer surface (ISSUE 17): the scrape
+            # changelog, the TSDB chunk stream, and the alert log are
+            # produced by the obs package alone — a foreign record
+            # forks the history the SLO engine alerts from
+            if not self.in_obs and topic in _OBS_TELEMETRY_TOPICS:
+                self._emit("R12", node,
+                           f"produce to telemetry topic {topic!r} "
+                           "outside iotml/obs/: the telemetry plane's "
+                           "log topics have one writer family "
+                           "(FleetCollector / TsdbAppender / SloEngine)")
         # Second half: the segment-rewrite machinery is store-internal;
         # compaction is triggered through Broker.run_compaction so the
         # swap protocol and its crash-safety live in one place

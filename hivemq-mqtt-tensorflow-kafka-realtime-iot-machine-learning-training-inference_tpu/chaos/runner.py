@@ -227,6 +227,8 @@ class ChaosRunner:
                 report = self._run_mlops(eng)
             elif self.schedule.topology == "online":
                 report = self._run_online(eng, span_path)
+            elif self.schedule.topology == "obs":
+                report = self._run_obs(eng)
             else:
                 report = self._run_inproc(eng, span_path)
         finally:
@@ -808,6 +810,28 @@ class ChaosRunner:
             dropped_accounted=eng.dropped_count,
             injected=dict(sorted(eng.injected.items())),
             invariants=invariants, span_path=span_path)
+
+    # ---------------------------------------------------------------- obs
+    def _run_obs(self, eng: faults.ChaosEngine) -> ChaosReport:
+        """alert-burn: the telemetry-plane drill (iotml.obs.drill) under
+        the runner harness.  The drill owns fault arming itself — its
+        sustained degradation must land in the DEGRADED phase, not at
+        t=0 — so the runner's pre-armed engine is stood down and the
+        schedule's events handed over; the drill also configures its
+        own tracing (canary e2e must be span-sourced)."""
+        from ..obs.drill import drill_alert_burn
+
+        faults.disarm()
+        rep = drill_alert_burn(seed=self.schedule.seed,
+                               records=self.schedule.records,
+                               events=self.schedule.events)
+        return ChaosReport(
+            scenario=self.schedule.name, seed=self.schedule.seed,
+            records=self.schedule.records, topology="obs",
+            published=rep.published, scored=rep.scored,
+            rewinds=0, dropped_accounted=0,
+            injected=dict(rep.injected), invariants=list(rep.invariants),
+            span_path=None)
 
     # -------------------------------------------------------------- mlops
     def _run_mlops(self, eng: faults.ChaosEngine) -> ChaosReport:

@@ -52,6 +52,9 @@ Built-ins:
   its error signal, adapt and converge, the adapted model must
   hot-swap the scorer fleet through the registry, and no record may
   be lost or double-scored across the swap.
+- ``alert-burn`` (obs): sustained slow-bridge degradation under live
+  synthetic canaries; the SLO engine's fast burn-rate pair must fire,
+  land in ``_IOTML_ALERTS`` + ``/healthz``, and resolve on recovery.
 """
 
 from __future__ import annotations
@@ -308,6 +311,28 @@ def _double_fault(rng: random.Random, records: int) -> list:
     return events
 
 
+def _alert_burn(rng: random.Random, records: int) -> list:
+    # the telemetry-plane drill (ISSUE 17): a SUSTAINED slow-bridge
+    # degradation — every MQTT delivery delayed well past the canary
+    # latency SLO threshold — armed only for the drill's degraded
+    # phase.  The system under test is the alerting loop itself: the
+    # canary probes must measure the slowdown through the real path,
+    # the TSDB must carry it, and the SLO engine's FAST burn-rate pair
+    # must fire within the drill budget (then resolve after recovery).
+    # A couple of accounted drops ride along so the delivery SLO sees
+    # real loss too.
+    # far past the drill SLO threshold (0.1 s) so the degraded e2e
+    # separates cleanly from the healthy floor (~tens of ms of polling)
+    delay_s = round(rng.uniform(0.35, 0.5), 3)
+    events = [FaultEvent(1, "mqtt.deliver", "delay",
+                         params=(("seconds", delay_s),),
+                         repeat=1_000_000)]
+    for _ in range(2):
+        events.append(FaultEvent(rng.randint(1, max(2, records // 10)),
+                                 "mqtt.deliver", "drop"))
+    return events
+
+
 def _loss_bug_fixture(rng: random.Random, records: int) -> list:
     # the seeded bug: one delivery silently lost — NOT ledgered, so the
     # scored-or-accounted invariant must fail (the checker's own test)
@@ -377,6 +402,12 @@ SCENARIOS: Dict[str, Tuple[Callable, str, str]] = {
         "load: ISR evicts the dead follower, an ISR member is promoted "
         "at epoch+1 with ZERO acked-record loss (byte-identical "
         "offsets), a new follower heals the set and acks=all resumes"),
+    "alert-burn": (
+        _alert_burn, "obs",
+        "sustained slow-bridge degradation under live canary probes: "
+        "the e2e latency SLO's FAST burn-rate pair must fire within "
+        "budget, land in _IOTML_ALERTS + /healthz, and resolve after "
+        "recovery"),
     "drift-storm": (
         _drift_storm, "online",
         "seeded regional drift + flapping links concurrently: the "

@@ -252,6 +252,12 @@ class ClusterController:
                     self._compactors.append(StoreCompactor(
                         b, interval_s=b.store.policy.compact_interval_s,
                     ).start())
+        # per-shard scrape labels: every shard's current epoch is a
+        # labeled series from boot, so the federated scrape (and the
+        # TSDB behind it) can tell shards apart before any failover
+        for i in range(self.n):
+            obs_metrics.cluster_shard_epoch.set(
+                self.pmap.epoch(i), shard=str(i))
         self.started = True
         return self
 
@@ -375,7 +381,8 @@ class ClusterController:
             # the promoted server inherits the full serving surface:
             # admin verbs must survive every failover, not just boot
             rset.server.admin = self
-            obs_metrics.cluster_shard_failovers.inc()
+            obs_metrics.cluster_shard_failovers.inc(shard=str(shard))
+            obs_metrics.cluster_shard_epoch.set(epoch, shard=str(shard))
             if was_coordinator:
                 obs_metrics.cluster_coordinator_moves.inc()
             return addr
@@ -392,7 +399,8 @@ class ClusterController:
         addr = f"{self._adv_host}:{rep.port}"
         self.pmap.publish(shard, addr, epoch)
         self.serving[shard] = rep.local
-        obs_metrics.cluster_shard_failovers.inc()
+        obs_metrics.cluster_shard_failovers.inc(shard=str(shard))
+        obs_metrics.cluster_shard_epoch.set(epoch, shard=str(shard))
         if was_coordinator:
             # the pinned shard moved WITH its follower: clients re-find
             # the coordinator at the promoted address; membership state
@@ -543,7 +551,8 @@ class ClusterController:
         move.new_leader = addr
         move.epoch = epoch
         move.advance(MOVED)
-        obs_metrics.cluster_shard_failovers.inc()
+        obs_metrics.cluster_shard_failovers.inc(shard=str(shard))
+        obs_metrics.cluster_shard_epoch.set(epoch, shard=str(shard))
         if was_coordinator:
             obs_metrics.cluster_coordinator_moves.inc()
         if retire_old:

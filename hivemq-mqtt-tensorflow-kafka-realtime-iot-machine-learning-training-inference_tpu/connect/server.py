@@ -185,6 +185,60 @@ class ConnectServer(RestServer):
         self.route("GET", r"/twin/([^/]+)", self._twin_get)
         self.route("DELETE", r"/twin/([^/]+)", self._twin_delete)
 
+    def attach_tsdb(self, broker, partition: int = 0) -> None:
+        """Serve the telemetry TSDB over this REST surface (ISSUE 17):
+        `GET /query?query=<expr>[&time_ms=]` for instant evaluation and
+        `GET /query_range?query=&start_ms=&end_ms=[&step_ms=]` for
+        stepped series — the Prometheus HTTP API's shape, answered from
+        the `_IOTML_TSDB` log replay instead of a separate TSDB
+        process."""
+        self.tsdb_broker = broker
+        self.tsdb_partition = partition
+        self.route("GET", r"/query", self._tsdb_query)
+        self.route("GET", r"/query_range", self._tsdb_query_range)
+
+    def _tsdb_series(self, start_ms=None):
+        from ..obs import tsdb
+
+        return tsdb.read_series(self.tsdb_broker, start_ms=start_ms,
+                                partition=self.tsdb_partition)
+
+    def _tsdb_query(self, m, body):
+        from ..obs import tsdb
+
+        expr = body.get("query") or body.get("expr")
+        if not expr:
+            raise RestError(400, "missing 'query' parameter")
+        at_ms = int(body["time_ms"]) if body.get("time_ms") else None
+        try:
+            result = tsdb.query(self._tsdb_series(), expr, at_ms=at_ms)
+        except ValueError as e:
+            raise RestError(400, f"bad query: {e}")
+        return 200, {"status": "success", "data": result}
+
+    def _tsdb_query_range(self, m, body):
+        from ..obs import tsdb
+
+        expr = body.get("query") or body.get("expr")
+        if not expr:
+            raise RestError(400, "missing 'query' parameter")
+        try:
+            start = int(body["start_ms"])
+            end = int(body["end_ms"])
+        except (KeyError, ValueError):
+            raise RestError(400, "range query needs integer 'start_ms' "
+                            "and 'end_ms'")
+        step = int(body.get("step_ms") or 15_000)
+        # replay from before the range start: rate()/increase() at the
+        # first steps look back across the range boundary
+        horizon = start - 2 * tsdb.DEFAULT_LOOKBACK_MS
+        try:
+            result = tsdb.query(self._tsdb_series(start_ms=horizon), expr,
+                                start_ms=start, end_ms=end, step_ms=step)
+        except ValueError as e:
+            raise RestError(400, f"bad query: {e}")
+        return 200, {"status": "success", "data": result}
+
     def _twin_list(self, m, body):
         return 200, {"count": self.twin.count(),
                      "rebuilt_from_changelog": self.twin.rebuilt_records,

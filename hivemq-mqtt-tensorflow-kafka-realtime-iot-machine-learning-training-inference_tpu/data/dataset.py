@@ -85,6 +85,7 @@ class SensorBatches:
                  pad_tail: bool = True,
                  keep_labels: bool = False,
                  keep_keys: bool = False,
+                 exclude_key_marker: Optional[bytes] = None,
                  poll_chunk: int = 4096,
                  cache: bool = False):
         self.consumer = consumer
@@ -104,6 +105,15 @@ class SensorBatches:
         # join on.  Batched path only; the windowed path has no per-row
         # key semantics (a window spans records).
         self.keep_keys = keep_keys
+        # exclude_key_marker drops every record whose message key
+        # contains the marker BEFORE batching — the canary firewall
+        # (obs.canary.CANARY_KEY_MARKER): synthetic probe records ride
+        # the real ingest path but must never be scored into user-facing
+        # prediction topics.  Exclusion needs the keys even when the
+        # caller doesn't (keep_keys=False), so key capture is forced
+        # internally and the keys are shed again after filtering.
+        self.exclude_key_marker = exclude_key_marker
+        self._capture_keys = keep_keys or exclude_key_marker is not None
         self.poll_chunk = poll_chunk
         # cache=True decodes the stream once and replays batches from host
         # memory on later epochs.  The reference re-reads Kafka every epoch
@@ -231,7 +241,7 @@ class SensorBatches:
             rows = max(int(self.poll_chunk), 1)
             self._ring = pl.DecodeRing(
                 rows, self._native.n_numeric, self._native.n_strings,
-                with_keys=self.keep_keys)
+                with_keys=self._capture_keys)
             self._framedec = self._native.frame_decoder()
         max_bytes = pl.raw_batch_bytes()
         while True:
@@ -259,7 +269,7 @@ class SensorBatches:
                             tracing.spans_dropped.inc()
                         pending.append(ctx)
             if n:
-                keys = slot.keys[:n].copy() if self.keep_keys else None
+                keys = slot.keys[:n].copy() if self._capture_keys else None
                 yield self._emit_chunk(
                     slot.x[:n], self._native_labels(slot.labels[:n], n),
                     keys)
@@ -288,7 +298,7 @@ class SensorBatches:
                 empty = np.zeros((0, self.schema.num_sensors))
                 return self._emit_chunk(
                     empty, np.full((0,), "", object),
-                    np.zeros((0,), "S64") if self.keep_keys else None)
+                    np.zeros((0,), "S64") if self._capture_keys else None)
         if tracing.ENABLED:
             # the zero-copy paths have no per-message Python objects
             # (and no headers) — traces ride this decode path only
@@ -313,7 +323,7 @@ class SensorBatches:
                 tracing.spans_dropped.inc(overflowed)
         n = len(msgs)
         keys = None
-        if self.keep_keys:
+        if self._capture_keys:
             # vectorized truncation: numpy clips each key to the S63
             # itemsize in C (matching the native paths' stride-1 cut),
             # then widens to the shared S64 stride — no per-record
@@ -359,7 +369,7 @@ class SensorBatches:
             if self._ring is not False:
                 return
             # else: raw support vanished mid-stream; fall through
-        fused_attr = "fetch_decode_keys" if self.keep_keys \
+        fused_attr = "fetch_decode_keys" if self._capture_keys \
             else "fetch_decode"
         if self._native is not None and \
                 getattr(self.consumer.broker, fused_attr, None) is not None:
@@ -378,7 +388,7 @@ class SensorBatches:
                     res = self.consumer.poll_decoded(
                         self._native, strip=5,
                         max_messages=self._poll_limit(),
-                        with_keys=self.keep_keys)
+                        with_keys=self._capture_keys)
                 except SchemaIdMismatchError:
                     msgs = self.consumer.poll(self._poll_limit())
                     if msgs:
@@ -389,7 +399,7 @@ class SensorBatches:
                     return
                 yield self._emit_chunk(num,
                                        self._native_labels(lab, len(num)),
-                                       res[2] if self.keep_keys else None)
+                                       res[2] if self._capture_keys else None)
         while True:
             msgs = self.consumer.poll(self._poll_limit())
             if not msgs:
@@ -397,7 +407,15 @@ class SensorBatches:
             yield self._decode_msgs(msgs)
 
     def _filtered_chunks(self):
+        marker = self.exclude_key_marker
         for xs, labels, keys in self._decoded_chunks():
+            if marker is not None and keys is not None and len(keys):
+                # canary firewall: reserved-id records never batch
+                keep = np.char.find(keys, marker) == -1
+                if not keep.all():
+                    xs, labels, keys = xs[keep], labels[keep], keys[keep]
+            if marker is not None and not self.keep_keys:
+                keys = None  # captured for the filter only
             if self.only_normal:
                 keep = labels == "false"
                 xs, labels = xs[keep], labels[keep]

@@ -6,6 +6,13 @@
     python -m iotml.obs fleet [--endpoints MANIFEST] [--port 9200]
                               [--bootstrap HOST:PORT] [--once]
                               [--min-processes N]
+    python -m iotml.obs tsdb query EXPR --bootstrap HOST:PORT
+                              [--time-ms T | --start-ms A --end-ms B
+                               [--step-ms S]] [--json]
+    python -m iotml.obs tsdb slo-status --bootstrap HOST:PORT [--json]
+    python -m iotml.obs tsdb canary-report --bootstrap HOST:PORT
+                              [--window 5m] [--json]
+    python -m iotml.obs tsdb drill [--seed N] [--records N] [--json]
 
 ``trace`` summarizes a span log written by `iotml.obs.tracing`
 (``IOTML_TRACE=1 IOTML_TRACE_PATH=spans.jsonl``) into a per-stage
@@ -23,6 +30,15 @@ endpoint in the manifest (processes auto-join it via
 /metrics + /healthz with ``process=`` labels and ``iotml_cluster_*``
 rollups, and snapshot fleet state into the compacted
 ``_IOTML_METRICS`` changelog.
+
+``tsdb`` is the telemetry-plane surface (ISSUE 17): ``query`` evaluates
+a PromQL-shaped expression (instant or range) against the log-native
+``_IOTML_TSDB`` history over the Kafka wire, ``slo-status`` shows the
+burn-rate gauges + latest ``_IOTML_ALERTS`` state per SLO,
+``canary-report`` reconstructs the synthetic-probe outcome counters and
+e2e latency quantiles from the TSDB, and ``drill`` runs the live
+alert-burn drill (fire → /healthz → resolve; exit status is the
+verdict — CI runs exactly this).
 
 ``--min-stages`` / ``--require-e2e`` / ``--min-processes`` turn the
 summaries into assertions (exit 1 on violation) for CI smoke runs.
@@ -276,16 +292,39 @@ def cmd_fleet(args) -> int:
                   f"expected >= {args.min_processes}", file=sys.stderr)
             return 1
         return 0
+    # with a broker attached the long-running server is the full
+    # telemetry plane: scrapes append TSDB history and the burn-rate
+    # SLO engine (rules from config: IOTML_SLO_*) evaluates beside it
+    appender = engine = sup = None
+    if broker is not None:
+        from ..config import load_config, slo_rules
+        from ..supervise.supervisor import Supervisor
+        from . import slo as _slo
+        from . import tsdb as _tsdb
+
+        cfg, _ = load_config([])
+        appender = _tsdb.TsdbAppender(broker,
+                                      chunk_ms=cfg.slo.tsdb_chunk_ms)
+        engine = _slo.SloEngine(broker, slo_rules(cfg.slo),
+                                interval_s=cfg.slo.interval_s)
+        sup = Supervisor(name="obs-fleet-supervisor")
+        sup.add_loop("slo-engine", engine.loop)
+        sup.start()
     srv = FleetServer(collector, port=args.port,
-                      interval_s=args.interval, broker=broker).start()
+                      interval_s=args.interval, broker=broker,
+                      tsdb=appender).start()
     print(f"fleet metrics on :{srv.port}/metrics (+ /healthz), "
-          f"scraping every {args.interval}s; ctrl-c to stop")
+          f"scraping every {args.interval}s"
+          + ("; TSDB + SLO engine attached" if appender else "")
+          + "; ctrl-c to stop")
     try:
         import time as _time
 
         while True:
             _time.sleep(3600)
     except KeyboardInterrupt:
+        if sup is not None:
+            sup.stop()
         srv.stop()
     return 0
 
@@ -364,6 +403,130 @@ def cmd_dlq(args) -> int:
     return 0
 
 
+def _tsdb_client(bootstrap: str):
+    from ..stream.kafka_wire import KafkaWireBroker
+
+    try:
+        return KafkaWireBroker(bootstrap, client_id="iotml-obs-tsdb")
+    except OSError as e:
+        print(f"cannot reach broker {bootstrap!r}: {e}", file=sys.stderr)
+        return None
+
+
+def cmd_tsdb(args) -> int:
+    """The log-native TSDB surface: query / slo-status / canary-report
+    over the wire, or the live alert-burn drill in-process."""
+    from . import tsdb as _tsdb
+
+    if args.tsdb_cmd == "drill":
+        from .drill import drill_alert_burn
+
+        rep = drill_alert_burn(seed=args.seed, records=args.records)
+        if args.json:
+            print(json.dumps(rep.to_dict(), indent=2, sort_keys=True,
+                             default=str))
+        else:
+            for line in rep.lines():
+                print(line)
+        return 0 if rep.ok else 1
+
+    client = _tsdb_client(args.bootstrap)
+    if client is None:
+        return 2
+    try:
+        series = _tsdb.read_series(client)
+        if args.tsdb_cmd == "query":
+            try:
+                if args.start_ms is not None and args.end_ms is not None:
+                    result = _tsdb.query(series, args.expr,
+                                         start_ms=args.start_ms,
+                                         end_ms=args.end_ms,
+                                         step_ms=args.step_ms)
+                else:
+                    result = _tsdb.query(series, args.expr,
+                                         at_ms=args.time_ms)
+            except ValueError as e:
+                print(f"bad query: {e}", file=sys.stderr)
+                return 2
+            if args.json:
+                print(json.dumps(result, indent=2, sort_keys=True))
+            else:
+                if not result:
+                    print("empty result")
+                for r in result:
+                    labels = ",".join(f"{k}={v}" for k, v in
+                                      sorted(r["labels"].items()))
+                    if "values" in r:
+                        pts = " ".join(f"{t}:{v:.6g}"
+                                       for t, v in r["values"])
+                        print(f"{{{labels}}} {pts}")
+                    else:
+                        print(f"{{{labels}}} {r['value']:.6g}")
+            return 0
+        if args.tsdb_cmd == "slo-status":
+            from . import slo as _slo
+
+            alerts = _slo.read_alerts(client)
+            burns = _tsdb.instant(series, "iotml_slo_burn_rate")
+            doc = {"alerts": alerts,
+                   "burn_rates": [
+                       {"slo": r["labels"].get("slo", ""),
+                        "window": r["labels"].get("window", ""),
+                        "process": r["labels"].get("process", ""),
+                        "burn": r["value"]} for r in burns]}
+            if args.json:
+                print(json.dumps(doc, indent=2, sort_keys=True))
+            else:
+                if not burns and not alerts:
+                    print("no SLO telemetry in the TSDB")
+                for r in doc["burn_rates"]:
+                    print(f"burn {r['slo']}/{r['window']}: "
+                          f"{r['burn']:.2f} [{r['process']}]")
+                for name, a in sorted(alerts.items()):
+                    state = "FIRING" if a.get("firing") else "resolved"
+                    print(f"alert {name}: {state} "
+                          f"(last {a.get('action')} window="
+                          f"{a.get('window') or '-'}) {a.get('message')}")
+            # a firing alert makes the status check itself fail — the
+            # CI/cron shape (like fleet --min-processes)
+            return 1 if any(a.get("firing")
+                            for a in alerts.values()) else 0
+        # canary-report: probe outcomes + e2e quantiles from the TSDB
+        window_ms = _tsdb.parse_duration_ms(args.window)
+        outcomes = {}
+        for r in _tsdb.increase(series, "iotml_canary_probes_total",
+                                window_ms=window_ms):
+            out = r["labels"].get("outcome", "?")
+            outcomes[out] = outcomes.get(out, 0.0) + r["value"]
+        quantiles = {}
+        for q in (0.5, 0.95, 0.99):
+            res = _tsdb.histogram_quantile(
+                series, q, "iotml_canary_e2e_seconds",
+                window_ms=window_ms)
+            if res:
+                quantiles[f"p{int(q * 100)}"] = max(
+                    r["value"] for r in res)
+        doc = {"window": args.window, "outcomes": outcomes,
+               "e2e_quantiles_s": quantiles}
+        if args.json:
+            print(json.dumps(doc, indent=2, sort_keys=True))
+        else:
+            if not outcomes:
+                print(f"no canary probes in the last {args.window}")
+            else:
+                sent = outcomes.get("sent", 0.0)
+                ok = outcomes.get("ok", 0.0)
+                lost = outcomes.get("lost", 0.0)
+                print(f"canaries last {args.window}: sent={sent:.0f} "
+                      f"ok={ok:.0f} lost={lost:.0f}"
+                      + (f" delivery={ok / sent:.4f}" if sent else ""))
+                for name, v in sorted(quantiles.items()):
+                    print(f"  e2e {name}: {v * 1000:.1f} ms")
+        return 0
+    finally:
+        client.close()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m iotml.obs",
@@ -413,6 +576,45 @@ def main(argv=None) -> int:
     fp.add_argument("--follow-manifest", action="store_true",
                     help="re-read the manifest every pass (processes "
                          "may join after the collector starts)")
+    tsp = sub.add_parser(
+        "tsdb", help="log-native TSDB: query the _IOTML_TSDB history, "
+                     "show SLO/canary status, or run the alert-burn "
+                     "drill")
+    tsub = tsp.add_subparsers(dest="tsdb_cmd")
+    qp = tsub.add_parser(
+        "query", help="evaluate a PromQL-shaped expression (selector, "
+                      "rate(), increase(), histogram_quantile())")
+    qp.add_argument("expr", help='e.g. \'rate(iotml_records_scored_'
+                                 'total[5m])\'')
+    qp.add_argument("--bootstrap", required=True,
+                    help="broker address host:port")
+    qp.add_argument("--time-ms", type=int, default=None,
+                    help="instant evaluation timestamp (default: newest)")
+    qp.add_argument("--start-ms", type=int, default=None)
+    qp.add_argument("--end-ms", type=int, default=None,
+                    help="with --start-ms: range query")
+    qp.add_argument("--step-ms", type=int, default=15_000)
+    qp.add_argument("--json", action="store_true")
+    sp = tsub.add_parser(
+        "slo-status", help="burn-rate gauges + latest _IOTML_ALERTS "
+                           "state per SLO (exit 1 while any alert "
+                           "fires)")
+    sp.add_argument("--bootstrap", required=True)
+    sp.add_argument("--json", action="store_true")
+    cp = tsub.add_parser(
+        "canary-report", help="synthetic-probe outcomes and e2e "
+                              "latency quantiles from the TSDB")
+    cp.add_argument("--bootstrap", required=True)
+    cp.add_argument("--window", default="5m",
+                    help="trailing window (e.g. 30s, 5m, 1h)")
+    cp.add_argument("--json", action="store_true")
+    drp = tsub.add_parser(
+        "drill", help="live alert-burn drill: degrade the bridge, "
+                      "prove the fast burn pair fires + resolves "
+                      "(exit status is the verdict)")
+    drp.add_argument("--seed", type=int, default=7)
+    drp.add_argument("--records", type=int, default=600)
+    drp.add_argument("--json", action="store_true")
     dp = sub.add_parser(
         "dlq", help="peek a dead-letter topic's poisoned-record "
                     "envelopes over the Kafka wire protocol")
@@ -436,6 +638,11 @@ def main(argv=None) -> int:
         return cmd_fleet(args)
     if args.cmd == "dlq":
         return cmd_dlq(args)
+    if args.cmd == "tsdb":
+        if not getattr(args, "tsdb_cmd", None):
+            tsp.print_help()
+            return 2
+        return cmd_tsdb(args)
     ap.print_help()
     return 2
 

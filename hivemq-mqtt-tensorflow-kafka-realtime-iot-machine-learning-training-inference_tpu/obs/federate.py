@@ -148,15 +148,32 @@ def parse_prom_text(text: str) -> Tuple[Dict[str, str], List[tuple]]:
 def _parse_sample(line: str) -> tuple:
     if "{" in line:
         name, rest = line.split("{", 1)
-        lab_str, _, val_str = rest.rpartition("}")
+        # the value follows the LAST close brace; a `}` INSIDE a quoted
+        # label value cannot be last (escape-aware label parsing below
+        # rejects a truncated pair, so a mis-split fails loudly instead
+        # of yielding a corrupt sample)
+        lab_str, brace, val_str = rest.rpartition("}")
+        if not brace:
+            raise ValueError(line)  # `{` without `}` — truncated line
         labels = _parse_labels(lab_str)
     else:
-        name, _, val_str = line.partition(" ")
+        parts = line.split(None, 1)  # any whitespace run separates
+        if len(parts) != 2:
+            raise ValueError(line)
+        name, val_str = parts
         labels = {}
     val_str = val_str.strip()
     if not val_str:
         raise ValueError(line)
+    # optional trailing timestamp (Prometheus exposition): value is the
+    # first field.  float() covers NaN / +Inf / -Inf spellings.
     return name.strip(), labels, float(val_str.split()[0])
+
+
+#: exposition escape sequences (the render-side _escape_label_value
+#: inverse): backslash, double-quote, line feed.  Unknown escapes pass
+#: the escaped character through (OpenMetrics's lenient reading).
+_LABEL_ESCAPES = {"n": "\n", "\\": "\\", '"': '"'}
 
 
 def _parse_labels(lab_str: str) -> Dict[str, str]:
@@ -165,24 +182,34 @@ def _parse_labels(lab_str: str) -> Dict[str, str]:
     while i < n:
         eq = lab_str.find("=", i)
         if eq < 0:
+            if lab_str[i:].strip(", \t"):
+                raise ValueError(lab_str)  # trailing garbage, not a pair
             break
         key = lab_str[i:eq].strip().lstrip(",").strip()
+        if not key:
+            raise ValueError(lab_str)
         if eq + 1 >= n or lab_str[eq + 1] != '"':
             raise ValueError(lab_str)
         j = eq + 2
         out = []
+        closed = False
         while j < n:
             c = lab_str[j]
             if c == "\\" and j + 1 < n:
                 nxt = lab_str[j + 1]
-                out.append({"n": "\n", "\\": "\\", '"': '"'}
-                           .get(nxt, nxt))
+                out.append(_LABEL_ESCAPES.get(nxt, nxt))
                 j += 2
                 continue
             if c == '"':
+                closed = True
                 break
             out.append(c)
             j += 1
+        if not closed:
+            # unterminated value: the line was truncated (or the value
+            # sample-split above mis-fired on a `}` inside a quote) —
+            # reject the whole sample rather than store a corrupt tail
+            raise ValueError(lab_str)
         labels[key] = "".join(out)
         i = j + 1
     return labels
@@ -435,6 +462,26 @@ class FleetCollector:
             out[name] = doc
         return out
 
+    def append_tsdb(self, appender,
+                    snapshots: Optional[Dict[str, dict]] = None) -> int:
+        """Append every scraped sample to the log-native TSDB (ISSUE
+        17), one chunked write set per process with the federation
+        ``process=`` relabel applied at write time — history for the
+        query engine beside the latest-only _IOTML_METRICS snapshot.
+        Returns chunk records appended."""
+        if snapshots is None:
+            with self._lock:
+                snapshots = dict(self.snapshots)
+        n = 0
+        for name in sorted(snapshots):
+            s = snapshots[name]
+            if not s["up"] or not s["samples"]:
+                continue
+            n += appender.append(s["samples"],
+                                 ts_ms=int(s["ts"] * 1000),
+                                 process=name)
+        return n
+
     def snapshot_changelog(self, broker,
                            snapshots: Optional[Dict[str, dict]] = None
                            ) -> int:
@@ -493,10 +540,13 @@ class FleetServer:
     runtime)."""
 
     def __init__(self, collector: FleetCollector, port: int = 9200,
-                 interval_s: float = 2.0, broker=None):
+                 interval_s: float = 2.0, broker=None, tsdb=None):
         self.collector = collector
         self.interval_s = interval_s
         self.broker = broker
+        #: optional tsdb.TsdbAppender: every scrape's samples append to
+        #: the log-native TSDB beside the latest-only changelog
+        self.tsdb = tsdb
         self._stop = threading.Event()
         import http.server
 
@@ -535,6 +585,11 @@ class FleetServer:
                 self.collector.snapshot_changelog(self.broker, snaps)
             except (ConnectionError, OSError):
                 pass  # broker down: the merged /metrics still serves
+        if self.tsdb is not None:
+            try:
+                self.collector.append_tsdb(self.tsdb, snaps)
+            except (ConnectionError, OSError):
+                pass  # same degradation contract as the changelog
         return snaps
 
     def _loop(self) -> None:

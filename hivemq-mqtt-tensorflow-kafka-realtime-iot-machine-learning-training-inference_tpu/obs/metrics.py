@@ -287,7 +287,13 @@ cluster_metadata_refreshes = default_registry.counter(
     "cluster metadata refreshes performed by routing clients")
 cluster_shard_failovers = default_registry.counter(
     "iotml_cluster_shard_failovers_total",
-    "per-shard leader failovers (one shard moved, not the world)")
+    "per-shard leader failovers (one shard moved, not the world; "
+    "label shard= says WHICH — the TSDB query surface can tell a "
+    "flapping shard from spread-out churn)")
+cluster_shard_epoch = default_registry.gauge(
+    "iotml_cluster_shard_epoch",
+    "current leadership epoch per shard (a bump = a promotion; the "
+    "federated scrape carries the per-shard label into the TSDB)")
 cluster_coordinator_moves = default_registry.counter(
     "iotml_cluster_coordinator_moves_total",
     "group-coordinator re-discoveries after NOT_COORDINATOR or a "
@@ -445,7 +451,7 @@ quorum_hwm_lag = default_registry.gauge(
 ALLOWED_LABEL_KEYS = frozenset({
     "stage", "topic", "partition", "group", "phase", "loop", "process",
     "component", "detector", "action", "fault", "source", "outcome",
-    "unit", "le",
+    "unit", "le", "slo", "window", "shard",
 })
 
 #: per-metric ceiling on distinct label-value combinations.  Generous —
@@ -460,8 +466,12 @@ MAX_LABEL_SERIES = 256
 #: rule D2), caught before the new dimension multiplies series in
 #: production.  Metrics absent from the table take no labels.
 DECLARED_METRIC_LABELS = {
+    "alert_transitions": ("action",),
+    "canary_probes": ("outcome",),
     "chaos_injected": ("fault",),
     "checkpoint_seconds": ("phase",),
+    "cluster_shard_epoch": ("shard",),
+    "cluster_shard_failovers": ("shard",),
     "consumer_autoresets": ("topic",),
     "consumer_lag_records": ("group", "partition", "topic"),
     "dlq_total": ("source",),
@@ -474,6 +484,7 @@ DECLARED_METRIC_LABELS = {
     "quorum_hwm_lag": ("partition", "topic"),
     "replica_lag": ("topic",),
     "rollouts": ("outcome",),
+    "slo_burn_rate": ("slo", "window"),
     "step_seconds": ("loop", "phase"),
     "supervisor_degraded": ("unit",),
     "supervisor_failovers": ("unit",),
@@ -613,6 +624,19 @@ def start_http_server(port: int = 9100, registry: Registry = default_registry):
                 (f"{dict(k).get('group', '')}:{dict(k).get('topic', '')}"
                  f":{dict(k).get('partition', '')}"): v
                 for k, v in sorted(clag_vals.items())}
+        # SLO burn-rate alerts (ISSUE 17): firing alerts from any live
+        # SloEngine in this process, surfaced where probes already
+        # look.  Late import with a guard, like the supervisor block —
+        # a process without the SLO engine must not pay for it.
+        try:
+            from . import slo as _slo
+
+            firing = _slo.firing_alerts()
+            if firing:
+                doc["alerts"] = firing
+                doc["status"] = "degraded"
+        except Exception:  # noqa: BLE001 - health endpoint stays up
+            pass
         epoch = failover_epoch.value()
         if epoch:
             doc["failover_epoch"] = epoch

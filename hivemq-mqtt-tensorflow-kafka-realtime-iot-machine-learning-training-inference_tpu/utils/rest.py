@@ -51,7 +51,17 @@ class RestServer:
                         self._send(400, {"error_code": 400,
                                          "message": "malformed JSON body"})
                         return
-                path = self.path.split("?", 1)[0]  # routes ignore the query
+                # routes match the bare path; query-string params merge
+                # into the body dict (first value wins, body takes
+                # precedence) so GET endpoints can take parameters —
+                # the TSDB query surface (`/query?query=...`) reads
+                # them exactly like a POSTed JSON field
+                path, _, qs = self.path.partition("?")
+                if qs:
+                    from urllib.parse import parse_qs
+
+                    for k, vs in parse_qs(qs).items():
+                        body.setdefault(k, vs[0])
                 for m, pat, fn in outer._routes:
                     if m != method:
                         continue

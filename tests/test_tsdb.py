@@ -1,0 +1,718 @@
+"""Log-native TSDB + burn-rate SLO engine + canary plane (ISSUE 17).
+
+Covers the telemetry-plane contracts: delta-encoded chunk append →
+replay round trip, the PromQL-subset query engine (instant/range,
+matchers, reset-corrected ``rate()``, ``histogram_quantile``), the
+counter-reset regression under a REAL supervised restart, TSDB
+boundedness under forced compaction, the incremental ``TsdbTail``
+reader, SLO fire→resolve transitions on the ``_IOTML_ALERTS``
+changelog, the canary firewall (reserved ids never reach scoring), the
+trace-sourced canary e2e through the real MQTT→bridge→converter path,
+the ``/query`` REST surface, and the ``parse_prom_text`` round trip.
+"""
+
+import http.client
+import json
+import math
+import threading
+import time
+import urllib.parse
+
+import numpy as np
+import pytest
+
+from iotml.data.dataset import SensorBatches
+from iotml.gen.simulator import FleetGenerator, FleetScenario
+from iotml.obs import canary as canary_mod
+from iotml.obs import federate, slo as slo_mod, tracing, tsdb
+from iotml.obs import metrics as metrics_mod
+from iotml.stream import Broker
+from iotml.stream.consumer import StreamConsumer
+from iotml.supervise.supervisor import Supervisor
+
+BASE_TS = 1_700_000_000_000  # fixed event-time origin for all samples
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    tracing.reset()
+    yield
+    tracing.configure(enabled=False, sample=1.0, path="")
+    tracing.reset()
+
+
+def _count_records(broker, topic, partition=0):
+    n = 0
+    off = broker.begin_offset(topic, partition)
+    end = broker.end_offset(topic, partition)
+    while off < end:
+        batch = broker.fetch(topic, partition, off, 4096)
+        if not batch:
+            break
+        for m in batch:
+            off = m.offset + 1
+            n += 1
+    return n
+
+
+# ------------------------------------------------------------ appender
+def test_appender_roundtrip_delta_encoding():
+    broker = Broker()
+    app = tsdb.TsdbAppender(broker, chunk_ms=1_000)
+    for i in range(25):  # 100 ms cadence across 3 chunk windows
+        app.append([("iotml_rt_total", {"job": "a"}, float(i)),
+                    ("iotml_rt_gauge", {}, float(i % 4))],
+                   ts_ms=BASE_TS + i * 100)
+
+    # the wire chunks really are delta-encoded: t[0] absolute, the rest
+    # the (small) scrape-cadence deltas
+    raw = broker.fetch(tsdb.TSDB_TOPIC, 0, 0, 4)
+    doc = json.loads(raw[0].value)
+    assert doc["t"][0] >= BASE_TS
+    assert all(d == 100 for d in doc["t"][1:])
+
+    series = tsdb.read_series(broker)
+    sid = tsdb.series_id("iotml_rt_total", {"job": "a"})
+    got = series[sid]["samples"]
+    assert got == [(BASE_TS + i * 100, float(i)) for i in range(25)]
+    assert series[sid]["l"] == {"job": "a"}
+    # re-appending a window keeps the newest (most complete) copy only
+    assert len(tsdb.read_series(broker)[sid]["samples"]) == 25
+
+
+def test_appender_dedup_and_ordering_rules():
+    broker = Broker()
+    app = tsdb.TsdbAppender(broker, chunk_ms=60_000)
+    app.append([("m", {}, 1.0)], ts_ms=BASE_TS)
+    app.append([("m", {}, 9.0)], ts_ms=BASE_TS)        # same stamp: LWW
+    app.append([("m", {}, 5.0)], ts_ms=BASE_TS - 10)   # out of order: drop
+    app.append([("m", {}, 2.0)], ts_ms=BASE_TS + 500)
+    samples = tsdb.read_series(broker)[tsdb.series_id("m", {})]["samples"]
+    assert samples == [(BASE_TS, 9.0), (BASE_TS + 500, 2.0)]
+
+    # process relabel applied at write time
+    app.append([("m", {}, 3.0)], ts_ms=BASE_TS + 600, process="scorer")
+    sid = tsdb.series_id("m", {"process": "scorer"})
+    assert tsdb.read_series(broker)[sid]["l"] == {"process": "scorer"}
+
+
+# ------------------------------------------------------------- queries
+def _mkseries(points):
+    """points: {(name, labels-tuple): [(ts, v)...]} → series dict."""
+    out = {}
+    for (name, labels), samples in points.items():
+        labels = dict(labels)
+        out[tsdb.series_id(name, labels)] = {
+            "n": name, "l": labels, "samples": sorted(samples)}
+    return out
+
+
+def test_instant_and_range_with_matchers():
+    series = _mkseries({
+        ("up", (("job", "scorer"),)): [(BASE_TS + i * 1_000, 1.0)
+                                       for i in range(10)],
+        ("up", (("job", "trainer"),)): [(BASE_TS + i * 1_000, 0.0)
+                                        for i in range(10)],
+    })
+    at = BASE_TS + 9_000
+    allr = tsdb.instant(series, "up", at_ms=at)
+    assert {tuple(r["labels"].items()) for r in allr} == {
+        (("job", "scorer"),), (("job", "trainer"),)}
+
+    eq = tsdb.instant(series, "up", [tsdb.Matcher("job", "=", "scorer")],
+                      at_ms=at)
+    assert len(eq) == 1 and eq[0]["value"] == 1.0
+    ne = tsdb.instant(series, "up", [tsdb.Matcher("job", "!=", "scorer")],
+                      at_ms=at)
+    assert len(ne) == 1 and ne[0]["labels"]["job"] == "trainer"
+    rex = tsdb.instant(series, "up", [tsdb.Matcher("job", "=~", "sc.*")],
+                       at_ms=at)
+    assert len(rex) == 1 and rex[0]["labels"]["job"] == "scorer"
+    nrex = tsdb.instant(series, "up", [tsdb.Matcher("job", "!~", "sc.*")],
+                        at_ms=at)
+    assert len(nrex) == 1 and nrex[0]["labels"]["job"] == "trainer"
+
+    # staleness: an instant past the lookback answers nothing
+    assert tsdb.instant(series, "up", at_ms=at + 400_000) == []
+
+    # range: last-observed carry at every step, staleness-bounded
+    rq = tsdb.range_query(series, "up",
+                          [tsdb.Matcher("job", "=", "scorer")],
+                          start_ms=BASE_TS, end_ms=BASE_TS + 20_000,
+                          step_ms=5_000)
+    assert len(rq) == 1
+    assert rq[0]["values"] == [(BASE_TS + k * 5_000, 1.0)
+                               for k in range(5)]
+
+
+def test_parse_selector_and_query_expressions():
+    name, matchers, window = tsdb.parse_selector(
+        'iotml_x_total{job="a",mode=~"b.*"}[5m]')
+    assert name == "iotml_x_total" and window == 300_000
+    assert [(m.key, m.op, m.value) for m in matchers] == [
+        ("job", "=", "a"), ("mode", "=~", "b.*")]
+    with pytest.raises(ValueError):
+        tsdb.parse_selector("{nometric}")
+    with pytest.raises(ValueError):
+        tsdb.parse_duration_ms("5x")
+
+    series = _mkseries({
+        ("c_total", ()): [(BASE_TS + i * 1_000, float(10 * i))
+                          for i in range(30)]})
+    at = BASE_TS + 29_000
+    r = tsdb.query(series, "rate(c_total[30s])", at_ms=at)
+    assert len(r) == 1 and r[0]["value"] == pytest.approx(10.0)
+    inc = tsdb.query(series, "increase(c_total[10s])", at_ms=at)
+    assert inc[0]["value"] == pytest.approx(100.0)
+    with pytest.raises(ValueError):
+        tsdb.query(series, "rate(c_total)")         # needs a [window]
+    with pytest.raises(ValueError):
+        tsdb.query(series, "c_total[5m]")           # bare selector + window
+    ranged = tsdb.query(series, "rate(c_total[10s])",
+                        start_ms=BASE_TS + 15_000, end_ms=at,
+                        step_ms=7_000)
+    assert ranged and all(v == pytest.approx(10.0)
+                          for _, v in ranged[0]["values"])
+
+
+# ------------------------------------------------- counter-reset rate()
+def test_rate_counter_reset_never_negative():
+    series = _mkseries({
+        ("req_total", ()): [
+            (BASE_TS, 100.0), (BASE_TS + 1_000, 150.0),
+            (BASE_TS + 2_000, 200.0),
+            (BASE_TS + 3_000, 5.0),    # restart: counter re-starts low
+            (BASE_TS + 4_000, 55.0)]})
+    before = tsdb.tsdb_resets.value()
+    r = tsdb.rate(series, "req_total", window_ms=60_000,
+                  at_ms=BASE_TS + 4_000)
+    assert len(r) == 1
+    assert r[0]["value"] >= 0.0
+    # increase = 50 + 50 + 5 (post-reset absolute) + 50 over 4 s
+    assert r[0]["value"] == pytest.approx(155.0 / 4.0)
+    assert r[0]["resets"] == 1
+    assert tsdb.tsdb_resets.value() == before + 1
+
+
+def test_supervised_restart_mid_scrape_rate_regression():
+    """ISSUE 17 satellite (b): restart a supervised unit mid-scrape
+    stream; the unit's process-local counter re-starts from zero, and
+    ``rate()`` over the stored samples must read that as a reset
+    (counted in iotml_tsdb_resets_total), never as a negative rate."""
+    broker = Broker()
+    app = tsdb.TsdbAppender(broker, chunk_ms=3_600_000)
+    tick = {"i": 0}
+    crashed = threading.Event()
+    finished = threading.Event()
+
+    def scrape_loop(unit):
+        count = 0.0  # process-local: the restart re-creates it at zero
+        while not unit.should_stop():
+            count += 10.0
+            i = tick["i"]
+            tick["i"] += 1
+            app.append([("iotml_unit_work_total", {"unit": "w"}, count)],
+                       ts_ms=BASE_TS + i * 1_000)
+            unit.heartbeat()
+            if not crashed.is_set() and count >= 50.0:
+                crashed.set()
+                raise RuntimeError("simulated crash mid-scrape")
+            if crashed.is_set() and count >= 30.0:
+                finished.set()
+                while not unit.should_stop():
+                    time.sleep(0.01)
+                return
+            time.sleep(0.005)
+
+    before = tsdb.tsdb_resets.value()
+    sup = Supervisor(poll_interval_s=0.02, name="tsdb-reset-test")
+    unit = sup.add_loop("scraper", scrape_loop, heartbeat_timeout_s=30.0)
+    sup.start()
+    try:
+        assert finished.wait(10.0), "supervised unit never recovered"
+    finally:
+        sup.stop()
+    assert unit.restarts == 1
+    assert "simulated crash" in (unit.last_error or "")
+
+    series = tsdb.read_series(broker)
+    r = tsdb.rate(series, "iotml_unit_work_total", window_ms=3_600_000)
+    assert len(r) == 1
+    assert r[0]["value"] >= 0.0, "rate went negative across a restart"
+    assert r[0]["resets"] == 1
+    assert tsdb.tsdb_resets.value() == before + 1
+    # every evaluation point across the restart boundary stays >= 0
+    for i in range(1, tick["i"]):
+        for p in tsdb.rate(series, "iotml_unit_work_total",
+                           window_ms=3_600_000,
+                           at_ms=BASE_TS + i * 1_000):
+            assert p["value"] >= 0.0
+
+
+# ---------------------------------------------------- histogram_quantile
+def _bucket_width(buckets, value):
+    prev = 0.0
+    for b in buckets:
+        if value <= b:
+            return b - prev
+        prev = b
+    return float("inf")
+
+
+def _quantile_via_tsdb(values, buckets, q):
+    """Render a real Histogram, parse the exposition, append the parsed
+    samples into the TSDB, read back, interpolate — the whole path the
+    federated scrape exercises."""
+    reg = metrics_mod.Registry()
+    h = reg.histogram("iotml_q_seconds", buckets=buckets)
+    for v in values:
+        h.observe(float(v))
+    _types, samples = federate.parse_prom_text(reg.render())
+    broker = Broker()
+    tsdb.TsdbAppender(broker, chunk_ms=60_000).append(samples,
+                                                      ts_ms=BASE_TS)
+    series = tsdb.read_series(broker)
+    out = tsdb.histogram_quantile(series, q, "iotml_q_seconds",
+                                  at_ms=BASE_TS)
+    assert len(out) == 1
+    return out[0]["value"]
+
+
+def test_histogram_quantile_uniform_within_bucket_width():
+    buckets = tuple(round(0.1 * k, 1) for k in range(1, 11))  # 0.1 .. 1.0
+    rng = np.random.default_rng(42)
+    values = rng.uniform(0.0, 1.0, size=4_000)
+    for q in (0.1, 0.5, 0.9, 0.99):
+        est = _quantile_via_tsdb(values, buckets, q)
+        true = float(np.quantile(values, q))
+        tol = _bucket_width(buckets, true)
+        assert abs(est - true) <= tol, (q, est, true, tol)
+
+
+def test_histogram_quantile_bimodal_within_bucket_width():
+    # two separated modes: the winning bucket flips between them as q
+    # crosses the mass split, and interpolation must stay inside it
+    buckets = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+    rng = np.random.default_rng(7)
+    low = rng.uniform(0.0, 0.05, size=3_000)    # healthy mode (60 %)
+    high = rng.uniform(0.6, 0.9, size=2_000)    # degraded mode (40 %)
+    values = np.concatenate([low, high])
+    for q in (0.25, 0.5, 0.75, 0.95):
+        est = _quantile_via_tsdb(values, buckets, q)
+        true = float(np.quantile(values, q))
+        tol = _bucket_width(buckets, true)
+        assert abs(est - true) <= tol, (q, est, true, tol)
+    # the modes really are resolved: p25 in the low cluster, p95 high
+    assert _quantile_via_tsdb(values, buckets, 0.25) < 0.1
+    assert _quantile_via_tsdb(values, buckets, 0.95) > 0.5
+
+
+def test_histogram_quantile_rejects_bad_q():
+    with pytest.raises(ValueError):
+        tsdb.histogram_quantile({}, 1.5, "x")
+
+
+# ------------------------------------------------- compaction boundedness
+def test_tsdb_bounded_under_forced_compaction(tmp_path):
+    broker = Broker(store_dir=str(tmp_path))
+    app = tsdb.TsdbAppender(broker, chunk_ms=1_000)
+    for i in range(200):  # 10 samples per window, 20 windows, 2 series
+        app.append([("iotml_b_total", {}, float(i)),
+                    ("iotml_b_gauge", {"k": "v"}, float(i % 7))],
+                   ts_ms=BASE_TS + i * 100)
+    pre = _count_records(broker, tsdb.TSDB_TOPIC)
+    assert pre == 400  # every scrape re-appended its window's chunk
+    before = tsdb.read_series(broker)
+
+    broker.store.log_for(tsdb.TSDB_TOPIC, 0).roll()
+    broker.run_compaction(force=True)
+
+    post = _count_records(broker, tsdb.TSDB_TOPIC)
+    assert post == 40  # one record per live (series, window) key
+    # compaction kept the newest chunk copies: the replay is identical
+    assert tsdb.read_series(broker) == before
+
+
+# ----------------------------------------------------------- TsdbTail
+def test_tsdb_tail_matches_read_series_and_is_incremental():
+    broker = Broker()
+    app = tsdb.TsdbAppender(broker, chunk_ms=1_000)
+    for i in range(30):
+        app.append([("a_total", {}, float(i)),
+                    ("b_total", {"x": "1"}, float(2 * i))],
+                   ts_ms=BASE_TS + i * 100)
+    now = BASE_TS + 3_000
+    tail = tsdb.TsdbTail(broker)
+    assert tail.collect(now) == tsdb.read_series(broker)
+
+    # incremental: only the new records are decoded, same answer
+    for i in range(30, 60):
+        app.append([("a_total", {}, float(i))], ts_ms=BASE_TS + i * 100)
+    assert tail.collect(BASE_TS + 6_000) == tsdb.read_series(broker)
+
+    # family filter: the tail skips everything the rules don't read
+    only_a = tsdb.TsdbTail(broker, names={"a_total"}).collect(
+        BASE_TS + 6_000)
+    assert set(s["n"] for s in only_a.values()) == {"a_total"}
+
+    # lookback: chunks whose newest sample aged out are pruned
+    bounded = tsdb.TsdbTail(broker, lookback_ms=2_000)
+    got = bounded.collect(BASE_TS + 6_000)
+    for s in got.values():
+        assert all(ts >= BASE_TS + 4_000 for ts, _v in s["samples"])
+
+
+def test_tsdb_tail_empty_topic():
+    broker = Broker()
+    assert tsdb.TsdbTail(broker).collect(BASE_TS) == {}
+
+
+# ----------------------------------------------------------- SLO engine
+def _ratio_rule(**over):
+    doc = {"name": "api-availability", "objective": 0.99,
+           "indicator": {"kind": "ratio", "bad": "err_total",
+                         "total": "req_total"},
+           "windows": (("fast", 2_000, 6_000, 10.0),
+                       ("slow", 4_000, 12_000, 5.0))}
+    doc.update(over)
+    return doc
+
+
+def _ratio_series(err_rate, n_s=60, step_ms=1_000):
+    """req at 10/s; errors at err_rate fraction of them, cumulative."""
+    req, err = [], []
+    total = bad = 0.0
+    for i in range(n_s):
+        total += 10.0
+        bad += 10.0 * err_rate
+        req.append((BASE_TS + i * step_ms, total))
+        err.append((BASE_TS + i * step_ms, bad))
+    return _mkseries({("req_total", ()): req, ("err_total", ()): err})
+
+
+def test_slo_engine_fire_and_resolve_transitions():
+    broker = Broker()
+    engine = slo_mod.SloEngine(broker, [_ratio_rule()], interval_s=0.1)
+    now = BASE_TS + 59_000
+
+    # healthy: zero errors → no transition, burn 0
+    assert engine.evaluate(series=_ratio_series(0.0), now_ms=now) == []
+    assert not engine.states["api-availability"].firing
+
+    # total outage: 100 % errors → burn = 1/0.01 = 100 on BOTH legs of
+    # the fast pair → fire, transition lands on _IOTML_ALERTS
+    trans = engine.evaluate(series=_ratio_series(1.0), now_ms=now)
+    assert [t["action"] for t in trans] == ["fire"]
+    st = engine.states["api-availability"]
+    assert st.firing and st.window == "fast"
+    assert st.burn["fast"] == pytest.approx(100.0)
+    assert slo_mod.slo_burn_rate.value(
+        slo="api-availability", window="fast") == pytest.approx(100.0)
+    assert "api-availability" in slo_mod.firing_alerts()
+    doc = slo_mod.read_alerts(broker)["api-availability"]
+    assert doc["action"] == "fire" and doc["firing"] is True
+
+    # still burning: no duplicate transition
+    assert engine.evaluate(series=_ratio_series(1.0), now_ms=now) == []
+
+    # recovery: errors stop → resolve transition, /healthz surface clears
+    trans = engine.evaluate(series=_ratio_series(0.0), now_ms=now)
+    assert [t["action"] for t in trans] == ["resolve"]
+    assert not engine.states["api-availability"].firing
+    assert "api-availability" not in slo_mod.firing_alerts()
+    doc = slo_mod.read_alerts(broker)["api-availability"]
+    assert doc["action"] == "resolve" and doc["firing"] is False
+
+
+def test_slo_short_spike_alone_never_pages():
+    """Multi-window discipline: the SHORT leg burning while the long
+    window is still healthy must not fire (the workbook's defence
+    against paging on a blip)."""
+    broker = Broker()
+    engine = slo_mod.SloEngine(broker, [_ratio_rule()], interval_s=0.1)
+    # 60 s of clean traffic, then a 1 s error blip sized so the 2 s
+    # short window burns (3/20 = 15x budget) while the 6 s long window
+    # stays under threshold (3/60 = 5x budget < 10)
+    req, err = [], []
+    total = bad = 0.0
+    for i in range(60):
+        total += 10.0
+        if i == 59:
+            bad += 3.0
+        req.append((BASE_TS + i * 1_000, total))
+        err.append((BASE_TS + i * 1_000, bad))
+    series = _mkseries({("req_total", ()): req, ("err_total", ()): err})
+    assert engine.evaluate(series=series, now_ms=BASE_TS + 59_000) == []
+    assert not engine.states["api-availability"].firing
+
+
+def test_slo_no_traffic_is_no_burn():
+    broker = Broker()
+    engine = slo_mod.SloEngine(broker, [_ratio_rule()], interval_s=0.1)
+    assert engine.evaluate(series={}, now_ms=BASE_TS) == []
+    assert engine.states["api-availability"].burn["fast"] == 0.0
+
+
+def test_slo_latency_indicator_over_buckets():
+    rule = {"name": "lat", "objective": 0.9,
+            "indicator": {"kind": "latency", "metric": "lat_seconds",
+                          "threshold_s": 0.1},
+            "windows": (("fast", 2_000, 6_000, 5.0),)}
+    broker = Broker()
+    engine = slo_mod.SloEngine(broker, [rule], interval_s=0.1)
+
+    reg = metrics_mod.Registry()
+    h = reg.histogram("lat_seconds", buckets=(0.05, 0.1, 0.5, 1.0))
+    app = tsdb.TsdbAppender(broker, chunk_ms=60_000)
+    # scrape 0: nothing yet; then every observation is slow (1.0 > 0.1)
+    app.append(federate.parse_prom_text(reg.render())[1], ts_ms=BASE_TS)
+    for _ in range(50):
+        h.observe(1.0)
+    app.append(federate.parse_prom_text(reg.render())[1],
+               ts_ms=BASE_TS + 1_000)
+    series = tsdb.read_series(broker)
+    trans = engine.evaluate(series=series, now_ms=BASE_TS + 1_000)
+    assert [t["action"] for t in trans] == ["fire"]
+    # err = 1.0, budget = 0.1 → burn 10
+    assert engine.states["lat"].burn["fast"] == pytest.approx(10.0)
+
+
+def test_slo_rule_validation():
+    with pytest.raises(ValueError):
+        slo_mod.SloRule.from_dict({"objective": 0.9})          # no name
+    with pytest.raises(ValueError):
+        slo_mod.SloRule.from_dict(_ratio_rule(objective=1.5))
+    with pytest.raises(ValueError):
+        slo_mod.SloRule.from_dict(
+            {"name": "x", "objective": 0.9,
+             "indicator": {"kind": "bogus"}})
+    r = slo_mod.SloRule.from_dict(_ratio_rule())
+    assert r.error_budget == pytest.approx(0.01)
+
+
+def test_slo_engine_indicator_families_bound_the_tail():
+    broker = Broker()
+    engine = slo_mod.SloEngine(
+        broker, canary_mod.default_slo_rules(), interval_s=0.1)
+    assert engine._indicator_families() == {
+        "iotml_canary_e2e_seconds_bucket", "iotml_canary_probes_total"}
+
+
+# ------------------------------------------------------------- canaries
+def test_sensor_batches_firewall_excludes_canary_records():
+    broker = Broker()
+    gen = FleetGenerator(FleetScenario(num_cars=10, failure_rate=0.0,
+                                       seed=3))
+    n = gen.publish(broker, "SENSOR_DATA_S_AVRO", n_ticks=5)
+    assert n == 50
+    # canary records are schema-valid fleet bytes under a reserved key
+    tmpl = broker.fetch("SENSOR_DATA_S_AVRO", 0, 0, 1)[0].value
+    for seq in (1, 2, 3):
+        broker.produce(
+            "SENSOR_DATA_S_AVRO", tmpl,
+            key=b"vehicles/sensor/data/canary-%08d" % seq)
+
+    consumer = StreamConsumer(broker, ["SENSOR_DATA_S_AVRO:0:0"],
+                              group="fw-armed", eof=True)
+    armed = SensorBatches(
+        consumer, batch_size=10, pad_tail=False,
+        exclude_key_marker=canary_mod.CANARY_KEY_MARKER)
+    assert sum(b.n_valid for b in armed) == 50
+    assert armed.records_seen == 53  # it SAW the canaries, then dropped
+
+    control = SensorBatches(
+        StreamConsumer(broker, ["SENSOR_DATA_S_AVRO:0:0"],
+                       group="fw-off", eof=True),
+        batch_size=1, pad_tail=False)
+    assert sum(b.n_valid for b in control) == 53
+
+
+def test_canary_probe_e2e_through_real_path_is_trace_sourced():
+    from iotml.mqtt.bridge import KafkaBridge
+    from iotml.mqtt.broker import MqttBroker
+    from iotml.streamproc.tasks import JsonToAvro
+
+    tracing.configure(enabled=True, sample=1.0)
+    tracing.reset()
+    mqtt = MqttBroker()
+    stream = Broker()
+    KafkaBridge(mqtt, stream, partitions=1)
+    task = JsonToAvro(stream, src="sensor-data",
+                      dst="SENSOR_DATA_S_AVRO", partitions=1)
+    probe = canary_mod.CanaryProbe(mqtt, stream,
+                                   topic="SENSOR_DATA_S_AVRO",
+                                   interval_s=0.05, timeout_s=2.0)
+    for _ in range(3):
+        probe.probe_once()
+        task.process_available()
+        probe.observe()
+    rep = probe.report()
+    assert rep["sent"] == 3 and rep["ok"] == 3 and rep["lost"] == 0
+    # e2e came from the PR 2 trace span headers, not the fallback clock
+    assert rep["trace_sourced"] == 3
+    assert rep["inflight"] == 0
+    assert 0.0 <= rep["last_e2e_s"] < 5.0
+
+
+def test_canary_probe_times_out_lost_records():
+    from iotml.mqtt.broker import MqttBroker
+
+    mqtt = MqttBroker()  # NO bridge: published probes never arrive
+    stream = Broker()
+    probe = canary_mod.CanaryProbe(mqtt, stream,
+                                   topic="SENSOR_DATA_S_AVRO",
+                                   interval_s=0.05, timeout_s=0.05)
+    probe.probe_once()
+    time.sleep(0.1)
+    probe.observe()
+    rep = probe.report()
+    assert rep["lost"] == 1 and rep["ok"] == 0 and rep["inflight"] == 0
+
+
+def test_default_slo_rules_shape():
+    rules = [slo_mod.SloRule.from_dict(d)
+             for d in canary_mod.default_slo_rules(window_scale=0.5)]
+    assert {r.name for r in rules} == {"canary-e2e-latency",
+                                       "canary-delivery"}
+    assert all(r.window_scale == 0.5 for r in rules)
+
+
+# ---------------------------------------------------------- REST surface
+def test_rest_query_and_query_range():
+    from iotml.connect import ConnectServer, ConnectWorker
+
+    broker = Broker()
+    app = tsdb.TsdbAppender(broker, chunk_ms=60_000)
+    for i in range(30):
+        app.append([("iotml_http_total", {"job": "a"}, float(10 * i)),
+                    ("iotml_http_total", {"job": "b"}, float(i))],
+                   ts_ms=BASE_TS + i * 1_000)
+
+    server = ConnectServer(ConnectWorker(broker),
+                           poll_interval_s=9999).start()
+    try:
+        server.attach_tsdb(broker)
+        conn = http.client.HTTPConnection(server.host, server.port,
+                                          timeout=5)
+
+        def get(path):
+            conn.request("GET", path)
+            r = conn.getresponse()
+            return r.status, json.loads(r.read())
+
+        q = urllib.parse.quote('iotml_http_total{job="a"}', safe="")
+        status, body = get(f"/query?query={q}&time_ms={BASE_TS + 29_000}")
+        assert status == 200 and body["status"] == "success"
+        assert body["data"] == [{"labels": {"job": "a"},
+                                 "ts_ms": BASE_TS + 29_000,
+                                 "value": 290.0}]
+
+        q = urllib.parse.quote('rate(iotml_http_total{job="a"}[10s])',
+                               safe="")
+        status, body = get(f"/query?query={q}&time_ms={BASE_TS + 29_000}")
+        assert status == 200
+        assert body["data"][0]["value"] == pytest.approx(10.0)
+
+        q = urllib.parse.quote("iotml_http_total", safe="")
+        status, body = get(
+            f"/query_range?query={q}&start_ms={BASE_TS}"
+            f"&end_ms={BASE_TS + 20_000}&step_ms=10000")
+        assert status == 200 and len(body["data"]) == 2
+        for s in body["data"]:
+            assert len(s["values"]) == 3
+
+        assert get("/query")[0] == 400                       # no expr
+        bad = urllib.parse.quote("rate(x_total)", safe="")
+        assert get(f"/query?query={bad}")[0] == 400          # bad expr
+        assert get(f"/query_range?query={q}")[0] == 400      # no range
+    finally:
+        server.stop()
+
+
+# ------------------------------------------- parse_prom_text round trip
+TRICKY_LABELS = [
+    "plain",
+    'quo"te',
+    "back\\slash",
+    "new\nline",
+    "comma,eq=brace}",
+    "open{brace",
+    "trailing\\",
+    ' leading and trailing ',
+    '\\"mixed\\" \n end}',
+]
+
+
+def test_parse_prom_text_roundtrip_tricky_labels_and_values():
+    """Satellite (a): the exposition renderer and parser are inverses —
+    escaped label values, NaN/±Inf sample values, and histogram frames
+    all survive render → parse bit-faithfully."""
+    reg = metrics_mod.Registry()
+    c = reg.counter("rt_events_total")
+    for i, v in enumerate(TRICKY_LABELS):
+        c.inc(i + 1.5, label=v, idx=str(i))
+    g = reg.gauge("rt_level")
+    g.set(float("nan"), kind="nan")
+    g.set(float("inf"), kind="hi")
+    g.set(float("-inf"), kind="lo")
+    h = reg.histogram("rt_lat_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(5.0)
+
+    types, samples = federate.parse_prom_text(reg.render())
+    assert types["rt_events_total"] == "counter"
+    assert types["rt_level"] == "gauge"
+    assert types["rt_lat_seconds"] == "histogram"
+
+    by_labels = {(n, tuple(sorted(l.items()))): v for n, l, v in samples}
+    for i, v in enumerate(TRICKY_LABELS):
+        key = ("rt_events_total",
+               tuple(sorted({"label": v, "idx": str(i)}.items())))
+        assert key in by_labels, f"label {v!r} did not round-trip"
+        assert by_labels[key] == i + 1.5
+    assert math.isnan(by_labels[("rt_level", (("kind", "nan"),))])
+    assert by_labels[("rt_level", (("kind", "hi"),))] == float("inf")
+    assert by_labels[("rt_level", (("kind", "lo"),))] == float("-inf")
+    assert by_labels[("rt_lat_seconds_bucket", (("le", "0.1"),))] == 1
+    assert by_labels[("rt_lat_seconds_bucket", (("le", "+Inf"),))] == 2
+    assert by_labels[("rt_lat_seconds_sum", ())] == pytest.approx(5.05)
+    assert by_labels[("rt_lat_seconds_count", ())] == 2
+
+
+def test_parse_prom_text_roundtrip_property_random_labels():
+    """Property-style: 200 seeded random strings over an alphabet of
+    exposition metacharacters all survive the round trip exactly."""
+    rng = np.random.default_rng(1234)
+    alphabet = np.array(list('ab"\\\n,={} \t'))
+    reg = metrics_mod.Registry()
+    c = reg.counter("prop_total")
+    expected = {}
+    for i in range(200):
+        size = int(rng.integers(0, 12))
+        val = "".join(rng.choice(alphabet, size=size))
+        # the parser strips line-level whitespace; values differing only
+        # by outer whitespace are legitimate collisions — index them
+        c.inc(float(i), v=val, i=str(i))
+        expected[str(i)] = (val, float(i))
+
+    _types, samples = federate.parse_prom_text(reg.render())
+    got = {l["i"]: (l["v"], v) for n, l, v in samples
+           if n == "prop_total"}
+    assert got == expected
+
+
+def test_parse_prom_text_tolerates_garbage_lines():
+    text = "\n".join([
+        "# TYPE ok_total counter",
+        "ok_total 3",
+        "broken{unclosed 9",
+        'broken{k="unterminated 9',
+        "no_value",
+        "# some comment",
+        "",
+        'ok_total{a="b"} 4 1700000000000',  # trailing timestamp ok
+    ])
+    types, samples = federate.parse_prom_text(text)
+    assert types == {"ok_total": "counter"}
+    assert samples == [("ok_total", {}, 3.0),
+                       ("ok_total", {"a": "b"}, 4.0)]
