@@ -296,6 +296,91 @@ def bench_store_log():
                 n_passes=len(walls))
 
 
+def bench_tiered():
+    """Tiered-store replay ladder (ISSUE 18): records/s replayed from
+    the local hot tier vs through the remote tier with a cold cache
+    (blob fetch + CRC verify + read-only mount, amortised over the
+    batch), plus time-to-first-batch for a cold backfill — an empty
+    local dir over the committed remote tier, the follower-bootstrap /
+    historical-trainer cold-start cost.  Same records, same frame
+    decoder on both legs; three prices."""
+    import shutil
+    import tempfile
+
+    from iotml.store import RemoteTier, StorePolicy, TieredLog, TierPolicy
+    from iotml.train.artifacts import ArtifactStore
+
+    n_records = int(os.environ.get("IOTML_BENCH_TIERED_RECORDS", "50000"))
+    value = b"x" * 256
+    root = tempfile.mkdtemp(prefix="iotml_bench_tiered_")
+    try:
+        store = ArtifactStore(os.path.join(root, "bucket"))
+        bucket = os.path.join(root, "bucket")
+        log = TieredLog(os.path.join(root, "local"),
+                        policy=StorePolicy(fsync="never",
+                                           segment_bytes=4 * 1024 * 1024),
+                        remote=RemoteTier(store, prefix="tiered/bench/0"),
+                        tier=TierPolicy(uri=bucket))
+        for i in range(n_records):
+            log.append(None, value, i, sync=False)
+        log.roll()
+
+        def replay(lg):
+            t0 = time.perf_counter()
+            off, seen = lg.base_offset, 0
+            while seen < n_records:
+                chunk = lg.read_from(off, 4096)
+                if not chunk:
+                    break
+                seen += len(chunk)
+                off = chunk[-1][0] + 1
+            return seen, time.perf_counter() - t0
+
+        passes = max(3, PASSES // 2)
+        local_walls = []
+        for _ in range(passes + 1):  # first pass warms the page cache
+            seen, w = replay(log)
+            assert seen == n_records
+            local_walls.append(w)
+        l50, _ = _percentiles(local_walls[1:])
+
+        log.tier_sync()
+        log.evict_hot(budget_bytes=0)
+        assert log.local_base_offset >= n_records  # hot tier fully out
+        remote_walls = []
+        for _ in range(passes):
+            log.cache.clear()  # every pass pays the full cold fetch
+            seen, w = replay(log)
+            assert seen == n_records
+            remote_walls.append(w)
+        r50, _ = _percentiles(remote_walls)
+
+        ttfb = []
+        for i in range(passes):
+            cold_dir = os.path.join(root, f"cold{i}")
+            t0 = time.perf_counter()
+            cold = TieredLog(cold_dir, policy=StorePolicy(fsync="never"),
+                             remote=RemoteTier(store,
+                                               prefix="tiered/bench/0"),
+                             tier=TierPolicy(uri=bucket))
+            first = cold.read_from(cold.base_offset, 4096)
+            ttfb.append(time.perf_counter() - t0)
+            assert first
+            cold.close()
+            shutil.rmtree(cold_dir, ignore_errors=True)
+        t50, _ = _percentiles(ttfb)
+
+        log.close()
+        return dict(value=n_records / r50,
+                    local_replay_records_per_sec=round(n_records / l50, 1),
+                    cold_backfill_first_batch_ms=round(t50 * 1e3, 2),
+                    remote_vs_local_pct=round(100.0 * (r50 / l50 - 1.0), 1),
+                    n_records=n_records, payload_bytes=len(value),
+                    n_passes=passes)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_replication():
     """Quorum replication costs (ISSUE 14): acks=all vs acks=1 produce
     throughput through a live leader + 2 ISR followers (background
@@ -3391,6 +3476,11 @@ METRIC_ORDER = [
     # recovery wall time; no reference twin (its retention lived in
     # managed Kafka), so vs_baseline deliberately 0
     ("store_append_mb_per_sec", "MB/s", None),
+    # tiered-store replay ladder (ISSUE 18): remote-tier replay rate
+    # with a cold cache vs the local hot tier, + cold-backfill
+    # time-to-first-batch; no reference twin (its history ended at
+    # broker disk × retention.ms), so vs_baseline deliberately 0
+    ("tiered_remote_replay_records_per_sec", "records/s", None),
     # zero-copy columnar consume path (ISSUE 10): python vs fused vs
     # columnar decode rate over one durable topic + the RAW_FETCH
     # wire leg — the host-pipeline ceiling behind the e2e knee.
@@ -3477,6 +3567,7 @@ SINGLE_BENCH = {
     "bench_serve": "serve_rows_per_sec",
     "bench_ksql_pipeline": "ksql_pipeline_records_per_sec",
     "bench_store_log": "store_append_mb_per_sec",
+    "bench_tiered": "tiered_remote_replay_records_per_sec",
     "bench_pipeline": "pipeline_columnar_records_per_sec",
     "bench_tsdb": "tsdb_pipeline_records_per_sec",
     "bench_twin": "twin_apply_records_per_sec",
@@ -3518,6 +3609,7 @@ def main():
         run("serve_rows_per_sec", bench_serve)
         run("ksql_pipeline_records_per_sec", bench_ksql_pipeline)
         run("store_append_mb_per_sec", bench_store_log)
+        run("tiered_remote_replay_records_per_sec", bench_tiered)
         run("pipeline_columnar_records_per_sec", bench_pipeline)
         run("tsdb_pipeline_records_per_sec", bench_tsdb)
         run("twin_apply_records_per_sec", bench_twin)

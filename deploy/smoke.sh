@@ -28,6 +28,12 @@ JAX_PLATFORMS=cpu python -m iotml.chaos run --scenario drift-storm \
   --seed 7 --records 2000
 JAX_PLATFORMS=cpu python -m iotml.chaos run --scenario double-fault \
   --seed 7 --records 500
+echo "==      tier-upload-crash drill (iotml.store.tiered): the tier"
+echo "        uploader killed between blob uploads and the manifest"
+echo "        commit — torn upload never served, local authoritative,"
+echo "        cold remote replay byte-identical, garbage swept"
+JAX_PLATFORMS=cpu python -m iotml.chaos run --scenario tier-upload-crash \
+  --seed 7 --records 500
 echo "==      alert-burn drill (iotml.obs): sustained delivery delay"
 echo "        must FIRE the fast burn-rate pair onto _IOTML_ALERTS +"
 echo "        /healthz within budget, then RESOLVE on recovery"

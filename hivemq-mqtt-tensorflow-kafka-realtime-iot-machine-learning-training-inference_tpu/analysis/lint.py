@@ -52,7 +52,11 @@ Rules (see ARCHITECTURE.md §analysis for the full table):
       store directory goes through ``store.segment.SegmentWriter``, so
       the durability promises (fsync accounting, torn-tail recovery
       semantics, atomic-rename publication) are made in exactly one
-      place.
+      place.  Extended to the REMOTE tier: segment blob uploads
+      (``upload_segment``), ``.stage`` intent markers, ``tiered/``
+      blob names and the tier manifest are ``store.remote.RemoteTier``'s
+      alone — a foreign manifest write could commit torn blobs, which
+      the stage → blobs → manifest-commit protocol exists to forbid.
   R11 model-registry write discipline (R9's story for model
       artifacts): outside ``iotml/mlops/``, no ``open()``/``os.open()``
       or ``atomic_write()`` whose arguments name a registry path
@@ -129,6 +133,7 @@ CHAOS_ALLOWED_MODULES = frozenset({
     ("serve", "scorer.py"), ("train", "live.py"),
     ("mlops", "checkpoint.py"), ("mlops", "registry.py"),
     ("store", "compact.py"), ("online", "learner.py"),
+    ("store", "remote.py"),
 })
 CHAOS_SHIM_MODULE = "faults"
 # Drill-harness modules outside chaos/supervise: live-drill peers of
@@ -186,7 +191,9 @@ RULES: Dict[str, str] = {
           "registry)",
     "R9": "naked store-dir write (os.fsync, or open()/os.open() on a "
           "store path) outside iotml/store/: all store-dir bytes go "
-          "through SegmentWriter",
+          "through SegmentWriter; remote-tier writes (upload_segment, "
+          ".stage markers, tiered/ blobs, the tier manifest) go "
+          "through RemoteTier",
     "R10": "direct broker-instance addressing outside iotml/cluster/ "
            "(ShardBroker(...) construction, or subscripting a "
            "controller's .brokers/.servers/.serving/.replicas): clients "
@@ -278,6 +285,18 @@ _R10_COLLECTIONS = frozenset({"brokers", "servers", "serving", "replicas"})
 # suppression, the lint's usual direction.
 _STORE_PATH_NAME_RE = re.compile(
     r"store_dir|store_path|storedir|segment_path|\.slog\b", re.IGNORECASE)
+
+# R9 (tier extension): remote-tier write surfaces.  Blob names under
+# the remote "tiered/" prefix, ".stage" intent markers and the remote
+# tier manifest are written ONLY by store.remote.RemoteTier — a foreign
+# writer could commit a manifest entry for torn blobs, the exact state
+# the stage -> blobs -> manifest-commit protocol exists to rule out.
+# Same conservative name-matching as the store-path regex above.
+_TIER_PATH_NAME_RE = re.compile(
+    r"tiered/|\.stage\b|remote_tier|tier_manifest", re.IGNORECASE)
+#: RemoteTier's mutating entry points — calling one outside the store
+#: package is a remote-tier write regardless of argument spelling.
+_TIER_WRITE_CALLS = frozenset({"upload_segment"})
 
 # R11: identifier substrings marking an open()/atomic_write() argument
 # as a model-registry path.  Same conservative name-based matching as
@@ -828,6 +847,28 @@ class _FileLinter(ast.NodeVisitor):
                                "dir go through SegmentWriter (framing, "
                                "CRC, fsync accounting, recovery "
                                "semantics)")
+            # tier extension: remote-tier writes (segment blob uploads,
+            # .stage markers, the remote manifest) are RemoteTier's
+            # alone — a foreign manifest write could reference torn
+            # blobs, which the commit-marker protocol exists to forbid
+            if name in _TIER_WRITE_CALLS:
+                self._emit("R9", node,
+                           "remote-tier segment upload outside "
+                           "iotml/store/: sealed segments reach the "
+                           "object store only through RemoteTier's "
+                           "stage -> blobs -> manifest-commit protocol")
+            if name in ("open", "upload", "put_text", "atomic_write"):
+                arg_src = " ".join(
+                    ast.unparse(a) for a in list(node.args)
+                    + [kw.value for kw in node.keywords])
+                if _TIER_PATH_NAME_RE.search(arg_src):
+                    self._emit("R9", node,
+                               f"naked {name}() on a remote-tier path "
+                               "(tiered/ blob, .stage marker, tier "
+                               "manifest) outside iotml/store/: the "
+                               "remote tier has ONE writer, RemoteTier "
+                               "— local bytes stay authoritative until "
+                               "ITS manifest commit")
 
         # R11 — model-registry write discipline: registry bytes are
         # ModelRegistry's alone; a naked open/atomic_write on a registry

@@ -79,6 +79,12 @@ KNOWN_POINTS: Dict[str, str] = {
                      "(delay) = a slow incremental step, crash (error) "
                      "= the learner dies mid-stream and must resume "
                      "from its committed cursor",
+    "store.tier_upload": "tiered-store upload, between the segment blob "
+                         "uploads and the remote manifest commit: crash "
+                         "(error) = uploader killed mid-upload, leaving "
+                         "staged blobs the manifest never references "
+                         "(swept later, never served); delay = slow "
+                         "object store",
 }
 
 #: runner-orchestrated pseudo-points: process-level acts (killing a wire
@@ -122,6 +128,7 @@ POINT_ACTIONS: Dict[str, frozenset] = {
     "ckpt.write": frozenset({"error", "delay"}),
     "registry.commit": frozenset({"error", "delay"}),
     "store.compact_swap": frozenset({"error", "delay"}),
+    "store.tier_upload": frozenset({"error", "delay"}),
     "online.update": frozenset({"error", "delay"}),
     "runner.kill_leader": frozenset({"kill_leader"}),
     "runner.crash_broker": frozenset({"crash_broker"}),

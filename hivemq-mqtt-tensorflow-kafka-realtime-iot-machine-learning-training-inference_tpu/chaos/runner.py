@@ -476,6 +476,8 @@ class ChaosRunner:
         try:
             if self.schedule.name == "compaction-under-crash":
                 return self._run_compact_in(eng, span_path, store_dir)
+            if self.schedule.name == "tier-upload-crash":
+                return self._run_tiered_in(eng, span_path, store_dir)
             return self._run_store_in(eng, span_path, store_dir)
         finally:
             # CI/smoke run this scenario repeatedly; a leaked segment
@@ -807,6 +809,207 @@ class ChaosRunner:
             scenario=self.schedule.name, seed=self.schedule.seed,
             records=self.schedule.records, topology="store",
             published=published, scored=svc.applied, rewinds=0,
+            dropped_accounted=eng.dropped_count,
+            injected=dict(sorted(eng.injected.items())),
+            invariants=invariants, span_path=span_path)
+
+    # ------------------------------------------------------------- tiered
+    def _run_tiered_in(self, eng: faults.ChaosEngine, span_path: str,
+                       store_dir: str) -> ChaosReport:
+        """The tier-upload-crash drill: a durable broker tiers sealed
+        segments into a local-directory ArtifactStore, and the uploader
+        is KILLED at the scheduled ``store.tier_upload`` traversal —
+        the gap between the segment blob uploads and the remote
+        manifest commit (staged blobs exist remotely, nothing
+        references them).  Proven: a cold reader trusting only the
+        manifest serves EXACTLY the committed prefix (never the torn
+        upload), the local copy stays byte-authoritative across the
+        kill, the finished pass sweeps the garbage, and — after the hot
+        tier is fully evicted — the whole history replays through the
+        REMOTE leg byte-identical to the pre-kill reads, surviving a
+        remount too."""
+        import shutil
+        import tempfile
+
+        from ..gen.simulator import FleetGenerator, FleetScenario
+        from ..store import (RemoteTier, StorePolicy, TieredLog,
+                             TierPolicy)
+        from ..stream.broker import Broker
+
+        remote_dir = tempfile.mkdtemp(prefix="iotml_chaos_tier_")
+        cold_dir = tempfile.mkdtemp(prefix="iotml_chaos_cold_")
+        policy = dict(fsync="interval", segment_bytes=16 * 1024)
+        parts = 2
+
+        def read_all(b):
+            """Every live record per partition as comparable tuples
+            (fetch batches end at tier boundaries; the loop crosses)."""
+            out = {}
+            for p in range(parts):
+                recs = []
+                off = b.begin_offset(IN_TOPIC, p)
+                end = b.end_offset(IN_TOPIC, p)
+                while off < end:
+                    batch = b.fetch(IN_TOPIC, p, off, 1 << 20)
+                    if not batch:
+                        break
+                    recs.extend((m.offset, m.key, m.value, m.timestamp_ms)
+                                for m in batch)
+                    off = batch[-1].offset + 1
+                out[p] = recs
+            return out
+
+        try:
+            broker = Broker(store_dir=store_dir,
+                            store_policy=StorePolicy(**policy),
+                            tier=TierPolicy(uri=remote_dir))
+            broker.create_topic(IN_TOPIC, partitions=parts)
+            gen = FleetGenerator(FleetScenario(num_cars=CARS_PER_TICK,
+                                               seed=self.schedule.seed))
+            ticks = max(2, -(-self.schedule.records // CARS_PER_TICK))
+            published = 0
+            for _ in range(ticks):
+                published += gen.publish(broker, IN_TOPIC, n_ticks=1,
+                                         partitions=parts)
+            logs = [broker.store.log_for(IN_TOPIC, p) for p in range(parts)]
+            for log in logs:
+                log.roll()  # sealed segments exist before the first pass
+            pre_kill = read_all(broker)
+            store_obj = broker.store._tier_store
+
+            def unreferenced(p):
+                """Blobs under partition p's prefix the manifest does
+                not name — the torn upload's remote footprint."""
+                tierp = logs[p].remote
+                referenced = {tierp._manifest_name}
+                for m in tierp.load():
+                    for sfx in (".log", ".index", ".timeindex"):
+                        referenced.add(tierp._blob(m.base, sfx))
+                return [n for n in store_obj.list(tierp.prefix)
+                        if n not in referenced]
+
+            # --- the kill: the scheduled error fires INSIDE an upload,
+            # after the blobs landed and before the manifest commit
+            crashed = False
+            try:
+                broker.run_tiering()
+            except RuntimeError:
+                crashed = True
+            committed = {p: logs[p].remote_metas() for p in range(parts)}
+            torn = {p: unreferenced(p) for p in range(parts)}
+            any_torn = any(torn.values())
+
+            # local authority: every pre-kill byte still re-serves
+            local_ok = read_all(broker) == pre_kill
+
+            # a COLD reader (fresh empty dir, manifest-only trust — the
+            # follower-bootstrap path) must serve exactly the committed
+            # prefix, every segment CRC-verified, and nothing staged
+            cold_ok = True
+            for p in range(parts):
+                cold = TieredLog(
+                    os.path.join(cold_dir, str(p)),
+                    policy=StorePolicy(fsync="never"),
+                    remote=RemoteTier(store_obj, prefix=logs[p].remote.prefix),
+                    tier=TierPolicy(uri=remote_dir))
+                recs = []
+                off = cold.base_offset
+                end = max((m.next for m in cold.remote_metas()),
+                          default=off)
+                while off < end:
+                    batch = cold.read_from(off, 4096)
+                    if not batch:
+                        break
+                    recs.extend((o, k, v, ts) for o, k, v, ts, _h in batch)
+                    off = recs[-1][0] + 1
+                cold.close()
+                want = [r for r in pre_kill[p]
+                        if committed[p]
+                        and r[0] < max(m.next for m in committed[p])]
+                if recs != want:
+                    cold_ok = False
+
+            # --- finish the job: the spent event doesn't re-fire; the
+            # completed pass commits everything and sweeps the garbage
+            finished = True
+            try:
+                stats = broker.run_tiering()
+            except RuntimeError:
+                finished, stats = False, {}
+            garbage_left = sum(len(unreferenced(p)) for p in range(parts))
+
+            # hot tier fully evicted: history now serves through the
+            # REMOTE leg only (plus the live active segment locally)
+            for log in logs:
+                log.evict_hot(budget_bytes=0)
+            evicted = all(log.local_base_offset > log.base_offset
+                          for log in logs)
+            remote_replay = read_all(broker)
+            remote_used = any(len(log.cache) for log in logs)
+            replay_ok = remote_replay == pre_kill and evicted and remote_used
+
+            # ...and a remount sees the same bytes (manifest + local
+            # tail recompose the one log)
+            broker.close()
+            broker2 = Broker(store_dir=store_dir,
+                             store_policy=StorePolicy(**policy),
+                             tier=TierPolicy(uri=remote_dir))
+            stable = read_all(broker2) == pre_kill
+            broker2.close()
+        finally:
+            shutil.rmtree(remote_dir, ignore_errors=True)
+            shutil.rmtree(cold_dir, ignore_errors=True)
+
+        invariants = [
+            Invariant(
+                "crash_injected",
+                crashed and any_torn,
+                f"uploader killed between blob puts and manifest commit "
+                f"({sum(len(v) for v in torn.values())} unreferenced "
+                f"staged blob(s) left remotely)" if crashed and any_torn
+                else "the scheduled store.tier_upload error NEVER FIRED "
+                     "(or left no staged garbage)"),
+            Invariant(
+                "torn_upload_never_served",
+                cold_ok,
+                "cold manifest-only reader served exactly the committed "
+                "prefix, every segment CRC-verified" if cold_ok else
+                "cold reader DIVERGED from the committed prefix (torn "
+                "or missing bytes served)"),
+            Invariant(
+                "local_authoritative_across_kill",
+                local_ok,
+                "every pre-kill record re-served locally after the "
+                "crashed pass" if local_ok else
+                "local reads DIVERGED after the crashed upload pass"),
+            Invariant(
+                "resumed_pass_commits_and_sweeps",
+                finished and garbage_left == 0,
+                f"re-run pass committed the interrupted segment and "
+                f"swept the stage garbage (sweep total "
+                f"{sum(s.get('swept', 0) for s in stats.values())})"
+                if finished and garbage_left == 0 else
+                f"resumed pass failed or left {garbage_left} "
+                f"unreferenced blob(s)"),
+            Invariant(
+                "remote_replay_byte_identical",
+                replay_ok,
+                "hot tier evicted; full history replayed THROUGH THE "
+                "REMOTE TIER byte-identical to pre-kill reads"
+                if replay_ok else
+                f"remote replay diverged (evicted={evicted}, "
+                f"remote_cache_used={remote_used})"),
+            Invariant(
+                "remount_byte_stable",
+                stable,
+                "a remounted broker re-serves the identical history "
+                "from manifest + local tail" if stable else
+                "post-remount reads DIVERGED"),
+        ]
+        return ChaosReport(
+            scenario=self.schedule.name, seed=self.schedule.seed,
+            records=self.schedule.records, topology="store",
+            published=published, scored=0, rewinds=0,
             dropped_accounted=eng.dropped_count,
             injected=dict(sorted(eng.injected.items())),
             invariants=invariants, span_path=span_path)

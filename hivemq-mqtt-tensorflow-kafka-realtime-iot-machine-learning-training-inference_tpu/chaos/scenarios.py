@@ -40,6 +40,12 @@ Built-ins:
   remount must sweep the tmp, lose no key, serve byte-identical
   compacted reads, and a finished pass must stay byte-stable across a
   second remount.
+- ``tier-upload-crash`` (store): the tier uploader is killed between
+  the segment blob uploads and the remote manifest commit (staged
+  blobs exist, nothing references them); remount + a cold reader over
+  the remote tier must never serve the torn upload, local bytes stay
+  authoritative, and the finished re-upload must replay byte-identical
+  through the remote leg.
 - ``trainer-crash-mid-checkpoint`` (mlops): the checkpoint writer dies
   inside a registry publication (torn version dir left behind); a
   restarted trainer must resume model + stream offsets from the last
@@ -192,6 +198,24 @@ def _compaction_under_crash(rng: random.Random, records: int) -> list:
     # compacted reads.  A couple of fetch stalls ride along so the
     # pre-kill reads happen under an unquiet consumer.
     events = [FaultEvent(rng.randint(1, 3), "store.compact_swap", "error",
+                         params=(("exc", "RuntimeError"),))]
+    for _ in range(2):
+        events.append(FaultEvent(rng.randint(1, max(2, records // 20)),
+                                 "broker.fetch", "delay",
+                                 params=(("seconds", 0.001),)))
+    return events
+
+
+def _tier_upload_crash(rng: random.Random, records: int) -> list:
+    # the tier uploader dies BETWEEN the segment blob uploads and the
+    # remote manifest commit — staged blobs exist remotely but nothing
+    # references them, the worst mid-upload shape.  The runner remounts
+    # (local AND a fresh cold reader against the remote tier) and
+    # proves the torn upload is never served, local bytes stayed
+    # authoritative, and the finished re-upload replays byte-identical
+    # through the remote leg.  A couple of fetch stalls ride along so
+    # pre-kill reads happen under an unquiet consumer.
+    events = [FaultEvent(rng.randint(1, 3), "store.tier_upload", "error",
                          params=(("exc", "RuntimeError"),))]
     for _ in range(2):
         events.append(FaultEvent(rng.randint(1, max(2, records // 20)),
@@ -380,6 +404,13 @@ SCENARIOS: Dict[str, Tuple[Callable, str, str]] = {
         "segment compactor killed mid-swap on the compacted twin "
         "changelog; remount sweeps the tmp, loses no key, and compacted "
         "reads stay byte-stable across a second remount"),
+    "tier-upload-crash": (
+        _tier_upload_crash, "store",
+        "tier uploader killed between segment blob uploads and the "
+        "remote manifest commit; remount + cold remote reader prove no "
+        "torn segment serves, local stays authoritative, and the "
+        "finished re-upload replays byte-identical through the remote "
+        "tier"),
     "rebalance-under-chaos": (
         _rebalance_under_chaos, "cluster",
         "3-broker cluster: a group member AND a shard leader die "

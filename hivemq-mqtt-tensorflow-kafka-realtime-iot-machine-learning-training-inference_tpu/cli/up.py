@@ -45,6 +45,7 @@ class Platform:
                  connect_port: int = 0, host: str = "127.0.0.1",
                  retention_messages: Optional[int] = None, cc_port: int = 0,
                  store_dir: Optional[str] = None, store_policy=None,
+                 tier=None,
                  trusted_passthrough: Optional[bool] = None,
                  registry_dir: Optional[str] = None,
                  registry_watch_poll_s: float = 0.25):
@@ -65,7 +66,8 @@ class Platform:
         # and a restarted platform re-serves everything it acked — the
         # "no data lake" training substrate surviving the process
         self.store_dir = store_dir
-        self.broker = Broker(store_dir=store_dir, store_policy=store_policy)
+        self.broker = Broker(store_dir=store_dir, store_policy=store_policy,
+                             tier=tier)
         # durable brokers get the background dirty-ratio compactor: a
         # platform with compacted topics (the CAR_TWIN changelog) must
         # actually reclaim them, not only when a drill calls
@@ -78,6 +80,14 @@ class Platform:
             self.compactor = StoreCompactor(
                 self.broker,
                 interval_s=self.broker.store.policy.compact_interval_s)
+        # tiered stores additionally get the background uploader that
+        # offloads sealed segments to the object store and enforces the
+        # hot-tier byte budget.  Same lifecycle shape as the compactor.
+        self.uploader = None
+        if self.broker.store is not None and tier:
+            from ..store import TierUploader
+            self.uploader = TierUploader(self.broker,
+                                         interval_s=tier.interval_s)
         # the reference's two topics, its partition count.  retention
         # bounds the in-memory log for long-running platforms (the
         # reference sets retention.ms=100000 — aggressive 100s retention,
@@ -205,6 +215,8 @@ class Platform:
             self.registry_watcher.start()
         if self.compactor is not None:
             self.compactor.start()
+        if self.uploader is not None:
+            self.uploader.start()
         if metrics_port is not None:
             self.metrics_server = self._obs.start_http_server(metrics_port)
         self.control_center.start()
@@ -213,6 +225,8 @@ class Platform:
 
     def endpoints(self) -> dict:
         out = {} if self.store_dir is None else {"store": self.store_dir}
+        if self.uploader is not None:
+            out["tier"] = self.broker.store.tier.uri
         if self.registry_dir:
             out["registry"] = self.registry_dir
         out.update({
@@ -404,6 +418,8 @@ class Platform:
             self.metrics_server.shutdown()
             self.metrics_server.server_close()
             self.metrics_server = None
+        if self.uploader is not None:
+            self.uploader.stop()
         if self.compactor is not None:
             self.compactor.stop()
         self.broker.close()  # durable: fsync + release fds (no-op else)
@@ -444,6 +460,13 @@ def main(argv=None) -> int:
     ap.add_argument("--store-dir", default=None, metavar="DIR",
                     help="store directory for --durable (also enables "
                          "durable mode when given)")
+    ap.add_argument("--tier-uri", default=None, metavar="URI",
+                    help="object-store URI (gs://... or a local path) for "
+                         "tiered storage: sealed segments upload to the "
+                         "remote tier and the local dir becomes a hot "
+                         "cache.  Requires durable mode.  Also via "
+                         "IOTML_TIER_URI; budget/lag knobs ride the "
+                         "tier.* config section.")
     ap.add_argument("--registry", default=None, metavar="DIR",
                     help="mount a versioned model registry (iotml.mlops): "
                          "torn publishes swept at boot, the serving "
@@ -511,6 +534,17 @@ def main(argv=None) -> int:
     store_dir = args.store_dir or (
         (cfg.store.dir or "/tmp/iotml-store") if args.durable else
         (cfg.store.dir or None))
+    tier = None
+    if store_dir:
+        from ..store import TierPolicy
+
+        tier = TierPolicy.from_config(cfg.tier)
+        if args.tier_uri:
+            tier.uri = args.tier_uri
+        if not tier:
+            tier = None
+    elif args.tier_uri:
+        ap.error("--tier-uri requires durable mode (--durable/--store-dir)")
     try:
         plat = Platform(sasl=sasl, host=args.host,
                         kafka_port=args.kafka_port,
@@ -527,6 +561,7 @@ def main(argv=None) -> int:
                         store_dir=store_dir,
                         store_policy=(StorePolicy.from_config(cfg.store)
                                       if store_dir else None),
+                        tier=tier,
                         trusted_passthrough=args.trust_passthrough,
                         registry_dir=args.registry
                         or (cfg.mlops.registry_dir or None),

@@ -128,6 +128,25 @@ class StoreConfig:
 
 
 @dataclasses.dataclass
+class TierConfig:
+    """Object-store tiered log storage (iotml.store.tiered).
+
+    ``uri`` empty (the default) keeps the durable log local-only; set
+    it to a directory path or ``gs://bucket/prefix``
+    (``IOTML_TIER_URI``) — or pass ``--tier-uri`` to the platform CLI —
+    and sealed segments offload to the ArtifactStore-backed remote
+    tier, with reads falling through transparently below the local
+    base.  Only meaningful alongside a durable store (``store.dir``)."""
+
+    uri: str = ""                 # empty = no remote tier
+    local_hot_bytes: int = 0      # hot-tier budget/partition; 0 = no evict
+    upload_lag_s: float = 0.0     # min sealed age before upload
+    remote_retention_ms: int = 0  # remote history age cap; 0 = forever
+    cache_segments: int = 4       # RemoteSegmentCache entries/partition
+    interval_s: float = 5.0       # background TierUploader cadence
+
+
+@dataclasses.dataclass
 class MlopsConfig:
     """Model lifecycle (iotml.mlops): versioned registry + async
     checkpointing + rollout.
@@ -215,6 +234,7 @@ class Config:
     scenario: ScenarioConfig = dataclasses.field(default_factory=ScenarioConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     store: StoreConfig = dataclasses.field(default_factory=StoreConfig)
+    tier: TierConfig = dataclasses.field(default_factory=TierConfig)
     mlops: MlopsConfig = dataclasses.field(default_factory=MlopsConfig)
     online: OnlineConfig = dataclasses.field(default_factory=OnlineConfig)
     slo: SloConfig = dataclasses.field(default_factory=SloConfig)

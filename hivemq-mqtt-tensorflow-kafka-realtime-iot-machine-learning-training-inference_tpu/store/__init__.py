@@ -23,9 +23,13 @@ from .hwm import HwmFile, hwm_file_for
 from .log import SegmentedLog, StorePolicy
 from .mount import StoreMount
 from .offsets import OffsetsFile
+from .remote import RemoteSegmentMeta, RemoteTier
 from .segment import SegmentWriter, atomic_write, crc32c, fsync_dir
+from .tiered import RemoteSegmentCache, TieredLog, TierPolicy, TierUploader
 
 __all__ = ["SegmentedLog", "StorePolicy", "StoreMount", "OffsetsFile",
            "SegmentWriter", "atomic_write", "crc32c", "fsync_dir",
            "CompactionStats", "StoreCompactor", "HwmFile",
-           "hwm_file_for"]
+           "hwm_file_for", "RemoteTier", "RemoteSegmentMeta",
+           "TieredLog", "TierPolicy", "TierUploader",
+           "RemoteSegmentCache"]

@@ -46,9 +46,19 @@ class StoreMount:
     """Owns the manifest, the offsets file and every partition log of
     one store directory.  The broker calls in under its own lock."""
 
-    def __init__(self, dir: str, policy: Optional[StorePolicy] = None):
+    def __init__(self, dir: str, policy: Optional[StorePolicy] = None,
+                 tier=None):
         self.dir = dir
         self.policy = policy or StorePolicy()
+        #: TierPolicy with a uri → every partition log mounts as a
+        #: TieredLog over one shared ArtifactStore backend; falsy →
+        #: plain local SegmentedLogs (the seed behavior, zero cost)
+        self.tier = tier if tier else None
+        self._tier_store = None
+        if self.tier is not None:
+            from .remote import artifact_store_for
+
+            self._tier_store = artifact_store_for(self.tier.uri)
         os.makedirs(dir, exist_ok=True)
         self._acquire_dir_lock()
         self._logs: Dict[tuple, SegmentedLog] = {}
@@ -129,9 +139,19 @@ class StoreMount:
             doc = self._manifest.get(topic) or {"dir": _dirname_for(topic)}
             pdir = os.path.join(self.dir, "segments", doc["dir"],
                                 str(int(partition)))
-            log = SegmentedLog(pdir, policy=self.policy,
-                               metric_labels={"topic": topic,
-                                              "partition": str(partition)})
+            labels = {"topic": topic, "partition": str(partition)}
+            if self._tier_store is not None:
+                from .remote import RemoteTier
+                from .tiered import TieredLog
+
+                remote = RemoteTier(
+                    self._tier_store,
+                    prefix=f"tiered/{doc['dir']}/{int(partition)}")
+                log = TieredLog(pdir, policy=self.policy, remote=remote,
+                                tier=self.tier, metric_labels=labels)
+            else:
+                log = SegmentedLog(pdir, policy=self.policy,
+                                   metric_labels=labels)
             self._logs[key] = log
         return log
 

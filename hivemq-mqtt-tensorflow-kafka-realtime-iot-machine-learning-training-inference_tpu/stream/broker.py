@@ -394,11 +394,15 @@ class Broker:
     consumer offsets load from the compacted offsets file, and every
     subsequent commit is persisted through it."""
 
-    def __init__(self, store_dir: Optional[str] = None, store_policy=None):
+    def __init__(self, store_dir: Optional[str] = None, store_policy=None,
+                 tier=None):
         self._lock = threading.Lock()
         # serializes whole compaction PASSES (background compactor vs a
         # forced drill pass); the data lock above covers only the swaps
         self._compact_pass_lock = threading.Lock()
+        # serializes whole TIERING passes the same way (background
+        # TierUploader vs a drill/test's forced run_tiering)
+        self._tier_pass_lock = threading.Lock()
         #: quorum replication state (iotml.replication.ReplicationState)
         #: when this broker LEADS replicated partitions — consulted by
         #: fetch/fetch_raw (consumer reads stop at the quorum high-water
@@ -414,7 +418,8 @@ class Broker:
         if store_dir:
             from ..store import StoreMount
 
-            self.store = StoreMount(store_dir, policy=store_policy)
+            self.store = StoreMount(store_dir, policy=store_policy,
+                                    tier=tier)
             for doc in self.store.topics():
                 self.create_topic(
                     doc["name"], partitions=doc["partitions"],
@@ -781,6 +786,43 @@ class Broker:
                                          lock=self._lock)
                     if stats.segments_rewritten:
                         out[(name, p)] = stats
+        return out
+
+    def run_tiering(self) -> Dict[tuple, dict]:
+        """One tiering pass over every tiered partition (durable broker
+        mounted with a tier only): upload eligible sealed segments to
+        the remote tier, evict the hot tier past its byte budget,
+        enforce remote retention, sweep unreferenced blobs.  Returns
+        {(topic, partition): stats} for partitions that did anything.
+        Driven by the background ``store.TierUploader`` in production
+        and called directly by tests/drills/the chaos runner.
+
+        Only below-quorum-HWM bytes ever tier out: on a replicated
+        leader each partition's upload ceiling is
+        ``replication.fetch_ceiling`` — the read-barrier the consumers
+        already honor — so a record a failover could un-write can never
+        reach the remote tier either.  Blob I/O runs outside the broker
+        lock (`TieredLog.tier_sync` takes it only around manifest/
+        segment-list publication), so produce/fetch proceed through a
+        pass; whole passes serialize on ``_tier_pass_lock``."""
+        if self.store is None:
+            return {}
+        out: Dict[tuple, dict] = {}
+        with self._tier_pass_lock:
+            with self._lock:
+                tiered = [(name, p, part.slog)
+                          for name, parts in self._parts.items()
+                          for p, part in enumerate(parts)
+                          if getattr(part, "slog", None) is not None
+                          and getattr(part.slog, "remote", None)
+                          is not None]
+            for name, p, slog in tiered:
+                ceiling = None
+                if self.replication is not None:
+                    ceiling = self.replication.fetch_ceiling(name, p)
+                stats = slog.tier_sync(ceiling=ceiling, lock=self._lock)
+                if any(stats.values()):
+                    out[(name, p)] = stats
         return out
 
     # -------------------------------------------------------------- fetch

@@ -33,14 +33,72 @@ class ArtifactStore:
 
     # ------------------------------------------------------------ blobs
     def upload(self, local_path: str, name: str) -> str:
+        """Publish a blob ATOMICALLY under `name`.
+
+        GCS object creation is atomic by the service's own contract; the
+        local backend must match it — a plain `shutil.copy2` makes the
+        destination visible while half-written, so a reader that trusts
+        `exists()` (the tiered-store fetch path does) could download a
+        torn blob.  Local uploads stage to a pid-unique tmp and
+        `os.replace` into place, then fsync the directory so the rename
+        survives a host crash too."""
         if self._gcs:
             blob = self._bucket.blob(os.path.join(self._prefix, name))
             blob.upload_from_filename(local_path)
             return f"{self.root}/{name}"
         dst = os.path.join(self.root, name)
-        os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
-        shutil.copy2(local_path, dst)
+        dirname = os.path.dirname(dst) or "."
+        os.makedirs(dirname, exist_ok=True)
+        from ..store import fsync_dir
+
+        tmp = dst + f".tmp.{os.getpid()}"
+        try:
+            shutil.copy2(local_path, tmp)
+            os.replace(tmp, dst)  # atomic within a filesystem
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        fsync_dir(dirname)
         return dst
+
+    def list(self, prefix: str = "") -> list:
+        """Blob names under `prefix`, sorted.  Staging tmps (the
+        `.tmp.<pid>` uploads in flight above) are never listed — a
+        sweeper enumerating the store must see only published blobs."""
+        if self._gcs:
+            full = os.path.join(self._prefix, prefix) if prefix \
+                else self._prefix
+            names = [b.name for b in self._bucket.list_blobs(prefix=full)]
+            if self._prefix:
+                names = [n[len(self._prefix):].lstrip("/") for n in names]
+            return sorted(n for n in names if ".tmp." not in n)
+        base = os.path.join(self.root, prefix) if prefix else self.root
+        out = []
+        if not os.path.isdir(base):
+            return out
+        for dirpath, _dirs, files in os.walk(base):
+            for f in files:
+                rel = os.path.relpath(os.path.join(dirpath, f), self.root)
+                if ".tmp." in rel:
+                    continue
+                out.append(rel.replace(os.sep, "/"))
+        return sorted(out)
+
+    def delete(self, name: str) -> bool:
+        """Remove one blob; False when it did not exist (idempotent —
+        the tier sweeper retries deletions after a crash)."""
+        if self._gcs:
+            blob = self._bucket.blob(os.path.join(self._prefix, name))
+            if not blob.exists():
+                return False
+            blob.delete()
+            return True
+        path = os.path.join(self.root, name)
+        try:
+            os.remove(path)
+            return True
+        except FileNotFoundError:
+            return False
 
     def download(self, name: str, local_path: str) -> str:
         if self._gcs:

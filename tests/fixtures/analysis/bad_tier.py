@@ -1,0 +1,34 @@
+"""R9 (remote tier) fixture: tier writes outside iotml/store/, every
+way to get it wrong — a direct upload_segment() call (1 finding), a
+naked upload() of a tiered/ blob (1 finding), a put_text() on the tier
+manifest (1 finding), an open() on a .stage intent marker (1 finding)
+— plus the clean shapes: an upload to a non-tier artifact name and a
+text write to an unrelated path (0 findings)."""
+
+
+def bypass_the_uploader(tier, seg):
+    # flagged: segment blob uploads are RemoteTier.upload_segment's
+    # alone, and that lives in iotml/store/remote.py
+    tier.upload_segment(seg.path, seg.index, seg.timeindex,
+                        base=0, next_offset=10, max_ts=99)
+
+
+def naked_blob_upload(store, path):
+    store.upload(path, "tiered/T/0/00000000000000000000.log")
+
+
+def hand_rolled_commit(store):
+    store.put_text("tiered/T/0/manifest.json", "{}")
+
+
+def forged_stage_marker(tmp):
+    with open(tmp + "/00000000000000000000.stage", "w") as fh:
+        fh.write("{}")
+
+
+def plain_artifact_upload_is_fine(store, path):
+    store.upload(path, "models/anomaly/v3/weights.msgpack")
+
+
+def unrelated_text_write_is_fine(store):
+    store.put_text("reports/daily.json", "{}")

@@ -40,6 +40,7 @@ def test_lint_flags_every_seeded_violation():
     assert by_file.get("bad_span_metric.py") == {"R6"}
     assert by_file.get("bad_chaos.py") == {"R7"}
     assert by_file.get("bad_store.py") == {"R9"}
+    assert by_file.get("bad_tier.py") == {"R9"}
     assert by_file.get("bad_cluster.py") == {"R10"}
     assert by_file.get("bad_ckpt.py") == {"R11"}
     assert by_file.get("bad_twin.py") == {"R12"}
