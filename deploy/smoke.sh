@@ -52,6 +52,11 @@ echo "==      live twin-rebuild drill (iotml.twin): kill the twin"
 echo "        service, rebuild from the compacted changelog, state"
 echo "        equals the pre-kill snapshot"
 JAX_PLATFORMS=cpu python -m iotml.twin drill --seed 7 --records 1500
+echo "==      live gateway shard-kill drill (iotml.gateway): standby"
+echo "        promoted under a query storm — promote SLO, zero wrong"
+echo "        answers, bounded staleness"
+JAX_PLATFORMS=cpu python -m iotml.gateway drill --seed 7 --records 1500 \
+  --cars 30
 echo "==      live drift-adapt-swap drill (iotml.online): seeded"
 echo "        regional drift detected within the SLO, adaptation"
 echo "        published + hot-swapped, wrecked adaptation rolled back"

@@ -145,6 +145,7 @@ CHAOS_HARNESS_MODULES = frozenset({
     ("online", "drill.py"), ("online", "__main__.py"),
     ("replication", "drill.py"), ("replication", "__main__.py"),
     ("obs", "drill.py"), ("obs", "__main__.py"),
+    ("gateway", "drill.py"), ("gateway", "__main__.py"),
 })
 
 # R6 (naming): metric families and span/stage names are lowercase
@@ -170,7 +171,7 @@ _METRIC_RECORD_CALLS = frozenset({"inc", "observe", "set", "time"})
 _ALLOWED_METRIC_LABELS = frozenset({
     "stage", "topic", "partition", "group", "phase", "loop", "process",
     "component", "detector", "action", "fault", "source", "outcome",
-    "unit", "le", "slo", "window", "shard",
+    "unit", "le", "slo", "window", "shard", "route", "code",
 })
 
 RULES: Dict[str, str] = {
@@ -226,6 +227,14 @@ RULES: Dict[str, str] = {
            "ONE wire→disk→host contract with ONE codec — consume raw "
            "batches via Broker.fetch_raw + FrameDecoder, produce them "
            "via ops.framing helpers / RawBatchProducer",
+    "R16": "direct TwinTable access outside iotml/twin/ + "
+           "iotml/gateway/ (TwinTable(...) construction, "
+           ".apply_changelog(...), or reaching through a service's "
+           ".table): the materialised twin has two legal holders — "
+           "TwinService and the gateway's standby/serving plane; "
+           "everyone else queries via TwinService / TwinFeatureStore / "
+           "GatewayClient, or a foreign mutation forks state the "
+           "changelog can never rebuild",
 }
 
 # R14: the segment frame codec's entry points, and the frame-head
@@ -278,6 +287,14 @@ _ISR_INGRESS_CALLS = frozenset({"observe_fetch", "wait_replicated"})
 # NOT_LEADER + epoch-fencing invariants).  The chaos/supervise drill
 # harnesses are exempt — proving failover requires touching the victim.
 _R10_COLLECTIONS = frozenset({"brokers", "servers", "serving", "replicas"})
+
+# R16: the TwinTable surface reachable through a service's `.table`
+# attribute.  `apply_changelog` is caught at the call site, so the
+# attribute-chain check covers the rest of the table API (same
+# conservative name-matching as R9/R11/R12 — a false positive
+# justifies itself with a suppression).
+_TWIN_TABLE_ATTRS = frozenset({"apply", "snapshot", "resume_offsets",
+                               "twins", "cars", "get"})
 
 # R9: identifier substrings that mark an open() argument as a store
 # path.  Conservative by construction (names, not data flow) — matching
@@ -598,6 +615,12 @@ class _FileLinter(ast.NodeVisitor):
         # (_IOTML_METRICS / _IOTML_TSDB / _IOTML_ALERTS)
         self.in_twin = "twin" in parts
         self.in_obs = "obs" in parts
+        # R16 scoping: the twin package owns the TwinTable, and the
+        # gateway's standby/serving plane is its second legal holder
+        # (a standby IS a continuously-rebuilt table); the chaos/
+        # supervise drill harnesses may snapshot victims directly
+        self.r16_exempt = self.in_twin or "gateway" in parts \
+            or self.in_chaos
         # R13 scoping: the registry machinery (mlops watchers/rollouts)
         # and the online learner's adaptation path are the two places a
         # scorer's weights may legally be set in place — everything
@@ -668,6 +691,21 @@ class _FileLinter(ast.NodeVisitor):
                        f"direct broker-instance addressing "
                        f"(.{v.attr}[...]) outside iotml/cluster/: "
                        f"route via PartitionMap / ClusterClient")
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        # R16 — reaching through a service's `.table` to the TwinTable
+        # API outside the twin/gateway planes: serving raw table state
+        # bypasses the owner's locking and the provenance the
+        # changelog's crash story depends on
+        v = node.value
+        if not self.r16_exempt and isinstance(v, ast.Attribute) \
+                and v.attr == "table" and node.attr in _TWIN_TABLE_ATTRS:
+            self._emit("R16", node,
+                       f"direct TwinTable access (.table.{node.attr}) "
+                       "outside iotml/twin/ + iotml/gateway/: query "
+                       "via TwinService / TwinFeatureStore / "
+                       "GatewayClient")
         self.generic_visit(node)
 
     # R4 needs with-scope tracking, so visit With explicitly
@@ -1008,6 +1046,28 @@ class _FileLinter(ast.NodeVisitor):
                        "weights as a registry version and let a "
                        "RegistryWatcher swap it (versioned, gated, "
                        "rollback-able)")
+
+        # R16 — TwinTable one-owner discipline: constructing a table or
+        # applying changelog records outside the twin/gateway planes
+        # builds a twin nobody's changelog covers — a rebuild after a
+        # crash silently disagrees with what was served
+        if not self.r16_exempt:
+            if name == "TwinTable":
+                self._emit("R16", node,
+                           "TwinTable(...) constructed outside "
+                           "iotml/twin/ + iotml/gateway/: the "
+                           "materialised twin is built by TwinService "
+                           "or adopted through the gateway standby "
+                           "plane — query via TwinService / "
+                           "TwinFeatureStore / GatewayClient")
+            if name == "apply_changelog" \
+                    and isinstance(node.func, ast.Attribute):
+                self._emit("R16", node,
+                           ".apply_changelog(...) outside iotml/twin/ "
+                           "+ iotml/gateway/: changelog replay is the "
+                           "table owners' alone — a foreign apply "
+                           "forks state the changelog can never "
+                           "rebuild")
 
         # R10 — broker instances are the cluster package's to build:
         # constructing a ShardBroker elsewhere bypasses the controller's

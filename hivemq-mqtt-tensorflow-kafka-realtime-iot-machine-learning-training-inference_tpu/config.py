@@ -367,7 +367,12 @@ def load_config(argv: Optional[Sequence[str]] = None,
                   # mesh size and the device-side normalization toggle
                   # select the process's training machinery, same
                   # family as the decode/prefetch knobs above
-                  "IOTML_MESH_DATA", "IOTML_DEVICE_NORMALIZE"}
+                  "IOTML_MESH_DATA", "IOTML_DEVICE_NORMALIZE",
+                  # REST serving plane (ISSUE 20): the concurrent-
+                  # connection ceiling every RestServer sheds 503s
+                  # past — a process-protection knob, not pipeline
+                  # config
+                  "IOTML_REST_MAX_CONCURRENCY"}
     for key, value in env.items():
         if not key.startswith("IOTML_") or key in non_config:
             continue

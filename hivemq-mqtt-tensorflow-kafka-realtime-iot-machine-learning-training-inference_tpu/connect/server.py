@@ -239,10 +239,37 @@ class ConnectServer(RestServer):
             raise RestError(400, f"bad query: {e}")
         return 200, {"status": "success", "data": result}
 
+    #: page-size ceiling for GET /twin: a 100k-car table must never emit
+    #: a multi-megabyte id dump per poll (ISSUE 20) — callers page with
+    #: limit/offset or take the count_only fast path
+    TWIN_LIST_DEFAULT_LIMIT = 1000
+    TWIN_LIST_MAX_LIMIT = 10_000
+
     def _twin_list(self, m, body):
-        return 200, {"count": self.twin.count(),
-                     "rebuilt_from_changelog": self.twin.rebuilt_records,
-                     "cars": self.twin.cars()}
+        out = {"count": self.twin.count(),
+               "rebuilt_from_changelog": self.twin.rebuilt_records}
+        if str(body.get("count_only", "")).lower() in ("1", "true", "yes"):
+            # fast path: len() of the table, no id list materialised
+            return 200, out
+        try:
+            limit = int(body.get("limit", self.TWIN_LIST_DEFAULT_LIMIT))
+            offset = int(body.get("offset", 0))
+        except (TypeError, ValueError):
+            raise RestError(400, "limit/offset must be integers")
+        if limit < 0 or offset < 0:
+            raise RestError(400, "limit/offset must be >= 0")
+        limit = min(limit, self.TWIN_LIST_MAX_LIMIT)
+        cars = self.twin.cars()
+        page = cars[offset:offset + limit]
+        out["cars"] = page
+        out["offset"] = offset
+        out["limit"] = limit
+        # the resume cursor: None signals the last page, so pollers
+        # walk `next_offset` until it nulls instead of guessing from
+        # page fill (a filtered backend may return short pages)
+        nxt = offset + len(page)
+        out["next_offset"] = nxt if nxt < len(cars) else None
+        return 200, out
 
     def _twin_get(self, m, body):
         doc = self.twin.get(m.group(1))

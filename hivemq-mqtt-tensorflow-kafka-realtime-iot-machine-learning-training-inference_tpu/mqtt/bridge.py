@@ -29,17 +29,38 @@ from .broker import MqttBroker
 
 @dataclasses.dataclass(frozen=True)
 class TopicMapping:
-    """One <topic-mapping>: MQTT filter(s) → stream topic."""
+    """One <topic-mapping>: MQTT filter(s) → stream topic.
+
+    ``stream_key`` picks the produced record's key: ``"topic"`` is the
+    reference extension's shape (full MQTT topic — downstream KSQL
+    re-keys); ``"car"`` keys by the topic's LAST segment (the car id on
+    ``vehicles/sensor/data/{car}``), which is what lets a federated
+    MQTT front (ISSUE 20) produce straight into the keyed sensor stream
+    the twin shards consume — same car, same partition, no re-key hop."""
 
     mqtt_topic_filters: tuple
     stream_topic: str
     id: str = ""
+    stream_key: str = "topic"
+
+    def __post_init__(self):
+        if self.stream_key not in ("topic", "car"):
+            raise ValueError(f"stream_key must be 'topic' or 'car', "
+                             f"got {self.stream_key!r}")
 
     @classmethod
     def sensor_data(cls) -> "TopicMapping":
         """The reference's single production mapping."""
         return cls(("vehicles/sensor/data/#",), "sensor-data",
                    id="sensor-data")
+
+    @classmethod
+    def sensor_data_keyed(cls, stream_topic: str = "SENSOR_DATA_S_AVRO"
+                          ) -> "TopicMapping":
+        """The federated-front mapping: framed-Avro payloads land on the
+        twin shards' source topic keyed by car id."""
+        return cls(("vehicles/sensor/data/#",), stream_topic,
+                   id="sensor-data-keyed", stream_key="car")
 
 
 class KafkaBridge:
@@ -71,8 +92,10 @@ class KafkaBridge:
             stream.create_topic(m.stream_topic, partitions=partitions)
             cid = f"__bridge__{m.id or i}"
             dest = m.stream_topic
+            car_key = m.stream_key == "car"
 
-            def deliver(topic, payload, qos, retain, _dest=dest):
+            def deliver(topic, payload, qos, retain, _dest=dest,
+                        _car_key=car_key):
                 # the publisher-thread trace context (fan-out latency so
                 # far = mqtt_deliver) becomes a stream-record header; the
                 # MQTT payload and the produced value stay byte-identical.
@@ -89,7 +112,9 @@ class KafkaBridge:
                     ctx.mark("bridge_produce")
                     hdrs = tracing.headers_for(ctx)
                 t0 = time.perf_counter()
-                self.stream.produce(_dest, payload, key=topic.encode(),
+                key = (topic.rsplit("/", 1)[-1] if _car_key
+                       else topic).encode()
+                self.stream.produce(_dest, payload, key=key,
                                     timestamp_ms=int(time.time() * 1000),  # wallclock-ok: record timestamp, not a timeout
                                     headers=hdrs)
                 self._m_lag.observe(time.perf_counter() - t0)

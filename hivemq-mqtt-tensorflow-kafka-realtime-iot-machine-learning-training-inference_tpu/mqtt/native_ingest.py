@@ -134,6 +134,12 @@ class NativeIngestBridge:
         self.ingest = NativeMqttIngest(port)
         self.port = self.ingest.port
         self._match_cache: dict = {}
+        # mqtt topic bytes → stream record key bytes (the mapping's
+        # stream_key policy, cached like the match result — fleets
+        # publish on stable per-car topics)
+        self._key_cache: dict = {}
+        self._car_key = getattr(self.mapping, "stream_key",
+                                "topic") == "car"
         self._n_fwd = 0
         #: cumulative seconds spent in the stream-produce call (the
         #: bridge leg of the e2e produce breakdown)
@@ -146,7 +152,7 @@ class NativeIngestBridge:
         #: in-memory backend would only decode the frames right back).
         self._raw = None
         self._partitions = partitions
-        self._part_cache: dict = {}  # mqtt topic bytes → partition
+        self._part_cache: dict = {}  # record key bytes → partition
         if getattr(stream, "produce_raw", None) is not None and \
                 not isinstance(stream, Broker):
             from ..stream.producer import RawBatchProducer
@@ -171,14 +177,25 @@ class NativeIngestBridge:
                 self._match_cache[topic] = hit
         return hit
 
+    def _key_for(self, topic: bytes) -> bytes:
+        if not self._car_key:
+            return topic
+        key = self._key_cache.get(topic)
+        if key is None:
+            key = topic.rsplit(b"/", 1)[-1]
+            if len(self._key_cache) < 1_000_000:
+                self._key_cache[topic] = key
+        return key
+
     def pump_once(self, timeout_ms: int = 50) -> int:
         batch = self.ingest.poll(timeout_ms)
         if not batch:
             return 0
         ts = int(time.time() * 1000)  # wallclock-ok: record timestamp, not a timeout
         matches = self._matches
-        entries = [(topic, payload, ts) for topic, payload in batch
-                   if matches(topic)]
+        key_for = self._key_for
+        entries = [(key_for(topic), payload, ts)
+                   for topic, payload in batch if matches(topic)]
         n = len(entries)
         if entries:
             t0 = time.perf_counter()

@@ -645,6 +645,23 @@ class MqttClient:
             if not pair[1].wait(timeout):
                 raise TimeoutError(f"no PUBCOMP for packet {pid}")
 
+    def publish_many(self, items, qos: int = 0) -> int:
+        """Pipeline a batch of QoS-0 publishes in ONE socket write.
+
+        The federated fleet driver (iotml.gateway) pushes a tick's
+        worth of per-car publishes per front; a sendall per message
+        would syscall 100k times per tick.  QoS 0 only: higher QoS
+        needs per-packet ids and ack tracking, which defeats the
+        point of the batch."""
+        if qos != 0:
+            raise ValueError("publish_many is QoS 0 only")
+        buf = b"".join(publish_packet(topic, payload, 0, False, 0,
+                                      self._level)
+                       for topic, payload in items)
+        with self._wlock:
+            self._sock.sendall(buf)
+        return len(items)
+
     def subscribe(self, filter_: str, qos: int = 0,
                   timeout: float = 10.0) -> None:
         with self._wlock:

@@ -451,7 +451,7 @@ quorum_hwm_lag = default_registry.gauge(
 ALLOWED_LABEL_KEYS = frozenset({
     "stage", "topic", "partition", "group", "phase", "loop", "process",
     "component", "detector", "action", "fault", "source", "outcome",
-    "unit", "le", "slo", "window", "shard",
+    "unit", "le", "slo", "window", "shard", "route", "code",
 })
 
 #: per-metric ceiling on distinct label-value combinations.  Generous —
@@ -475,6 +475,8 @@ DECLARED_METRIC_LABELS = {
     "consumer_autoresets": ("topic",),
     "consumer_lag_records": ("group", "partition", "topic"),
     "dlq_total": ("source",),
+    "gateway_promotions": ("shard",),
+    "gateway_standby_lag": ("shard",),
     "isr_size": ("partition", "topic"),
     "model_offsets_lag": ("component",),
     "model_version": ("component",),
@@ -483,6 +485,8 @@ DECLARED_METRIC_LABELS = {
     "prefetch_occupancy": ("loop",),
     "quorum_hwm_lag": ("partition", "topic"),
     "replica_lag": ("topic",),
+    "rest_request_seconds": ("route",),
+    "rest_requests": ("route", "code"),
     "rollouts": ("outcome",),
     "slo_burn_rate": ("slo", "window"),
     "step_seconds": ("loop", "phase"),

@@ -69,6 +69,16 @@ class TwinService:
       changelog: False disables changelog emission (a read-only tap —
         used by feature-store consumers that follow someone else's
         changelog instead of writing their own).
+      table: an already-warm TwinTable to ADOPT instead of building an
+        empty one — the standby-promotion path (iotml.gateway): a
+        standby that followed the changelog continuously hands its
+        table over and only the delta past `rebuild_from` replays.
+      rebuild_from: per-partition changelog offsets the adopted table
+        has already applied through (TwinTable.changelog positions);
+        replay starts there instead of the log beginning.  Ignored
+        offsets behind the compacted log's begin are safe — fetch
+        resets to earliest and replay stays idempotent (latest record
+        per key wins).
     """
 
     def __init__(self, broker, source_topic: str = "SENSOR_DATA_S_AVRO",
@@ -77,7 +87,9 @@ class TwinService:
                  schema: RecordSchema = KSQL_CAR_SCHEMA,
                  window: int = DEFAULT_WINDOW,
                  changelog_topic: str = CHANGELOG_TOPIC,
-                 changelog: bool = True):
+                 changelog: bool = True,
+                 table: Optional[TwinTable] = None,
+                 rebuild_from: Optional[Dict[int, int]] = None):
         self.broker = broker
         self.source_topic = source_topic
         self.group = group
@@ -95,8 +107,8 @@ class TwinService:
         # ownership carries over 1:1 (same car -> same partition number)
         broker.create_topic(changelog_topic, partitions=n_parts,
                             cleanup_policy="compact")
-        self.table = TwinTable(window=window)
-        self.rebuilt_records = self._rebuild()
+        self.table = table if table is not None else TwinTable(window=window)
+        self.rebuilt_records = self._rebuild(start=rebuild_from)
         self.consumer = self._make_consumer()
         self.applied = 0
         self.emitted = 0
@@ -107,16 +119,20 @@ class TwinService:
         self._changelog_lock = threading.Lock()
 
     # ----------------------------------------------------------- rebuild
-    def _rebuild(self) -> int:
+    def _rebuild(self, start: Optional[Dict[int, int]] = None) -> int:
         """Replay the compacted changelog into the table: latest record
         per key wins (compaction already dropped most of the rest),
-        tombstones delete.  Returns records replayed."""
+        tombstones delete.  Returns records replayed.  `start` gives
+        per-partition offsets an adopted warm table already holds —
+        replay covers only the delta from there."""
+        start = start or {}
         replayed = 0
         for p in self.partitions:
             try:
                 off = self.broker.begin_offset(self.changelog_topic, p)
             except KeyError:
                 continue
+            off = max(off, start.get(p, 0))
             end = self.broker.end_offset(self.changelog_topic, p)
             while off < end:
                 try:

@@ -5,18 +5,65 @@ must speak: the Schema Registry (`register_schema.py:20-31`), Kafka Connect
 (`mongodb/README.md:139-171`), and KSQL (`01_installConfluentPlatform.sh`).
 This scaffold gives them one tiny routing layer: regex routes, JSON bodies,
 JSON replies, threaded serving — nothing more.
+
+Two serving disciplines every mounted surface inherits (ISSUE 20):
+
+* **Per-request observability** — every dispatch lands in
+  ``iotml_rest_requests_total{route,code}`` and the matched route's
+  ``iotml_rest_request_seconds`` series.  The route label is the
+  registered PATTERN string (a closed set — one series per route, never
+  per path), so a 100k-car query storm costs the same scrape it always
+  did.
+* **Bounded concurrency** — ThreadingHTTPServer spawns one handler
+  thread per connection with no ceiling, which under storm load turns
+  into unbounded thread creation exactly when the box is least able to
+  afford it.  Connections past ``max_concurrency`` are answered with a
+  raw ``503`` and closed BEFORE a handler thread exists; admitted
+  handler threads are daemon, named, and registered per lint R8.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, List, Optional, Tuple
 
+from ..obs.metrics import default_registry
+
 #: A handler takes (match, body_dict) and returns (status_code, json_obj).
 Route = Tuple[str, "re.Pattern", Callable]
+
+#: connection-concurrency ceiling when the constructor doesn't pick one
+#: (env IOTML_REST_MAX_CONCURRENCY; registered in config.non_config).
+DEFAULT_MAX_CONCURRENCY = 64
+
+rest_requests = default_registry.counter(
+    "iotml_rest_requests_total",
+    "REST requests served, by registered route pattern and status code "
+    "(route='(guard)' counts connections shed by the concurrency bound)")
+rest_request_seconds = default_registry.histogram(
+    "iotml_rest_request_seconds",
+    "REST request handler latency by registered route pattern")
+
+
+def _max_concurrency_default() -> int:
+    raw = os.environ.get("IOTML_REST_MAX_CONCURRENCY")
+    if raw is None:
+        return DEFAULT_MAX_CONCURRENCY
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"IOTML_REST_MAX_CONCURRENCY={raw!r} is not an integer")
+    if v < 1:
+        raise ValueError(
+            f"IOTML_REST_MAX_CONCURRENCY={v} must be >= 1: a zero bound "
+            f"sheds every connection")
+    return v
 
 
 class RestError(Exception):
@@ -28,20 +75,131 @@ class RestError(Exception):
         self.message = message
 
 
+class _BoundedThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with a concurrent-connection ceiling and
+    R8-compliant handler threads (daemon, named, registered)."""
+
+    daemon_threads = True
+
+    def __init__(self, addr, handler_cls, *, name: str, max_concurrency: int):
+        super().__init__(addr, handler_cls)
+        self.rest_name = name
+        self.max_concurrency = max_concurrency
+        self._guard_lock = threading.Lock()
+        self._active = 0
+        self._hseq = 0
+        self._live: set = set()
+
+    def active_connections(self) -> int:
+        with self._guard_lock:
+            return self._active
+
+    def process_request(self, request, client_address):
+        with self._guard_lock:
+            if self._active >= self.max_concurrency:
+                admitted = False
+            else:
+                admitted = True
+                self._active += 1
+                self._hseq += 1
+                seq = self._hseq
+                self._live.add(request)
+        if not admitted:
+            # shed BEFORE a handler thread exists: a raw one-shot 503 on
+            # the accepted socket is the whole cost of an over-limit
+            # connection — the storm can't grow the thread count
+            body = (b'{"error_code":503,"message":'
+                    b'"connection limit reached, retry"}')
+            try:
+                request.sendall(
+                    b"HTTP/1.1 503 Service Unavailable\r\n"
+                    b"Content-Type: application/json\r\n"
+                    b"Content-Length: " + str(len(body)).encode() +
+                    b"\r\nConnection: close\r\n\r\n" + body)
+            except OSError:
+                pass
+            self.shutdown_request(request)
+            rest_requests.inc(route="(guard)", code=503)
+            return
+        from ..supervise.registry import register_thread
+
+        t = register_thread(threading.Thread(
+            target=self._handle_admitted, args=(request, client_address),
+            daemon=True, name=f"{self.rest_name}-h{seq}"))
+        t.start()
+
+    def _handle_admitted(self, request, client_address):
+        try:
+            self.finish_request(request, client_address)
+        except (ConnectionResetError, BrokenPipeError):
+            pass  # client vanished / connection severed: routine, not an error
+        except Exception:
+            self.handle_error(request, client_address)
+        finally:
+            self.shutdown_request(request)
+            with self._guard_lock:
+                self._active -= 1
+                self._live.discard(request)
+
+    def close_connections(self) -> None:
+        """Sever every established keep-alive connection.  shutdown()
+        only stops the accept loop — admitted handler threads keep
+        answering on their open sockets, which a dead process would
+        not; a crash-shaped stop (a killed serving shard) must look
+        like one to clients holding persistent connections."""
+        import socket as _socket
+
+        with self._guard_lock:
+            conns = list(self._live)
+        for c in conns:
+            try:
+                c.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
 class RestServer:
     """Routed threaded HTTP server; subclass or compose with `route()`."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 name: str = "iotml-rest"):
+                 name: str = "iotml-rest",
+                 max_concurrency: Optional[int] = None):
         self.name = name
+        self.max_concurrency = (_max_concurrency_default()
+                                if max_concurrency is None
+                                else int(max_concurrency))
+        if self.max_concurrency < 1:
+            raise ValueError(f"max_concurrency={self.max_concurrency} "
+                             f"must be >= 1")
         self._routes: List[Route] = []
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
             server_version = name
+            # replies go out as two writes (header flush, then body);
+            # with Nagle on, the body segment waits for the client's
+            # delayed ACK — a flat ~40ms tax on every point lookup
+            disable_nagle_algorithm = True
 
             def _dispatch(self, method: str):
+                t0 = time.perf_counter()
+                route_label = "(unmatched)"
+                code = 404
+                try:
+                    route_label, code = self._dispatch_inner(method)
+                finally:
+                    rest_requests.inc(route=route_label, code=code)
+                    rest_request_seconds.observe(
+                        time.perf_counter() - t0, route=route_label)
+
+            def _dispatch_inner(self, method: str) -> Tuple[str, int]:
+                """Route + run a handler; returns (route_label, code)
+                for the per-request metrics."""
                 body = {}
                 n = int(self.headers.get("Content-Length", 0) or 0)
                 if n:
@@ -50,7 +208,7 @@ class RestServer:
                     except ValueError:
                         self._send(400, {"error_code": 400,
                                          "message": "malformed JSON body"})
-                        return
+                        return "(unmatched)", 400
                 # routes match the bare path; query-string params merge
                 # into the body dict (first value wins, body takes
                 # precedence) so GET endpoints can take parameters —
@@ -71,7 +229,7 @@ class RestServer:
                             result = fn(match, body)
                             if len(result) == 3:  # (code, raw bytes, ctype)
                                 self._send_raw(*result)
-                                return
+                                return pat.pattern, result[0]
                             code, obj = result
                         except RestError as e:
                             code, obj = e.code, {"error_code": e.code,
@@ -80,9 +238,10 @@ class RestServer:
                             code, obj = 500, {"error_code": 500, "message":
                                               f"{type(e).__name__}: {e}"}
                         self._send(code, obj)
-                        return
+                        return pat.pattern, code
                 self._send(404, {"error_code": 404,
                                  "message": f"no route for {method} {self.path}"})
+                return "(unmatched)", 404
 
             def _send(self, code: int, obj):
                 self.send_response(code)
@@ -117,7 +276,9 @@ class RestServer:
             def log_message(self, *a):  # quiet
                 pass
 
-        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd = _BoundedThreadingHTTPServer(
+            (host, port), Handler, name=name,
+            max_concurrency=self.max_concurrency)
         self.host, self.port = self.httpd.server_address
         self._thread: Optional[threading.Thread] = None
 
@@ -128,6 +289,10 @@ class RestServer:
     @property
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
+
+    def active_connections(self) -> int:
+        """Handler threads currently admitted (below max_concurrency)."""
+        return self.httpd.active_connections()
 
     def start(self):
         from ..supervise.registry import register_thread
@@ -140,4 +305,14 @@ class RestServer:
 
     def stop(self):
         self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def kill(self):
+        """Crash-shaped stop: accept loop down AND every established
+        connection severed, so clients on keep-alive sockets observe
+        exactly what a crashed server looks like (connection error →
+        their refresh-and-retry path) instead of being answered by a
+        zombie."""
+        self.httpd.shutdown()
+        self.httpd.close_connections()
         self.httpd.server_close()

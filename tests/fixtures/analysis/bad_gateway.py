@@ -1,0 +1,40 @@
+"""R16 fixture: direct TwinTable access outside iotml/twin/ +
+iotml/gateway/ — a TwinTable built by hand (1 finding), a foreign
+changelog apply (1 finding), and reaching through a service's `.table`
+for raw reads and a raw fold (3 findings) — plus the clean shapes:
+querying through the owning service / feature store / gateway client
+and a justified suppression (0 findings)."""
+
+
+def hand_built_table(TwinTable):
+    # flagged: the materialised twin is TwinService's (or the gateway
+    # standby plane's) to build — this table has no changelog, so a
+    # crash loses it and a rebuild disagrees with what it served
+    return TwinTable(window=8)
+
+
+def foreign_replay(table, record):
+    # flagged: changelog replay belongs to the table owners; a foreign
+    # apply forks state the changelog can never rebuild
+    table.apply_changelog("car-7", record)
+
+
+def raw_table_reads(svc):
+    # all three flagged: serving raw table state bypasses the owner's
+    # locking and the provenance dedup the crash story depends on
+    snap = svc.table.snapshot()
+    twins = svc.table.twins
+    svc.table.apply("car-7", 0, 41, [0.5], False, 0)
+    return snap, twins
+
+
+def query_through_owner_is_fine(svc, feats, client):
+    doc = svc.get("car-7")
+    vec = feats.vector(b"car-7")
+    remote = client.get("car-7")
+    return doc, vec, remote
+
+
+def justified(svc):
+    # lint-ok: R16 drill assertion compares the victim's raw snapshot
+    return svc.table.snapshot()

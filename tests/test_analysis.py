@@ -46,6 +46,7 @@ def test_lint_flags_every_seeded_violation():
     assert by_file.get("bad_twin.py") == {"R12"}
     assert by_file.get("bad_online.py") == {"R13"}
     assert by_file.get("bad_isr.py") == {"R15"}
+    assert by_file.get("bad_gateway.py") == {"R16"}
     # a reason-less suppression is itself a finding AND does not suppress
     assert by_file.get("bad_suppression.py") == {"R3"}
     # the runtime fixture is lint-clean (locks held via `with` only)
